@@ -1,0 +1,97 @@
+"""Model / training configuration.
+
+The reference keeps hyperparameters hard-coded inside module ``__init__``s and
+mutates an argparse namespace as a grab-bag (reference ``core/raft.py:31-47``).
+Here everything is an explicit, hashable dataclass so configs can be closed
+over by ``jit`` without retracing surprises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTConfig:
+    """Canonical RAFT hyperparameters.
+
+    Mirrors reference ``core/raft.py:31-41``: the large model uses
+    hidden/context dims 128/128, 4 correlation levels, radius 4; the small
+    model 96/64, 4 levels, radius 3.
+    """
+
+    small: bool = False
+    hidden_dim: int = 128
+    context_dim: int = 128
+    corr_levels: int = 4
+    corr_radius: int = 4
+    feature_dim: int = 256          # fnet output channels (reference raft.py:56)
+    dropout: float = 0.0
+    alternate_corr: bool = False    # on-demand (Pallas) correlation lookup
+    # The fork added a 1/sqrt(dim) scale inside CorrBlock (reference
+    # core/corr.py:61); canonical RAFT applies the same scale in its
+    # all-pairs matmul. Kept switchable for exactness experiments.
+    corr_scale: bool = True
+    # Fork drift: the fork's coords_grid normalizes to [0,1] (reference
+    # core/utils/utils.py:74-77) to serve the sigmoid-space "ours" family.
+    # Canonical RAFT needs pixel coordinates. Pixel is the default.
+    normalized_coords: bool = False
+    # Mixed precision: run encoders/update block in bfloat16, keep the
+    # correlation volume and flow arithmetic in float32.
+    mixed_precision: bool = False
+    # Number of refinement iterations (train default 12; eval uses 24/32 —
+    # reference train.py:445, evaluate.py:75,102,251).
+    iters: int = 12
+
+    @property
+    def fnet_dim(self) -> int:
+        return 128 if self.small else self.feature_dim
+
+    @property
+    def hdim(self) -> int:
+        return 96 if self.small else self.hidden_dim
+
+    @property
+    def cdim(self) -> int:
+        return 64 if self.small else self.context_dim
+
+    @property
+    def radius(self) -> int:
+        return 3 if self.small else self.corr_radius
+
+    @staticmethod
+    def large(**kw) -> "RAFTConfig":
+        return RAFTConfig(small=False, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "RAFTConfig":
+        """A miniature config for fast tests (not part of the reference)."""
+        return RAFTConfig(small=True, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters (reference ``train.py:431-452`` flags and
+    ``train_mixed.sh`` / ``train_standard.sh`` schedules)."""
+
+    name: str = "raft"
+    stage: str = "chairs"
+    lr: float = 4e-4
+    num_steps: int = 100000
+    batch_size: int = 8
+    image_size: Tuple[int, int] = (368, 496)
+    wdecay: float = 1e-4
+    epsilon: float = 1e-8
+    clip: float = 1.0
+    gamma: float = 0.8              # loss decay weight (train.py gamma flag)
+    add_noise: bool = False
+    iters: int = 12
+    val_freq: int = 5000            # reference train.py VAL_FREQ
+    sum_freq: int = 100             # reference train.py SUM_FREQ
+    scheduler: str = "onecycle"     # onecycle | step | cosine_warmup
+    seed: int = 2022                # reference train.py:454-455
+    # Auxiliary sparse-keypoint loss weight for the "ours" family, active
+    # for the first 20k steps (reference train.py:379-383).
+    sparse_lambda: float = 0.0
+    sparse_lambda_steps: int = 20000

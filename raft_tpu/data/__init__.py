@@ -1,0 +1,1 @@
+from raft_tpu.data import frame_utils  # noqa: F401

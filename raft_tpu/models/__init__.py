@@ -1,0 +1,1 @@
+from raft_tpu.models.raft import RAFT  # noqa: F401

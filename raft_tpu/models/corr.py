@@ -1,0 +1,157 @@
+"""Correlation volumes: all-pairs (materialized) and on-demand (windowed).
+
+Two regimes, matching the reference's operator boundary:
+
+* ``CorrBlock`` — materialize the 4D all-pairs volume in one MXU einsum and
+  avg-pool it into a pyramid, then answer windowed lookups by bilinear
+  sampling (reference ``core/corr.py:12-61``; canonical ``num_levels=4``
+  restored — the fork's drifted default was 2).
+* ``AlternateCorrBlock`` — never materialize the volume: recompute windowed
+  correlations around the current flow estimate on demand, O(HW·(2r+1)²·L)
+  memory (the ``alt_cuda_corr`` CUDA extension's role, reference
+  ``core/corr.py:64-92`` + ``alt_cuda_corr/correlation_kernel.cu:19-119``).
+  Backed by a fused Pallas gather-dot kernel on TPU with a jnp fallback;
+  both satisfy the contract ``AlternateCorrBlock(...) == CorrBlock(...)``
+  bit-for-bit in exact arithmetic, which the tests assert.
+
+Window-ordering note (weight compatibility): the reference builds its delta
+grid with ``meshgrid(dy, dx)`` and adds it to (x, y)-ordered centroids
+(original RAFT ``corr.py``), so window position (i, j) samples offset
+``(x + off_i, y + off_j)`` — the *first* window axis moves x. We replicate
+that exactly; converted torch weights then consume identical channel order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.sampling import avg_pool2x2, bilinear_sampler
+
+
+def all_pairs_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                          scale: bool = True) -> jnp.ndarray:
+    """(B,H,W,C) x (B,H,W,C) → (B,H,W,H,W) correlation volume.
+
+    One batched matmul on the MXU (reference ``core/corr.py:53-61``).
+    Computed in float32 regardless of input dtype — the volume is the
+    numerically sensitive object (mirrors the reference's autocast-exempt
+    corr, ``core/raft.py:100-103``).
+    """
+    B, H, W, C = fmap1.shape
+    a = fmap1.reshape(B, H * W, C).astype(jnp.float32)
+    b = fmap2.reshape(B, H * W, C).astype(jnp.float32)
+    corr = jnp.einsum("bnc,bmc->bnm", a, b,
+                      preferred_element_type=jnp.float32)
+    if scale:
+        corr = corr / jnp.sqrt(jnp.float32(C))
+    return corr.reshape(B, H, W, H, W)
+
+
+def _window_delta(radius: int) -> jnp.ndarray:
+    """(2r+1, 2r+1, 2) offsets; first axis moves x (see module docstring)."""
+    off = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    ox, oy = jnp.meshgrid(off, off, indexing="ij")
+    return jnp.stack([ox, oy], axis=-1)
+
+
+class CorrBlock:
+    """Materialized all-pairs correlation pyramid with windowed lookup."""
+
+    def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                 num_levels: int = 4, radius: int = 4, scale: bool = True):
+        self.num_levels = num_levels
+        self.radius = radius
+        B, H, W, _ = fmap1.shape
+        self.shape = (B, H, W)
+        corr = all_pairs_correlation(fmap1, fmap2, scale=scale)
+        corr = corr.reshape(B * H * W, H, W, 1)
+        self.pyramid: List[jnp.ndarray] = [corr]
+        for _ in range(num_levels - 1):
+            corr = avg_pool2x2(corr)
+            self.pyramid.append(corr)
+
+    def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
+        """coords: (B,H,W,2) pixel (x,y) → (B,H,W, L*(2r+1)^2) features."""
+        B, H, W = self.shape
+        r = self.radius
+        delta = _window_delta(r).reshape(1, 2 * r + 1, 2 * r + 1, 2)
+        out = []
+        for lvl, corr in enumerate(self.pyramid):
+            centroid = coords.reshape(B * H * W, 1, 1, 2) / (2 ** lvl)
+            sampled = bilinear_sampler(corr, centroid + delta)
+            out.append(sampled.reshape(B, H, W, -1))
+        return jnp.concatenate(out, axis=-1)
+
+
+def windowed_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                         coords: jnp.ndarray, radius: int,
+                         scale: bool = True) -> jnp.ndarray:
+    """On-demand windowed correlation (jnp reference implementation).
+
+    For each query pixel q, correlate ``fmap1[q]`` against bilinear samples
+    of ``fmap2`` in a (2r+1)^2 window around ``coords[q]``. Linearity of the
+    dot product makes this exactly equal to bilinearly sampling the
+    materialized volume (what ``alt_cuda_corr``'s bilinear-scatter kernel
+    computes, reference ``correlation_kernel.cu:92-114``).
+
+    Args:
+      fmap1: (B, H, W, C) query features (full resolution).
+      fmap2: (B, H2, W2, C) target features (this pyramid level).
+      coords: (B, H, W, 2) pixel coords *at the fmap2 level's scale*.
+    Returns:
+      (B, H, W, (2r+1)^2) correlation features.
+    """
+    B, H, W, C = fmap1.shape
+    win = 2 * radius + 1
+    delta = _window_delta(radius).reshape(1, 1, 1, win, win, 2)
+    pts = coords[:, :, :, None, None, :] + delta          # (B,H,W,w,w,2)
+    pts = pts.reshape(B, H, W, win * win, 2)
+    # Sample fmap2 at every window point: (B,H,W,w*w,C)
+    samples = bilinear_sampler(fmap2.astype(jnp.float32),
+                               pts.reshape(B, H * W * win * win, 2))
+    samples = samples.reshape(B, H, W, win * win, C)
+    corr = jnp.einsum("bhwc,bhwkc->bhwk", fmap1.astype(jnp.float32),
+                      samples, preferred_element_type=jnp.float32)
+    if scale:
+        corr = corr / jnp.sqrt(jnp.float32(C))
+    return corr
+
+
+class AlternateCorrBlock:
+    """Memory-efficient correlation: pool *features*, recompute windows on
+    demand (reference ``core/corr.py:64-92``). ``backend='pallas'`` uses the
+    fused TPU kernel; ``'jnp'`` the reference implementation."""
+
+    def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                 num_levels: int = 4, radius: int = 4, scale: bool = True,
+                 backend: str = "auto"):
+        self.num_levels = num_levels
+        self.radius = radius
+        self.scale = scale
+        self.backend = backend
+        self.fmap1 = fmap1
+        self.pyramid2: List[jnp.ndarray] = [fmap2]
+        for _ in range(num_levels - 1):
+            self.pyramid2.append(avg_pool2x2(self.pyramid2[-1]))
+
+    def _window_fn(self):
+        if self.backend == "jnp":
+            return windowed_correlation
+        try:
+            from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
+            return windowed_correlation_pallas
+        except Exception:
+            if self.backend == "pallas":
+                raise
+            return windowed_correlation
+
+    def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
+        fn = self._window_fn()
+        out = []
+        for lvl in range(self.num_levels):
+            out.append(fn(self.fmap1, self.pyramid2[lvl],
+                          coords / (2 ** lvl), self.radius, self.scale))
+        return jnp.concatenate(out, axis=-1)

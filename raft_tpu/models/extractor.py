@@ -1,0 +1,179 @@
+"""Feature / context encoders (canonical RAFT).
+
+Re-expresses the semantics of the reference's original encoders
+(``core/extractor_origin.py:116-189`` BasicEncoder, ``:192-263``
+SmallEncoder) as flax modules in NHWC: a stride-2 7x7 stem, three residual
+stages (stride 1/2/2 → total stride 8), and a 1x1 projection to the output
+dim, with selectable group/batch/instance/none normalization.
+
+Submodule attribute names intentionally mirror the torch parameter names
+(``conv1``, ``norm1``, ``layer1``…) so the torch→jax weight converter
+(raft_tpu/utils/torch_convert.py) is a mechanical rename.
+
+The reference's twin-image trick — concatenating both images on the batch
+axis for a single encoder pass (``core/extractor_origin.py:168-171``) — is
+done by the caller (models/raft.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Norm(nn.Module):
+    """Normalization dispatch matching torch semantics.
+
+    group   → GroupNorm(8 groups, affine)
+    batch   → BatchNorm (running stats, affine, momentum 0.1 torch == 0.9 flax)
+    instance→ per-channel GroupNorm without affine params (torch
+              InstanceNorm2d(affine=False, track_running_stats=False))
+    none    → identity
+    """
+
+    norm_fn: str = "group"
+    axis_name: Optional[str] = None  # cross-replica BN axis (data parallel)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.norm_fn == "group":
+            return nn.GroupNorm(num_groups=8, epsilon=1e-5)(x)
+        if self.norm_fn == "batch":
+            return nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                axis_name=self.axis_name if train else None,
+            )(x)
+        if self.norm_fn == "instance":
+            return nn.GroupNorm(
+                num_groups=None, group_size=1, epsilon=1e-5,
+                use_bias=False, use_scale=False)(x)
+        if self.norm_fn == "none":
+            return x
+        raise ValueError(f"unknown norm_fn {self.norm_fn!r}")
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + norm + residual 1x1 downsample when stride > 1
+    (reference ``core/extractor_origin.py:6-55``)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.conv1 = nn.Conv(self.planes, (3, 3), strides=self.stride,
+                             padding=1)
+        self.conv2 = nn.Conv(self.planes, (3, 3), padding=1)
+        self.norm1 = Norm(self.norm_fn, self.axis_name)
+        self.norm2 = Norm(self.norm_fn, self.axis_name)
+        if self.stride != 1:
+            self.downsample = nn.Conv(self.planes, (1, 1),
+                                      strides=self.stride)
+            self.norm3 = Norm(self.norm_fn, self.axis_name)
+
+    def __call__(self, x, train: bool = False):
+        y = nn.relu(self.norm1(self.conv1(x), train))
+        y = nn.relu(self.norm2(self.conv2(y), train))
+        if self.stride != 1:
+            x = self.norm3(self.downsample(x), train)
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3(stride) → 1x1 bottleneck used by the small encoder
+    (reference ``core/extractor_origin.py:58-113``)."""
+
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        q = self.planes // 4
+        self.conv1 = nn.Conv(q, (1, 1))
+        self.conv2 = nn.Conv(q, (3, 3), strides=self.stride, padding=1)
+        self.conv3 = nn.Conv(self.planes, (1, 1))
+        self.norm1 = Norm(self.norm_fn, self.axis_name)
+        self.norm2 = Norm(self.norm_fn, self.axis_name)
+        self.norm3 = Norm(self.norm_fn, self.axis_name)
+        if self.stride != 1:
+            self.downsample = nn.Conv(self.planes, (1, 1),
+                                      strides=self.stride)
+            self.norm4 = Norm(self.norm_fn, self.axis_name)
+
+    def __call__(self, x, train: bool = False):
+        y = nn.relu(self.norm1(self.conv1(x), train))
+        y = nn.relu(self.norm2(self.conv2(y), train))
+        y = nn.relu(self.norm3(self.conv3(y), train))
+        if self.stride != 1:
+            x = self.norm4(self.downsample(x), train)
+        return nn.relu(x + y)
+
+
+class BasicEncoder(nn.Module):
+    """Stride-8 encoder, 64→96→128 stages → 1x1 to ``output_dim``
+    (reference ``core/extractor_origin.py:116-189``)."""
+
+    output_dim: int = 256
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.conv1 = nn.Conv(64, (7, 7), strides=2, padding=3)
+        self.norm1 = Norm(self.norm_fn, self.axis_name)
+        self.layer1 = [ResidualBlock(64, self.norm_fn, 1, self.axis_name),
+                       ResidualBlock(64, self.norm_fn, 1, self.axis_name)]
+        self.layer2 = [ResidualBlock(96, self.norm_fn, 2, self.axis_name),
+                       ResidualBlock(96, self.norm_fn, 1, self.axis_name)]
+        self.layer3 = [ResidualBlock(128, self.norm_fn, 2, self.axis_name),
+                       ResidualBlock(128, self.norm_fn, 1, self.axis_name)]
+        self.conv2 = nn.Conv(self.output_dim, (1, 1))
+
+    def __call__(self, x, train: bool = False,
+                 deterministic: bool = True):
+        x = nn.relu(self.norm1(self.conv1(x), train))
+        for blk in self.layer1 + self.layer2 + self.layer3:
+            x = blk(x, train)
+        x = self.conv2(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2))(
+                x, deterministic=deterministic)
+        return x
+
+
+class SmallEncoder(nn.Module):
+    """Stride-8 bottleneck encoder, 32→64→96 stages
+    (reference ``core/extractor_origin.py:192-263``)."""
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    dropout: float = 0.0
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.conv1 = nn.Conv(32, (7, 7), strides=2, padding=3)
+        self.norm1 = Norm(self.norm_fn, self.axis_name)
+        self.layer1 = [BottleneckBlock(32, self.norm_fn, 1, self.axis_name),
+                       BottleneckBlock(32, self.norm_fn, 1, self.axis_name)]
+        self.layer2 = [BottleneckBlock(64, self.norm_fn, 2, self.axis_name),
+                       BottleneckBlock(64, self.norm_fn, 1, self.axis_name)]
+        self.layer3 = [BottleneckBlock(96, self.norm_fn, 2, self.axis_name),
+                       BottleneckBlock(96, self.norm_fn, 1, self.axis_name)]
+        self.conv2 = nn.Conv(self.output_dim, (1, 1))
+
+    def __call__(self, x, train: bool = False,
+                 deterministic: bool = True):
+        x = nn.relu(self.norm1(self.conv1(x), train))
+        for blk in self.layer1 + self.layer2 + self.layer3:
+            x = blk(x, train)
+        x = self.conv2(x)
+        if self.dropout > 0:
+            x = nn.Dropout(self.dropout, broadcast_dims=(1, 2))(
+                x, deterministic=deterministic)
+        return x

@@ -1,0 +1,160 @@
+"""Canonical RAFT (Teed & Deng, ECCV 2020) as a jittable flax module.
+
+Semantics follow reference ``core/raft.py`` with the original (pre-fork)
+dependencies restored: pixel-coordinate grids, 4-level correlation pyramid,
+``extractor_origin`` encoders. The 12-iteration refinement loop is a single
+``nn.scan`` (→ ``lax.scan``) with per-iteration gradient cut on the carried
+coordinates — ``stop_gradient`` here corresponds to ``coords1.detach()`` at
+reference ``core/raft.py:124``; gradients flow only through each iteration's
+delta, which is a training-dynamics property, not an optimization.
+
+TPU mapping: fnet/cnet and the all-pairs correlation pyramid are the
+scan-invariant prologue (MXU matmuls), the scan body is the ConvGRU update;
+everything is static-shaped, so XLA compiles one fused program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models.corr import AlternateCorrBlock, CorrBlock
+from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
+
+
+class _UpdateStep(nn.Module):
+    """One refinement iteration, the ``lax.scan`` body
+    (reference ``core/raft.py:123-140``)."""
+
+    config: RAFTConfig
+
+    def setup(self):
+        if self.config.small:
+            self.update_block = SmallUpdateBlock(self.config.hdim)
+        else:
+            self.update_block = BasicUpdateBlock(self.config.hdim)
+
+    def __call__(self, carry, corr_state, inp, coords0):
+        net, coords1 = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+        corr = _lookup(self.config, corr_state, coords1)
+        corr = corr.astype(net.dtype)
+        flow = (coords1 - coords0).astype(net.dtype)
+        net, up_mask, delta_flow = self.update_block(net, inp, corr, flow)
+        coords1 = coords1 + delta_flow.astype(jnp.float32)
+        new_flow = coords1 - coords0
+        if up_mask is None:
+            flow_up = upflow8(new_flow)
+        else:
+            flow_up = convex_upsample(new_flow, up_mask.astype(jnp.float32))
+        return (net, coords1), flow_up
+
+
+def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
+    """Precompute the scan-invariant correlation state.
+
+    All-pairs mode: the pooled 4D-volume pyramid (tuple of arrays).
+    Alternate mode: fmap1 + the pooled fmap2 pyramid (tuple of arrays).
+    Returned as plain pytrees so they can cross ``nn.scan`` as broadcast
+    arguments.
+    """
+    if cfg.alternate_corr:
+        blk = AlternateCorrBlock(fmap1, fmap2, cfg.corr_levels, cfg.radius,
+                                 cfg.corr_scale)
+        return ("alt", (blk.fmap1, tuple(blk.pyramid2)))
+    blk = CorrBlock(fmap1, fmap2, cfg.corr_levels, cfg.radius, cfg.corr_scale)
+    return ("allpairs", (tuple(blk.pyramid), fmap1.shape))
+
+
+def _lookup(cfg: RAFTConfig, corr_state, coords):
+    kind, payload = corr_state
+    if kind == "alt":
+        fmap1, pyramid2 = payload
+        blk = AlternateCorrBlock.__new__(AlternateCorrBlock)
+        blk.num_levels = cfg.corr_levels
+        blk.radius = cfg.radius
+        blk.scale = cfg.corr_scale
+        blk.backend = "auto"
+        blk.fmap1 = fmap1
+        blk.pyramid2 = list(pyramid2)
+        return blk(coords)
+    pyramid, shape = payload
+    blk = CorrBlock.__new__(CorrBlock)
+    blk.num_levels = cfg.corr_levels
+    blk.radius = cfg.radius
+    blk.shape = shape[:3]
+    blk.pyramid = list(pyramid)
+    return blk(coords)
+
+
+class RAFT(nn.Module):
+    """Full RAFT model: encoders + correlation + scanned refinement.
+
+    ``__call__`` mirrors reference ``core/raft.py:87-145``:
+      images in [0, 255] NHWC uint8/float; returns all per-iteration
+      upsampled flows ``(iters, B, 8H', 8W', 2)`` for training, or
+      ``(flow_low, flow_up)`` when ``test_mode``.
+    """
+
+    config: RAFTConfig = RAFTConfig()
+
+    def setup(self):
+        cfg = self.config
+        if cfg.small:
+            self.fnet = SmallEncoder(128, "instance", cfg.dropout)
+            self.cnet = SmallEncoder(cfg.hdim + cfg.cdim, "none", cfg.dropout)
+        else:
+            self.fnet = BasicEncoder(cfg.fnet_dim, "instance", cfg.dropout)
+            self.cnet = BasicEncoder(cfg.hdim + cfg.cdim, "batch",
+                                     cfg.dropout)
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: Optional[int] = None,
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False):
+        cfg = self.config
+        iters = iters if iters is not None else cfg.iters
+
+        dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+        image1 = 2.0 * (image1.astype(dtype) / 255.0) - 1.0
+        image2 = 2.0 * (image2.astype(dtype) / 255.0) - 1.0
+
+        # Twin-image trick: one fnet pass over both images concatenated on
+        # the batch axis (reference extractor_origin.py:168-171).
+        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                          train=train, deterministic=not train)
+        fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+
+        corr_state = _build_corr_state(cfg, fmap1, fmap2)
+
+        cnet_out = self.cnet(image1, train=train, deterministic=not train)
+        net, inp = jnp.split(cnet_out, [cfg.hdim], axis=-1)
+        net = jnp.tanh(net)
+        inp = nn.relu(inp)
+
+        B, H8, W8, _ = fmap1.shape
+        coords0 = coords_grid(B, H8, W8, normalized=cfg.normalized_coords)
+        coords1 = coords0
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        scan = nn.scan(
+            _UpdateStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=nn.broadcast,
+            out_axes=0,
+            length=iters,
+        )(cfg, name="update")
+        (net, coords1), flow_predictions = scan(
+            (net, coords1), corr_state, inp, coords0)
+
+        if test_mode:
+            return coords1 - coords0, flow_predictions[-1]
+        return flow_predictions

@@ -1,0 +1,142 @@
+"""Iterative update machinery: motion encoders, ConvGRUs, flow heads.
+
+Semantics follow reference ``core/update.py:6-136`` (FlowHead, ConvGRU,
+SepConvGRU, Small/BasicMotionEncoder, Small/BasicUpdateBlock), re-expressed
+in NHWC flax. Attribute names mirror the torch parameter names for the
+weight converter.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FlowHead(nn.Module):
+    """3x3 conv → relu → 3x3 conv to 2 channels (core/update.py:6-14)."""
+
+    hidden_dim: int = 256
+
+    def setup(self):
+        self.conv1 = nn.Conv(self.hidden_dim, (3, 3), padding=1)
+        self.conv2 = nn.Conv(2, (3, 3), padding=1)
+
+    def __call__(self, x):
+        return self.conv2(nn.relu(self.conv1(x)))
+
+
+class ConvGRU(nn.Module):
+    """3x3 convolutional GRU (core/update.py:16-31)."""
+
+    hidden_dim: int = 128
+
+    def setup(self):
+        self.convz = nn.Conv(self.hidden_dim, (3, 3), padding=1)
+        self.convr = nn.Conv(self.hidden_dim, (3, 3), padding=1)
+        self.convq = nn.Conv(self.hidden_dim, (3, 3), padding=1)
+
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(self.convz(hx))
+        r = nn.sigmoid(self.convr(hx))
+        q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable (1,5)+(5,1) convolutional GRU (core/update.py:33-60):
+    a horizontal GRU step followed by a vertical one."""
+
+    hidden_dim: int = 128
+
+    def setup(self):
+        self.convz1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
+        self.convr1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
+        self.convq1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
+        self.convz2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
+        self.convr2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
+        self.convq2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
+
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(self.convz1(hx))
+        r = nn.sigmoid(self.convr1(hx))
+        q = nn.tanh(self.convq1(jnp.concatenate([r * h, x], axis=-1)))
+        h = (1 - z) * h + z * q
+
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(self.convz2(hx))
+        r = nn.sigmoid(self.convr2(hx))
+        q = nn.tanh(self.convq2(jnp.concatenate([r * h, x], axis=-1)))
+        return (1 - z) * h + z * q
+
+
+class SmallMotionEncoder(nn.Module):
+    """Correlation+flow → 82-channel motion features
+    (core/update.py:62-76). ``corr_channels = levels * (2r+1)^2``."""
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(nn.Conv(96, (1, 1), name="convc1")(corr))
+        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, name="convf1")(flow))
+        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, name="convf2")(flo))
+        out = jnp.concatenate([cor, flo], axis=-1)
+        out = nn.relu(nn.Conv(80, (3, 3), padding=1, name="conv")(out))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class BasicMotionEncoder(nn.Module):
+    """Correlation+flow → 128-channel motion features
+    (core/update.py:79-97)."""
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        cor = nn.relu(nn.Conv(256, (1, 1), name="convc1")(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, name="convc2")(cor))
+        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, name="convf1")(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, name="convf2")(flo))
+        out = jnp.concatenate([cor, flo], axis=-1)
+        out = nn.relu(nn.Conv(126, (3, 3), padding=1, name="conv")(out))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+class SmallUpdateBlock(nn.Module):
+    """Motion encoder → ConvGRU → FlowHead; no upsampling mask
+    (core/update.py:99-112)."""
+
+    hidden_dim: int = 96
+
+    def setup(self):
+        self.encoder = SmallMotionEncoder()
+        self.gru = ConvGRU(self.hidden_dim)
+        self.flow_head = FlowHead(128)
+
+    def __call__(self, net, inp, corr, flow):
+        motion_features = self.encoder(flow, corr)
+        inp = jnp.concatenate([inp, motion_features], axis=-1)
+        net = self.gru(net, inp)
+        delta_flow = self.flow_head(net)
+        return net, None, delta_flow
+
+
+class BasicUpdateBlock(nn.Module):
+    """Motion encoder → SepConvGRU → FlowHead + convex-upsampling mask head
+    scaled by 0.25 (core/update.py:114-136)."""
+
+    hidden_dim: int = 128
+
+    def setup(self):
+        self.encoder = BasicMotionEncoder()
+        self.gru = SepConvGRU(self.hidden_dim)
+        self.flow_head = FlowHead(256)
+        self.mask_conv1 = nn.Conv(256, (3, 3), padding=1)
+        self.mask_conv2 = nn.Conv(64 * 9, (1, 1))
+
+    def __call__(self, net, inp, corr, flow):
+        motion_features = self.encoder(flow, corr)
+        inp = jnp.concatenate([inp, motion_features], axis=-1)
+        net = self.gru(net, inp)
+        delta_flow = self.flow_head(net)
+        # 0.25 balances gradients into the mask head (core/update.py:133).
+        mask = 0.25 * self.mask_conv2(nn.relu(self.mask_conv1(net)))
+        return net, mask, delta_flow

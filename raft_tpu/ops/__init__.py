@@ -1,0 +1,7 @@
+from raft_tpu.ops.sampling import (  # noqa: F401
+    bilinear_sampler,
+    convex_upsample,
+    coords_grid,
+    resize_bilinear_align_corners,
+    upflow8,
+)

@@ -1,0 +1,154 @@
+"""Pure-function sampling / grid numerics.
+
+These are the numerics-critical primitives of the framework: coordinate
+grids, bilinear lookup with zero padding (the semantics of torch
+``grid_sample(align_corners=True, padding_mode='zeros')`` that the reference
+relies on in ``core/utils/utils.py:57-71``), convex 8x upsampling
+(reference ``core/raft.py:74-85``) and align-corners bilinear flow upsampling
+(reference ``core/utils/utils.py:80-82``).
+
+Layout convention: images/features are NHWC; flow fields are ``(B, H, W, 2)``
+with the last axis ordered ``(x, y)`` — matching the channel order of the
+reference's ``coords_grid`` (reference ``core/utils/utils.py:74-77``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coords_grid(batch: int, ht: int, wd: int, normalized: bool = False) -> jnp.ndarray:
+    """Pixel coordinate grid of shape ``(batch, ht, wd, 2)``, last axis (x, y).
+
+    ``normalized=False`` restores the canonical RAFT pixel semantics;
+    ``normalized=True`` reproduces the fork's [0, 1]-normalized variant
+    (reference ``core/utils/utils.py:74-77``) used by the sparse-keypoint
+    ("ours") model family.
+    """
+    y = jnp.arange(ht, dtype=jnp.float32)
+    x = jnp.arange(wd, dtype=jnp.float32)
+    if normalized:
+        y = y / max(ht - 1, 1)
+        x = x / max(wd - 1, 1)
+    yy, xx = jnp.meshgrid(y, x, indexing="ij")
+    grid = jnp.stack([xx, yy], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def bilinear_sampler(img: jnp.ndarray, coords: jnp.ndarray,
+                     mask: bool = False):
+    """Sample ``img`` at pixel coordinates with bilinear interpolation.
+
+    Semantics match ``F.grid_sample(..., align_corners=True,
+    padding_mode='zeros')`` after the pixel→[-1, 1] normalization the
+    reference performs (reference ``core/utils/utils.py:57-71``): a sample at
+    integer coordinate (x, y) returns ``img[y, x]`` exactly, and samples
+    blend toward zero outside the image.
+
+    Args:
+      img: ``(B, H, W, C)``.
+      coords: ``(B, ..., 2)`` pixel coordinates, last axis (x, y).
+      mask: if True, also return the in-bounds validity mask.
+
+    Returns:
+      ``(B, ..., C)`` sampled values (and optionally the ``(B, ...)`` mask).
+    """
+    H, W = img.shape[1], img.shape[2]
+    x, y = coords[..., 0], coords[..., 1]
+
+    x0f = jnp.floor(x)
+    y0f = jnp.floor(y)
+    x0 = x0f.astype(jnp.int32)
+    y0 = y0f.astype(jnp.int32)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    wx1 = x - x0f  # weight toward x1
+    wy1 = y - y0f
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def gather(yi, xi):
+        valid = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1)
+        yc = jnp.clip(yi, 0, H - 1)
+        # Per-batch advanced-index gather; vmap keeps it batched.
+        vals = jax.vmap(lambda im, yy, xx: im[yy, xx])(img, yc, xc)
+        return vals * valid[..., None].astype(img.dtype)
+
+    out = (gather(y0, x0) * (wx0 * wy0)[..., None]
+           + gather(y0, x1) * (wx1 * wy0)[..., None]
+           + gather(y1, x0) * (wx0 * wy1)[..., None]
+           + gather(y1, x1) * (wx1 * wy1)[..., None])
+
+    if mask:
+        inb = ((x >= 0) & (x <= W - 1) & (y >= 0) & (y <= H - 1))
+        return out, inb.astype(img.dtype)
+    return out
+
+
+def resize_bilinear_align_corners(x: jnp.ndarray, new_ht: int, new_wd: int) -> jnp.ndarray:
+    """Bilinear resize with align_corners=True semantics (NHWC).
+
+    ``jax.image.resize`` uses half-pixel centers (align_corners=False), so we
+    express the align-corners grid explicitly through ``bilinear_sampler``.
+    """
+    B, H, W, _ = x.shape
+    sy = (H - 1) / max(new_ht - 1, 1)
+    sx = (W - 1) / max(new_wd - 1, 1)
+    yy = jnp.arange(new_ht, dtype=jnp.float32) * sy
+    xx = jnp.arange(new_wd, dtype=jnp.float32) * sx
+    gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+    coords = jnp.broadcast_to(jnp.stack([gx, gy], axis=-1)[None],
+                              (B, new_ht, new_wd, 2))
+    return bilinear_sampler(x, coords)
+
+
+def upflow8(flow: jnp.ndarray) -> jnp.ndarray:
+    """8x bilinear flow upsampling with value scaling (reference
+    ``core/utils/utils.py:80-82``). ``flow``: ``(B, H, W, 2)``."""
+    B, H, W, _ = flow.shape
+    return 8.0 * resize_bilinear_align_corners(flow, 8 * H, 8 * W)
+
+
+def _neighborhood3x3(x: jnp.ndarray) -> jnp.ndarray:
+    """Stack the 3x3 zero-padded neighborhood: ``(B,H,W,C)`` →
+    ``(B,H,W,9,C)`` ordered row-major (dy, dx) — the ordering of
+    ``F.unfold(kernel=3, padding=1)``."""
+    p = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    H, W = x.shape[1], x.shape[2]
+    shifts = [p[:, dy:dy + H, dx:dx + W] for dy in range(3) for dx in range(3)]
+    return jnp.stack(shifts, axis=3)
+
+
+def convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Convex combination 8x upsampling (reference ``core/raft.py:74-85``).
+
+    Each fine pixel is a softmax-weighted combination of the 3x3 coarse
+    neighborhood of ``8 * flow``.
+
+    Args:
+      flow: ``(B, H, W, 2)`` coarse flow.
+      mask: ``(B, H, W, 576)`` logits; channels factor as ``(9, 8, 8)`` =
+        (neighbor, sub_y, sub_x), matching the torch
+        ``view(N, 1, 9, 8, 8, H, W)`` channel split.
+
+    Returns:
+      ``(B, 8H, 8W, 2)`` upsampled flow.
+    """
+    B, H, W, _ = flow.shape
+    m = mask.reshape(B, H, W, 9, 8, 8)
+    m = jax.nn.softmax(m, axis=3)
+    nb = _neighborhood3x3(8.0 * flow)                    # (B,H,W,9,2)
+    up = jnp.einsum("bhwkyx,bhwkc->bhwyxc", m, nb)       # (B,H,W,8,8,2)
+    up = up.transpose(0, 1, 3, 2, 4, 5)                  # (B,H,8,W,8,2)
+    return up.reshape(B, 8 * H, 8 * W, 2)
+
+
+def avg_pool2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """2x2 stride-2 average pool (NHWC), the pyramid builder of
+    ``CorrBlock`` (reference ``core/corr.py:24-27``)."""
+    return jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    ) * 0.25

@@ -1,0 +1,2 @@
+from raft_tpu.utils.padder import InputPadder  # noqa: F401
+from raft_tpu.utils.warm_start import forward_interpolate  # noqa: F401

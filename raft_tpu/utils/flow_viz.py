@@ -1,0 +1,77 @@
+"""Flow → RGB visualization via the Middlebury color wheel.
+
+Same capability as reference ``core/utils/flow_viz.py:20-132`` (the standard
+Baker et al. color coding): 55-entry color wheel, angle → hue, radius →
+saturation, with optional radius clipping/normalization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_colorwheel() -> np.ndarray:
+    """The 55-color Middlebury wheel (RY/YG/GC/CB/BM/MR segments)."""
+    RY, YG, GC, CB, BM, MR = 15, 6, 4, 11, 13, 6
+    ncols = RY + YG + GC + CB + BM + MR
+    wheel = np.zeros((ncols, 3))
+    col = 0
+    wheel[0:RY, 0] = 255
+    wheel[0:RY, 1] = np.floor(255 * np.arange(RY) / RY)
+    col += RY
+    wheel[col:col + YG, 0] = 255 - np.floor(255 * np.arange(YG) / YG)
+    wheel[col:col + YG, 1] = 255
+    col += YG
+    wheel[col:col + GC, 1] = 255
+    wheel[col:col + GC, 2] = np.floor(255 * np.arange(GC) / GC)
+    col += GC
+    wheel[col:col + CB, 1] = 255 - np.floor(255 * np.arange(CB) / CB)
+    wheel[col:col + CB, 2] = 255
+    col += CB
+    wheel[col:col + BM, 2] = 255
+    wheel[col:col + BM, 0] = np.floor(255 * np.arange(BM) / BM)
+    col += BM
+    wheel[col:col + MR, 2] = 255 - np.floor(255 * np.arange(MR) / MR)
+    wheel[col:col + MR, 0] = 255
+    return wheel
+
+
+_WHEEL = make_colorwheel()
+
+
+def flow_uv_to_colors(u: np.ndarray, v: np.ndarray,
+                      convert_to_bgr: bool = False) -> np.ndarray:
+    """Map normalized (|uv| <= 1) flow components to uint8 colors."""
+    flow_image = np.zeros((*u.shape, 3), np.uint8)
+    ncols = _WHEEL.shape[0]
+    rad = np.sqrt(np.square(u) + np.square(v))
+    a = np.arctan2(-v, -u) / np.pi
+    fk = (a + 1) / 2 * (ncols - 1)
+    k0 = np.floor(fk).astype(np.int32)
+    k1 = (k0 + 1) % ncols
+    f = fk - k0
+    for i in range(3):
+        col0 = _WHEEL[k0, i] / 255.0
+        col1 = _WHEEL[k1, i] / 255.0
+        col = (1 - f) * col0 + f * col1
+        idx = rad <= 1
+        col[idx] = 1 - rad[idx] * (1 - col[idx])
+        col[~idx] = col[~idx] * 0.75  # out-of-range: desaturate
+        ch = 2 - i if convert_to_bgr else i
+        flow_image[..., ch] = np.floor(255 * col)
+    return flow_image
+
+
+def flow_to_image(flow_uv: np.ndarray, clip_flow: float | None = None,
+                  convert_to_bgr: bool = False) -> np.ndarray:
+    """Colorize an (H, W, 2) flow field; radius-normalize over the image."""
+    flow_uv = np.asarray(flow_uv)
+    assert flow_uv.ndim == 3 and flow_uv.shape[2] == 2, "expected (H, W, 2)"
+    if clip_flow is not None:
+        flow_uv = np.clip(flow_uv, 0, clip_flow)
+    u, v = flow_uv[..., 0], flow_uv[..., 1]
+    rad = np.sqrt(np.square(u) + np.square(v))
+    rad_max = np.max(rad)
+    eps = 1e-5
+    return flow_uv_to_colors(u / (rad_max + eps), v / (rad_max + eps),
+                             convert_to_bgr)

@@ -1,0 +1,46 @@
+"""Input padding to stride-8-compatible shapes.
+
+Reference semantics: ``core/utils/utils.py:7-24`` — replicate-pad to the next
+multiple of 8; 'sintel' mode centers vertically, every other mode (kitti)
+pads only at the top. On TPU static shapes matter, so the padder is a
+host-side helper: pick a resolution bucket once, pad numpy arrays before
+``device_put``, and crop after.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class InputPadder:
+    """Pads NHWC (or HWC) arrays so H and W are divisible by ``factor``."""
+
+    def __init__(self, dims, mode: str = "sintel", factor: int = 8):
+        self.ht, self.wd = dims[-3], dims[-2]
+        pad_ht = (((self.ht // factor) + 1) * factor - self.ht) % factor
+        pad_wd = (((self.wd // factor) + 1) * factor - self.wd) % factor
+        if mode == "sintel":
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2]
+        else:  # kitti: all vertical padding on top
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, pad_ht, 0]
+
+    @property
+    def padded_shape(self):
+        return (self.ht + self._pad[2] + self._pad[3],
+                self.wd + self._pad[0] + self._pad[1])
+
+    def pad(self, *inputs):
+        l, r, t, b = self._pad
+        out = []
+        for x in inputs:
+            widths = [(0, 0)] * x.ndim
+            widths[-3] = (t, b)
+            widths[-2] = (l, r)
+            out.append(np.pad(x, widths, mode="edge"))
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x):
+        l, r, t, b = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t:ht - b if b else ht, l:wd - r if r else wd, :]

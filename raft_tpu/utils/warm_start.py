@@ -1,0 +1,44 @@
+"""Warm-start flow propagation for video sequences.
+
+Reference semantics: ``core/utils/utils.py:26-54`` (``forward_interpolate``) —
+forward-splat the previous frame's flow to initialize the next pair's
+refinement, filling holes with nearest-neighbor interpolation. This is a
+host-side (numpy/scipy) preprocessing step; the result is fed to the model as
+``flow_init``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import interpolate as _interp
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-propagate a flow field along itself.
+
+    Args:
+      flow: ``(H, W, 2)`` numpy flow, last axis (x, y).
+    Returns:
+      ``(H, W, 2)`` propagated flow.
+    """
+    flow = np.asarray(flow)
+    dx, dy = flow[..., 0], flow[..., 1]
+    ht, wd = dx.shape
+    y0, x0 = np.meshgrid(np.arange(ht), np.arange(wd), indexing="ij")
+
+    x1 = x0 + dx
+    y1 = y0 + dy
+
+    x1 = x1.reshape(-1)
+    y1 = y1.reshape(-1)
+    dx = dx.reshape(-1)
+    dy = dy.reshape(-1)
+
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    x1, y1, dx, dy = x1[valid], y1[valid], dx[valid], dy[valid]
+
+    flow_x = _interp.griddata((x1, y1), dx, (x0, y0),
+                              method="nearest", fill_value=0)
+    flow_y = _interp.griddata((x1, y1), dy, (x0, y0),
+                              method="nearest", fill_value=0)
+    return np.stack([flow_x, flow_y], axis=-1).astype(np.float32)

@@ -1,0 +1,30 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Tests never touch the real TPU. The environment may pin
+``JAX_PLATFORMS=axon`` (the TPU tunnel) and register an axon plugin that
+pins ``jax_platforms`` in jax.config at interpreter startup, so we must
+override both the env var *and* the config value before any backend is
+initialized. Sharding tests use
+``--xla_force_host_platform_device_count=8``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
