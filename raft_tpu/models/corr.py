@@ -57,6 +57,38 @@ def _window_delta(radius: int) -> jnp.ndarray:
     return jnp.stack([ox, oy], axis=-1)
 
 
+def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                       num_levels: int = 4, scale: bool = True):
+    """All-pairs volume → avg-pooled pyramid, each level
+    ``(B*H*W, H/2^l, W/2^l, 1)`` (reference ``core/corr.py:18-27``)."""
+    B, H, W, _ = fmap1.shape
+    corr = all_pairs_correlation(fmap1, fmap2, scale=scale)
+    corr = corr.reshape(B * H * W, H, W, 1)
+    pyramid = [corr]
+    for _ in range(num_levels - 1):
+        corr = avg_pool2x2(corr)
+        pyramid.append(corr)
+    return tuple(pyramid)
+
+
+def pyramid_lookup(pyramid, coords: jnp.ndarray, radius: int) -> jnp.ndarray:
+    """Windowed bilinear lookup into a materialized pyramid.
+
+    ``coords``: (B, H, W, 2) pixel (x, y); per level the centroid is scaled
+    by ``1/2^level`` (canonical RAFT — the fork dropped this rescale,
+    reference ``core/corr.py:42``). Returns (B, H, W, L*(2r+1)^2).
+    """
+    B, H, W, _ = coords.shape
+    r = radius
+    delta = _window_delta(r).reshape(1, 2 * r + 1, 2 * r + 1, 2)
+    out = []
+    for lvl, corr in enumerate(pyramid):
+        centroid = coords.reshape(B * H * W, 1, 1, 2) / (2 ** lvl)
+        sampled = bilinear_sampler(corr, centroid + delta)
+        out.append(sampled.reshape(B, H, W, -1))
+    return jnp.concatenate(out, axis=-1)
+
+
 class CorrBlock:
     """Materialized all-pairs correlation pyramid with windowed lookup."""
 
@@ -64,26 +96,10 @@ class CorrBlock:
                  num_levels: int = 4, radius: int = 4, scale: bool = True):
         self.num_levels = num_levels
         self.radius = radius
-        B, H, W, _ = fmap1.shape
-        self.shape = (B, H, W)
-        corr = all_pairs_correlation(fmap1, fmap2, scale=scale)
-        corr = corr.reshape(B * H * W, H, W, 1)
-        self.pyramid: List[jnp.ndarray] = [corr]
-        for _ in range(num_levels - 1):
-            corr = avg_pool2x2(corr)
-            self.pyramid.append(corr)
+        self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels, scale)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
-        """coords: (B,H,W,2) pixel (x,y) → (B,H,W, L*(2r+1)^2) features."""
-        B, H, W = self.shape
-        r = self.radius
-        delta = _window_delta(r).reshape(1, 2 * r + 1, 2 * r + 1, 2)
-        out = []
-        for lvl, corr in enumerate(self.pyramid):
-            centroid = coords.reshape(B * H * W, 1, 1, 2) / (2 ** lvl)
-            sampled = bilinear_sampler(corr, centroid + delta)
-            out.append(sampled.reshape(B, H, W, -1))
-        return jnp.concatenate(out, axis=-1)
+        return pyramid_lookup(self.pyramid, coords, self.radius)
 
 
 def windowed_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
@@ -120,6 +136,39 @@ def windowed_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     return corr
 
 
+def build_feature_pyramid(fmap2: jnp.ndarray, num_levels: int):
+    """Pool target features for on-demand correlation
+    (reference ``core/corr.py:69-73``)."""
+    pyramid2 = [fmap2]
+    for _ in range(num_levels - 1):
+        pyramid2.append(avg_pool2x2(pyramid2[-1]))
+    return tuple(pyramid2)
+
+
+def _resolve_window_fn(backend: str):
+    if backend == "jnp":
+        return windowed_correlation
+    try:
+        from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
+        return windowed_correlation_pallas
+    except Exception:
+        if backend == "pallas":
+            raise
+        return windowed_correlation
+
+
+def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
+                     radius: int, scale: bool = True,
+                     backend: str = "auto") -> jnp.ndarray:
+    """On-demand windowed lookup over a pooled feature pyramid; numerically
+    identical to ``pyramid_lookup`` over the materialized volume."""
+    fn = _resolve_window_fn(backend)
+    out = []
+    for lvl, f2 in enumerate(pyramid2):
+        out.append(fn(fmap1, f2, coords / (2 ** lvl), radius, scale))
+    return jnp.concatenate(out, axis=-1)
+
+
 class AlternateCorrBlock:
     """Memory-efficient correlation: pool *features*, recompute windows on
     demand (reference ``core/corr.py:64-92``). ``backend='pallas'`` uses the
@@ -133,25 +182,8 @@ class AlternateCorrBlock:
         self.scale = scale
         self.backend = backend
         self.fmap1 = fmap1
-        self.pyramid2: List[jnp.ndarray] = [fmap2]
-        for _ in range(num_levels - 1):
-            self.pyramid2.append(avg_pool2x2(self.pyramid2[-1]))
-
-    def _window_fn(self):
-        if self.backend == "jnp":
-            return windowed_correlation
-        try:
-            from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
-            return windowed_correlation_pallas
-        except Exception:
-            if self.backend == "pallas":
-                raise
-            return windowed_correlation
+        self.pyramid2 = build_feature_pyramid(fmap2, num_levels)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
-        fn = self._window_fn()
-        out = []
-        for lvl in range(self.num_levels):
-            out.append(fn(self.fmap1, self.pyramid2[lvl],
-                          coords / (2 ** lvl), self.radius, self.scale))
-        return jnp.concatenate(out, axis=-1)
+        return alternate_lookup(self.fmap1, self.pyramid2, coords,
+                                self.radius, self.scale, self.backend)

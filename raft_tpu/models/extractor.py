@@ -10,6 +10,10 @@ Submodule attribute names intentionally mirror the torch parameter names
 (``conv1``, ``norm1``, ``layer1``…) so the torch→jax weight converter
 (raft_tpu/utils/torch_convert.py) is a mechanical rename.
 
+``dtype`` is the compute/output dtype (bfloat16 under the mixed-precision
+policy); parameters stay float32 and flax norm layers compute statistics in
+float32 regardless.
+
 The reference's twin-image trick — concatenating both images on the batch
 axis for a single encoder pass (``core/extractor_origin.py:168-171``) — is
 done by the caller (models/raft.py).
@@ -17,7 +21,7 @@ done by the caller (models/raft.py).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -35,22 +39,27 @@ class Norm(nn.Module):
 
     norm_fn: str = "group"
     axis_name: Optional[str] = None  # cross-replica BN axis (data parallel)
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.norm_fn == "group":
-            return nn.GroupNorm(num_groups=8, epsilon=1e-5)(x)
+            return nn.GroupNorm(num_groups=8, epsilon=1e-5,
+                                dtype=self.dtype, name="n")(x)
         if self.norm_fn == "batch":
             return nn.BatchNorm(
                 use_running_average=not train,
                 momentum=0.9,
                 epsilon=1e-5,
                 axis_name=self.axis_name if train else None,
+                dtype=self.dtype,
+                name="n",
             )(x)
         if self.norm_fn == "instance":
             return nn.GroupNorm(
                 num_groups=None, group_size=1, epsilon=1e-5,
-                use_bias=False, use_scale=False)(x)
+                use_bias=False, use_scale=False, dtype=self.dtype,
+                name="n")(x)
         if self.norm_fn == "none":
             return x
         raise ValueError(f"unknown norm_fn {self.norm_fn!r}")
@@ -64,17 +73,19 @@ class ResidualBlock(nn.Module):
     norm_fn: str = "group"
     stride: int = 1
     axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
 
     def setup(self):
         self.conv1 = nn.Conv(self.planes, (3, 3), strides=self.stride,
-                             padding=1)
-        self.conv2 = nn.Conv(self.planes, (3, 3), padding=1)
-        self.norm1 = Norm(self.norm_fn, self.axis_name)
-        self.norm2 = Norm(self.norm_fn, self.axis_name)
+                             padding=1, dtype=self.dtype)
+        self.conv2 = nn.Conv(self.planes, (3, 3), padding=1,
+                             dtype=self.dtype)
+        self.norm1 = Norm(self.norm_fn, self.axis_name, self.dtype)
+        self.norm2 = Norm(self.norm_fn, self.axis_name, self.dtype)
         if self.stride != 1:
             self.downsample = nn.Conv(self.planes, (1, 1),
-                                      strides=self.stride)
-            self.norm3 = Norm(self.norm_fn, self.axis_name)
+                                      strides=self.stride, dtype=self.dtype)
+            self.norm3 = Norm(self.norm_fn, self.axis_name, self.dtype)
 
     def __call__(self, x, train: bool = False):
         y = nn.relu(self.norm1(self.conv1(x), train))
@@ -92,19 +103,21 @@ class BottleneckBlock(nn.Module):
     norm_fn: str = "group"
     stride: int = 1
     axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
 
     def setup(self):
         q = self.planes // 4
-        self.conv1 = nn.Conv(q, (1, 1))
-        self.conv2 = nn.Conv(q, (3, 3), strides=self.stride, padding=1)
-        self.conv3 = nn.Conv(self.planes, (1, 1))
-        self.norm1 = Norm(self.norm_fn, self.axis_name)
-        self.norm2 = Norm(self.norm_fn, self.axis_name)
-        self.norm3 = Norm(self.norm_fn, self.axis_name)
+        self.conv1 = nn.Conv(q, (1, 1), dtype=self.dtype)
+        self.conv2 = nn.Conv(q, (3, 3), strides=self.stride, padding=1,
+                             dtype=self.dtype)
+        self.conv3 = nn.Conv(self.planes, (1, 1), dtype=self.dtype)
+        self.norm1 = Norm(self.norm_fn, self.axis_name, self.dtype)
+        self.norm2 = Norm(self.norm_fn, self.axis_name, self.dtype)
+        self.norm3 = Norm(self.norm_fn, self.axis_name, self.dtype)
         if self.stride != 1:
             self.downsample = nn.Conv(self.planes, (1, 1),
-                                      strides=self.stride)
-            self.norm4 = Norm(self.norm_fn, self.axis_name)
+                                      strides=self.stride, dtype=self.dtype)
+            self.norm4 = Norm(self.norm_fn, self.axis_name, self.dtype)
 
     def __call__(self, x, train: bool = False):
         y = nn.relu(self.norm1(self.conv1(x), train))
@@ -123,17 +136,19 @@ class BasicEncoder(nn.Module):
     norm_fn: str = "batch"
     dropout: float = 0.0
     axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.conv1 = nn.Conv(64, (7, 7), strides=2, padding=3)
-        self.norm1 = Norm(self.norm_fn, self.axis_name)
-        self.layer1 = [ResidualBlock(64, self.norm_fn, 1, self.axis_name),
-                       ResidualBlock(64, self.norm_fn, 1, self.axis_name)]
-        self.layer2 = [ResidualBlock(96, self.norm_fn, 2, self.axis_name),
-                       ResidualBlock(96, self.norm_fn, 1, self.axis_name)]
-        self.layer3 = [ResidualBlock(128, self.norm_fn, 2, self.axis_name),
-                       ResidualBlock(128, self.norm_fn, 1, self.axis_name)]
-        self.conv2 = nn.Conv(self.output_dim, (1, 1))
+        d = self.dtype
+        self.conv1 = nn.Conv(64, (7, 7), strides=2, padding=3, dtype=d)
+        self.norm1 = Norm(self.norm_fn, self.axis_name, d)
+        self.layer1 = [ResidualBlock(64, self.norm_fn, 1, self.axis_name, d),
+                       ResidualBlock(64, self.norm_fn, 1, self.axis_name, d)]
+        self.layer2 = [ResidualBlock(96, self.norm_fn, 2, self.axis_name, d),
+                       ResidualBlock(96, self.norm_fn, 1, self.axis_name, d)]
+        self.layer3 = [ResidualBlock(128, self.norm_fn, 2, self.axis_name, d),
+                       ResidualBlock(128, self.norm_fn, 1, self.axis_name, d)]
+        self.conv2 = nn.Conv(self.output_dim, (1, 1), dtype=d)
 
     def __call__(self, x, train: bool = False,
                  deterministic: bool = True):
@@ -155,17 +170,22 @@ class SmallEncoder(nn.Module):
     norm_fn: str = "batch"
     dropout: float = 0.0
     axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.conv1 = nn.Conv(32, (7, 7), strides=2, padding=3)
-        self.norm1 = Norm(self.norm_fn, self.axis_name)
-        self.layer1 = [BottleneckBlock(32, self.norm_fn, 1, self.axis_name),
-                       BottleneckBlock(32, self.norm_fn, 1, self.axis_name)]
-        self.layer2 = [BottleneckBlock(64, self.norm_fn, 2, self.axis_name),
-                       BottleneckBlock(64, self.norm_fn, 1, self.axis_name)]
-        self.layer3 = [BottleneckBlock(96, self.norm_fn, 2, self.axis_name),
-                       BottleneckBlock(96, self.norm_fn, 1, self.axis_name)]
-        self.conv2 = nn.Conv(self.output_dim, (1, 1))
+        d = self.dtype
+        self.conv1 = nn.Conv(32, (7, 7), strides=2, padding=3, dtype=d)
+        self.norm1 = Norm(self.norm_fn, self.axis_name, d)
+        self.layer1 = [
+            BottleneckBlock(32, self.norm_fn, 1, self.axis_name, d),
+            BottleneckBlock(32, self.norm_fn, 1, self.axis_name, d)]
+        self.layer2 = [
+            BottleneckBlock(64, self.norm_fn, 2, self.axis_name, d),
+            BottleneckBlock(64, self.norm_fn, 1, self.axis_name, d)]
+        self.layer3 = [
+            BottleneckBlock(96, self.norm_fn, 2, self.axis_name, d),
+            BottleneckBlock(96, self.norm_fn, 1, self.axis_name, d)]
+        self.conv2 = nn.Conv(self.output_dim, (1, 1), dtype=d)
 
     def __call__(self, x, train: bool = False,
                  deterministic: bool = True):

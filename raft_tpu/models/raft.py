@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.config import RAFTConfig
-from raft_tpu.models.corr import AlternateCorrBlock, CorrBlock
+from raft_tpu.models import corr
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
 from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
@@ -35,10 +35,12 @@ class _UpdateStep(nn.Module):
     config: RAFTConfig
 
     def setup(self):
+        dtype = (jnp.bfloat16 if self.config.mixed_precision
+                 else jnp.float32)
         if self.config.small:
-            self.update_block = SmallUpdateBlock(self.config.hdim)
+            self.update_block = SmallUpdateBlock(self.config.hdim, dtype)
         else:
-            self.update_block = BasicUpdateBlock(self.config.hdim)
+            self.update_block = BasicUpdateBlock(self.config.hdim, dtype)
 
     def __call__(self, carry, corr_state, inp, coords0):
         net, coords1 = carry
@@ -65,32 +67,19 @@ def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
     arguments.
     """
     if cfg.alternate_corr:
-        blk = AlternateCorrBlock(fmap1, fmap2, cfg.corr_levels, cfg.radius,
-                                 cfg.corr_scale)
-        return ("alt", (blk.fmap1, tuple(blk.pyramid2)))
-    blk = CorrBlock(fmap1, fmap2, cfg.corr_levels, cfg.radius, cfg.corr_scale)
-    return ("allpairs", (tuple(blk.pyramid), fmap1.shape))
+        return ("alt", (fmap1, corr.build_feature_pyramid(
+            fmap2, cfg.corr_levels)))
+    return ("allpairs", corr.build_corr_pyramid(
+        fmap1, fmap2, cfg.corr_levels, cfg.corr_scale))
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
     kind, payload = corr_state
     if kind == "alt":
         fmap1, pyramid2 = payload
-        blk = AlternateCorrBlock.__new__(AlternateCorrBlock)
-        blk.num_levels = cfg.corr_levels
-        blk.radius = cfg.radius
-        blk.scale = cfg.corr_scale
-        blk.backend = "auto"
-        blk.fmap1 = fmap1
-        blk.pyramid2 = list(pyramid2)
-        return blk(coords)
-    pyramid, shape = payload
-    blk = CorrBlock.__new__(CorrBlock)
-    blk.num_levels = cfg.corr_levels
-    blk.radius = cfg.radius
-    blk.shape = shape[:3]
-    blk.pyramid = list(pyramid)
-    return blk(coords)
+        return corr.alternate_lookup(fmap1, pyramid2, coords, cfg.radius,
+                                     cfg.corr_scale)
+    return corr.pyramid_lookup(payload, coords, cfg.radius)
 
 
 class RAFT(nn.Module):
@@ -106,13 +95,17 @@ class RAFT(nn.Module):
 
     def setup(self):
         cfg = self.config
+        dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
         if cfg.small:
-            self.fnet = SmallEncoder(128, "instance", cfg.dropout)
-            self.cnet = SmallEncoder(cfg.hdim + cfg.cdim, "none", cfg.dropout)
+            self.fnet = SmallEncoder(128, "instance", cfg.dropout,
+                                     dtype=dtype)
+            self.cnet = SmallEncoder(cfg.hdim + cfg.cdim, "none", cfg.dropout,
+                                     dtype=dtype)
         else:
-            self.fnet = BasicEncoder(cfg.fnet_dim, "instance", cfg.dropout)
+            self.fnet = BasicEncoder(cfg.fnet_dim, "instance", cfg.dropout,
+                                     dtype=dtype)
             self.cnet = BasicEncoder(cfg.hdim + cfg.cdim, "batch",
-                                     cfg.dropout)
+                                     cfg.dropout, dtype=dtype)
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
@@ -120,6 +113,12 @@ class RAFT(nn.Module):
                  train: bool = False):
         cfg = self.config
         iters = iters if iters is not None else cfg.iters
+        if cfg.normalized_coords:
+            # [0,1]-normalized grids serve the sparse-keypoint ("ours")
+            # family; RAFT's correlation lookup and upsampling are
+            # pixel-unit. Fail loudly rather than produce garbage.
+            raise ValueError("normalized_coords is not supported by the "
+                             "canonical RAFT path")
 
         dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
         image1 = 2.0 * (image1.astype(dtype) / 255.0) - 1.0

@@ -3,10 +3,13 @@
 Semantics follow reference ``core/update.py:6-136`` (FlowHead, ConvGRU,
 SepConvGRU, Small/BasicMotionEncoder, Small/BasicUpdateBlock), re-expressed
 in NHWC flax. Attribute names mirror the torch parameter names for the
-weight converter.
+weight converter. ``dtype`` is the compute dtype (bfloat16 under the
+mixed-precision policy); params stay float32.
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -16,10 +19,12 @@ class FlowHead(nn.Module):
     """3x3 conv → relu → 3x3 conv to 2 channels (core/update.py:6-14)."""
 
     hidden_dim: int = 256
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.conv1 = nn.Conv(self.hidden_dim, (3, 3), padding=1)
-        self.conv2 = nn.Conv(2, (3, 3), padding=1)
+        self.conv1 = nn.Conv(self.hidden_dim, (3, 3), padding=1,
+                             dtype=self.dtype)
+        self.conv2 = nn.Conv(2, (3, 3), padding=1, dtype=self.dtype)
 
     def __call__(self, x):
         return self.conv2(nn.relu(self.conv1(x)))
@@ -29,11 +34,15 @@ class ConvGRU(nn.Module):
     """3x3 convolutional GRU (core/update.py:16-31)."""
 
     hidden_dim: int = 128
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.convz = nn.Conv(self.hidden_dim, (3, 3), padding=1)
-        self.convr = nn.Conv(self.hidden_dim, (3, 3), padding=1)
-        self.convq = nn.Conv(self.hidden_dim, (3, 3), padding=1)
+        self.convz = nn.Conv(self.hidden_dim, (3, 3), padding=1,
+                             dtype=self.dtype)
+        self.convr = nn.Conv(self.hidden_dim, (3, 3), padding=1,
+                             dtype=self.dtype)
+        self.convq = nn.Conv(self.hidden_dim, (3, 3), padding=1,
+                             dtype=self.dtype)
 
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
@@ -48,14 +57,16 @@ class SepConvGRU(nn.Module):
     a horizontal GRU step followed by a vertical one."""
 
     hidden_dim: int = 128
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.convz1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
-        self.convr1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
-        self.convq1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2))
-        self.convz2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
-        self.convr2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
-        self.convq2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0))
+        d = self.dtype
+        self.convz1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2), dtype=d)
+        self.convr1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2), dtype=d)
+        self.convq1 = nn.Conv(self.hidden_dim, (1, 5), padding=(0, 2), dtype=d)
+        self.convz2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0), dtype=d)
+        self.convr2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0), dtype=d)
+        self.convq2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0), dtype=d)
 
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
@@ -75,13 +86,19 @@ class SmallMotionEncoder(nn.Module):
     """Correlation+flow → 82-channel motion features
     (core/update.py:62-76). ``corr_channels = levels * (2r+1)^2``."""
 
+    dtype: Any = jnp.float32
+
     @nn.compact
     def __call__(self, flow, corr):
-        cor = nn.relu(nn.Conv(96, (1, 1), name="convc1")(corr))
-        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, name="convf1")(flow))
-        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, name="convf2")(flo))
+        d = self.dtype
+        cor = nn.relu(nn.Conv(96, (1, 1), dtype=d, name="convc1")(corr))
+        flo = nn.relu(nn.Conv(64, (7, 7), padding=3, dtype=d,
+                              name="convf1")(flow))
+        flo = nn.relu(nn.Conv(32, (3, 3), padding=1, dtype=d,
+                              name="convf2")(flo))
         out = jnp.concatenate([cor, flo], axis=-1)
-        out = nn.relu(nn.Conv(80, (3, 3), padding=1, name="conv")(out))
+        out = nn.relu(nn.Conv(80, (3, 3), padding=1, dtype=d,
+                              name="conv")(out))
         return jnp.concatenate([out, flow], axis=-1)
 
 
@@ -89,14 +106,21 @@ class BasicMotionEncoder(nn.Module):
     """Correlation+flow → 128-channel motion features
     (core/update.py:79-97)."""
 
+    dtype: Any = jnp.float32
+
     @nn.compact
     def __call__(self, flow, corr):
-        cor = nn.relu(nn.Conv(256, (1, 1), name="convc1")(corr))
-        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, name="convc2")(cor))
-        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, name="convf1")(flow))
-        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, name="convf2")(flo))
+        d = self.dtype
+        cor = nn.relu(nn.Conv(256, (1, 1), dtype=d, name="convc1")(corr))
+        cor = nn.relu(nn.Conv(192, (3, 3), padding=1, dtype=d,
+                              name="convc2")(cor))
+        flo = nn.relu(nn.Conv(128, (7, 7), padding=3, dtype=d,
+                              name="convf1")(flow))
+        flo = nn.relu(nn.Conv(64, (3, 3), padding=1, dtype=d,
+                              name="convf2")(flo))
         out = jnp.concatenate([cor, flo], axis=-1)
-        out = nn.relu(nn.Conv(126, (3, 3), padding=1, name="conv")(out))
+        out = nn.relu(nn.Conv(126, (3, 3), padding=1, dtype=d,
+                              name="conv")(out))
         return jnp.concatenate([out, flow], axis=-1)
 
 
@@ -105,11 +129,12 @@ class SmallUpdateBlock(nn.Module):
     (core/update.py:99-112)."""
 
     hidden_dim: int = 96
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.encoder = SmallMotionEncoder()
-        self.gru = ConvGRU(self.hidden_dim)
-        self.flow_head = FlowHead(128)
+        self.encoder = SmallMotionEncoder(self.dtype)
+        self.gru = ConvGRU(self.hidden_dim, self.dtype)
+        self.flow_head = FlowHead(128, self.dtype)
 
     def __call__(self, net, inp, corr, flow):
         motion_features = self.encoder(flow, corr)
@@ -124,13 +149,14 @@ class BasicUpdateBlock(nn.Module):
     scaled by 0.25 (core/update.py:114-136)."""
 
     hidden_dim: int = 128
+    dtype: Any = jnp.float32
 
     def setup(self):
-        self.encoder = BasicMotionEncoder()
-        self.gru = SepConvGRU(self.hidden_dim)
-        self.flow_head = FlowHead(256)
-        self.mask_conv1 = nn.Conv(256, (3, 3), padding=1)
-        self.mask_conv2 = nn.Conv(64 * 9, (1, 1))
+        self.encoder = BasicMotionEncoder(self.dtype)
+        self.gru = SepConvGRU(self.hidden_dim, self.dtype)
+        self.flow_head = FlowHead(256, self.dtype)
+        self.mask_conv1 = nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)
+        self.mask_conv2 = nn.Conv(64 * 9, (1, 1), dtype=self.dtype)
 
     def __call__(self, net, inp, corr, flow):
         motion_features = self.encoder(flow, corr)

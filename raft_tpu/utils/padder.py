@@ -2,9 +2,11 @@
 
 Reference semantics: ``core/utils/utils.py:7-24`` — replicate-pad to the next
 multiple of 8; 'sintel' mode centers vertically, every other mode (kitti)
-pads only at the top. On TPU static shapes matter, so the padder is a
-host-side helper: pick a resolution bucket once, pad numpy arrays before
-``device_put``, and crop after.
+puts all vertical padding at the bottom (torch ``F.pad`` order is
+left/right/top/bottom and the reference passes ``[l, r, 0, pad_ht]``). On
+TPU static shapes matter, so the padder is a host-side helper: pick a
+resolution bucket once, pad numpy arrays before ``device_put``, and crop
+after.
 """
 
 from __future__ import annotations
@@ -22,8 +24,8 @@ class InputPadder:
         if mode == "sintel":
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
                          pad_ht // 2, pad_ht - pad_ht // 2]
-        else:  # kitti: all vertical padding on top
-            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, pad_ht, 0]
+        else:  # kitti: all vertical padding at the bottom
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
 
     @property
     def padded_shape(self):

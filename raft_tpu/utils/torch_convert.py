@@ -1,0 +1,111 @@
+"""Torch → JAX checkpoint conversion for canonical RAFT weights.
+
+Lets published reference checkpoints (``download_models.sh``: raft-things,
+raft-sintel, raft-kitti, raft-chairs, raft-small) run in this framework.
+Handles the conversion traps called out in the rebuild plan: DataParallel
+``module.`` prefixes, OIHW→HWIO conv filters, torch norm naming
+(weight/bias/running_mean/running_var → scale/bias + batch_stats), list
+attributes (``layer1.0`` → ``layer1_0``), the mask-head ``nn.Sequential``
+indices, and the scanned update block's scope (``update_block.*`` →
+``update/update_block/*``).
+
+Works on anything dict-like mapping torch parameter names to numpy-able
+arrays — a ``torch.load(...)`` state dict or an ``np.load`` archive — so
+torch itself is not required at conversion time.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _to_numpy(v) -> np.ndarray:
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _set(tree: Dict[str, Any], path, leaf) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = leaf
+
+
+def _flax_path(name: str) -> Tuple[str, ...]:
+    """Torch dotted name → flax scope path (without the leaf)."""
+    name = re.sub(r"^module\.", "", name)
+    # Scanned update block lives under the 'update' scan scope.
+    name = re.sub(r"^update_block\.", "update.update_block.", name)
+    # Mask head Sequential indices → named convs.
+    name = re.sub(r"(^|\.)mask\.0\.", r"\1mask_conv1.", name)
+    name = re.sub(r"(^|\.)mask\.2\.", r"\1mask_conv2.", name)
+    # Torch wraps the residual shortcut as Sequential(conv, norm); the norm
+    # is also registered as norm3/norm4, so downsample.1.* is a duplicate
+    # (dropped in convert_state_dict) and downsample.0 is the conv.
+    name = re.sub(r"(^|\.)downsample\.0\.", r"\1downsample.", name)
+    # List attributes: layer1.0.conv1 → layer1_0.conv1
+    name = re.sub(r"\.(layer\d+)\.(\d+)\.", r".\1_\2.", name)
+    name = re.sub(r"^(layer\d+)\.(\d+)\.", r"\1_\2.", name)
+    return tuple(name.split("."))
+
+
+def convert_state_dict(state: Mapping[str, Any]):
+    """Convert a torch RAFT state dict into flax ``{'params', 'batch_stats'}``.
+
+    Returns variables loadable by ``raft_tpu.models.RAFT.apply``.
+    """
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+
+    for name, value in state.items():
+        if re.search(r"(^|\.)downsample\.1\.", name):
+            continue  # duplicate registration of norm3/norm4 (see _flax_path)
+        v = _to_numpy(value)
+        path = _flax_path(name)
+        scope, leaf = path[:-1], path[-1]
+        # Norm layers are the only 1-D 'weight's, and their scopes are the
+        # only ones named 'norm*' in canonical RAFT.
+        is_norm_scope = bool(scope) and scope[-1].startswith("norm")
+
+        if leaf == "running_mean":
+            _set(batch_stats, scope + ("n", "mean"), v)
+            continue
+        if leaf == "running_var":
+            _set(batch_stats, scope + ("n", "var"), v)
+            continue
+        if leaf == "num_batches_tracked":
+            continue
+
+        if v.ndim == 4 and leaf == "weight":          # conv OIHW → HWIO
+            _set(params, scope + ("kernel",), v.transpose(2, 3, 1, 0))
+        elif v.ndim == 2 and leaf == "weight":        # linear (out,in)→(in,out)
+            _set(params, scope + ("kernel",), v.transpose(1, 0))
+        elif v.ndim == 1 and leaf == "weight":        # norm scale
+            _set(params, scope + ("n", "scale"), v)
+        elif leaf == "bias" and is_norm_scope:
+            _set(params, scope + ("n", "bias"), v)
+        elif leaf == "bias":
+            _set(params, scope + ("bias",), v)
+        else:
+            raise ValueError(f"unhandled torch key {name} with shape {v.shape}")
+
+    out = {"params": params}
+    if batch_stats:
+        out["batch_stats"] = batch_stats
+    return out
+
+
+def load_torch_checkpoint(path: str):
+    """Load a reference ``.pth`` checkpoint and convert it.
+
+    Uses torch only for deserialization (CPU map).
+    """
+    import torch
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "model" in state:
+        state = state["model"]
+    return convert_state_dict(state)

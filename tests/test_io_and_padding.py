@@ -1,6 +1,7 @@
 """Flow I/O round-trips, padder geometry, warm-start, and viz sanity."""
 
 import numpy as np
+import pytest
 
 from raft_tpu.data import frame_utils
 from raft_tpu.utils import InputPadder, forward_interpolate
@@ -24,6 +25,7 @@ def test_pfm_roundtrip(tmp_path, rng):
 
 
 def test_kitti_png_roundtrip(tmp_path, rng):
+    pytest.importorskip("cv2")
     flow = (rng.standard_normal((6, 8, 2)) * 10).astype(np.float32)
     # KITTI encoding quantizes to 1/64 px.
     flow = np.round(flow * 64) / 64
@@ -43,12 +45,12 @@ def test_padder_sintel_center():
     assert p.unpad(y).shape == x.shape
 
 
-def test_padder_kitti_top():
+def test_padder_kitti_bottom():
     p = InputPadder((1, 375, 1242, 3), mode="kitti")
     y = p.pad(np.ones((1, 375, 1242, 3), np.float32))
     assert y.shape == (1, 376, 1248, 3)
-    # top padding: original content sits at the bottom rows
-    assert p._pad[3] == 0 and p._pad[2] == 1
+    # reference F.pad([l, r, 0, pad_ht]): vertical padding at the bottom
+    assert p._pad[2] == 0 and p._pad[3] == 1
 
 
 def test_padder_noop_when_divisible():
