@@ -1,0 +1,62 @@
+"""Headline benchmark: Sintel image-pairs/sec/chip @ iters=12.
+
+Runs the flagship canonical RAFT-large forward (test_mode, all-pairs
+correlation) at Sintel resolution (436x1024 padded to 440x1024, the
+``InputPadder`` pad-to-/8 shape) on the available accelerator and prints ONE
+JSON line. ``vs_baseline`` is measured against the BASELINE.md north-star
+denominator: the PyTorch reference on 1xV100 at the same setting, estimated
+at 10 image-pairs/sec (RAFT paper reports ~10 fps at 1088x436 / 12 iters on
+a 1080Ti-class GPU; BASELINE.md records no in-repo number, so the target
+"≥4x vs V100" is normalized to this documented estimate).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
+H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
+ITERS = 12
+WARMUP = 2
+REPS = 10
+
+
+def main():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    # TPU-first inference policy: bf16 encoders/update, f32 corr volume.
+    platform = jax.devices()[0].platform
+    cfg = RAFTConfig(iters=ITERS, mixed_precision=(platform == "tpu"))
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img, img,
+                           iters=1)
+
+    @jax.jit
+    def fwd(i1, i2):
+        return model.apply(variables, i1, i2, test_mode=True)[1]
+
+    for _ in range(WARMUP):
+        fwd(img, img).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        fwd(img, img).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = REPS / dt
+    print(json.dumps({
+        "metric": "sintel_image_pairs_per_sec_per_chip_iters12",
+        "value": round(pairs_per_sec, 3),
+        "unit": "image-pairs/sec",
+        "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

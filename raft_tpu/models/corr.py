@@ -23,9 +23,6 @@ that exactly; converted torch weights then consume identical channel order.
 
 from __future__ import annotations
 
-from typing import List, Tuple
-
-import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.sampling import avg_pool2x2, bilinear_sampler
@@ -94,7 +91,6 @@ class CorrBlock:
 
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True):
-        self.num_levels = num_levels
         self.radius = radius
         self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels, scale)
 
@@ -177,7 +173,6 @@ class AlternateCorrBlock:
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True,
                  backend: str = "auto"):
-        self.num_levels = num_levels
         self.radius = radius
         self.scale = scale
         self.backend = backend

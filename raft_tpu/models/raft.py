@@ -110,8 +110,12 @@ class RAFT(nn.Module):
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
                  flow_init=None, test_mode: bool = False,
-                 train: bool = False):
+                 train: bool = False, freeze_bn: bool = False):
+        """``freeze_bn`` keeps BatchNorm in eval (running-average) mode
+        while the rest trains — the reference's post-chairs freeze
+        (``core/raft.py:60-63``, ``train.py:414-415``)."""
         cfg = self.config
+        norm_train = train and not freeze_bn
         iters = iters if iters is not None else cfg.iters
         if cfg.normalized_coords:
             # [0,1]-normalized grids serve the sparse-keypoint ("ours")
@@ -127,18 +131,19 @@ class RAFT(nn.Module):
         # Twin-image trick: one fnet pass over both images concatenated on
         # the batch axis (reference extractor_origin.py:168-171).
         fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
-                          train=train, deterministic=not train)
+                          train=norm_train, deterministic=not train)
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
 
         corr_state = _build_corr_state(cfg, fmap1, fmap2)
 
-        cnet_out = self.cnet(image1, train=train, deterministic=not train)
+        cnet_out = self.cnet(image1, train=norm_train,
+                             deterministic=not train)
         net, inp = jnp.split(cnet_out, [cfg.hdim], axis=-1)
         net = jnp.tanh(net)
         inp = nn.relu(inp)
 
         B, H8, W8, _ = fmap1.shape
-        coords0 = coords_grid(B, H8, W8, normalized=cfg.normalized_coords)
+        coords0 = coords_grid(B, H8, W8)
         coords1 = coords0
         if flow_init is not None:
             coords1 = coords1 + flow_init

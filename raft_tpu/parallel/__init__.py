@@ -1,0 +1,29 @@
+"""Parallelism: device meshes, sharded train steps, collectives.
+
+The reference's parallelism surface (SURVEY.md §2.7) maps here:
+
+* ``nn.DataParallel`` (reference ``train.py:342``) → a 1-D ``data`` mesh
+  axis; the train step is ``jit``-ed with batch inputs sharded over it and
+  parameters replicated, so XLA inserts the gradient all-reduce over ICI.
+* The dormant NCCL/DDP scaffolding (reference ``core/utils/misc.py:366-460``)
+  → :mod:`raft_tpu.parallel.distributed` — ``jax.distributed.initialize``
+  plus process-rank helpers; collectives are compiler-scheduled, there is no
+  process-group bootstrap to write.
+* The CUDA-grid intra-op parallelism of the native kernels → Pallas grids
+  (:mod:`raft_tpu.ops.corr_pallas`).
+* Long-context analogue: the quadratic all-pairs correlation volume can be
+  sharded over query pixels (``spatial`` mesh axis) — the sequence-parallel /
+  ring-attention pattern applied to the (HW)² volume
+  (:mod:`raft_tpu.parallel.ring_corr`).
+"""
+
+from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS, make_mesh,
+                                    replicate, shard_batch)
+from raft_tpu.parallel.train_step import (RAFTTrainState, create_train_state,
+                                          make_eval_step, make_train_step)
+
+__all__ = [
+    "DATA_AXIS", "SPATIAL_AXIS", "make_mesh", "shard_batch", "replicate",
+    "RAFTTrainState", "create_train_state", "make_train_step",
+    "make_eval_step",
+]

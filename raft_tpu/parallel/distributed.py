@@ -1,0 +1,76 @@
+"""Multi-host bootstrap and cross-process helpers.
+
+TPU-native replacement for the reference's dormant NCCL/DDP scaffolding
+(``core/utils/misc.py:366-460``): on TPU pods, ``jax.distributed.initialize``
+wires up all hosts; collectives are compiled into the sharded program (ICI
+within a slice, DCN across slices), so there is no process group, backend
+choice, or pickle-based ``all_gather`` to reimplement. What remains useful —
+rank discovery, master-only side effects, cross-host metric reduction — is
+provided here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX (reference ``init_distributed_mode``,
+    ``core/utils/misc.py:422-460``).
+
+    On TPU pods all arguments are auto-detected from the metadata server;
+    explicit args cover the env-var path (``COORDINATOR_ADDRESS`` etc.) the
+    way the reference read ``RANK``/``WORLD_SIZE``. Safe to call on a
+    single host (no-op).
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None and "JAX_COORDINATOR" not in env:
+        # Single-process run (the common case on one chip / CPU tests).
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
+def is_main_process() -> bool:
+    """Reference ``is_main_process`` (``core/utils/misc.py:410-412``)."""
+    return jax.process_index() == 0
+
+
+def save_on_master(save_fn, *args, **kwargs):
+    """Run a side-effecting save only on rank 0
+    (reference ``core/utils/misc.py:417-419``)."""
+    if is_main_process():
+        save_fn(*args, **kwargs)
+
+
+def reduce_metrics(metrics: Dict[str, jax.Array],
+                   average: bool = True) -> Dict[str, float]:
+    """Cross-host mean of already-device-reduced scalars
+    (reference ``reduce_dict``, ``core/utils/misc.py:166-190``).
+
+    Under jit-with-sharding the per-step metrics are already global over the
+    mesh; this helper exists for host-side aggregation of *python* scalars
+    across processes (e.g. validation loops that iterate different shards of
+    a dataset per host).
+    """
+    if jax.process_count() == 1:
+        return {k: float(v) for k, v in metrics.items()}
+    from jax.experimental import multihost_utils
+
+    keys = sorted(metrics.keys())
+    vec = np.asarray([float(metrics[k]) for k in keys], np.float32)
+    summed = multihost_utils.process_allgather(vec).sum(axis=0)
+    if average:
+        summed = summed / jax.process_count()
+    return {k: float(summed[i]) for i, k in enumerate(keys)}

@@ -1,0 +1,61 @@
+"""Device-mesh construction and sharding helpers.
+
+One logical mesh with two axes:
+
+* ``data`` — data parallelism (the reference's only active strategy,
+  ``nn.DataParallel`` at ``train.py:342``); batch dim sharded, params
+  replicated, gradient all-reduce inserted by XLA over ICI.
+* ``spatial`` — optional sharding of the spatial/query axis of the
+  correlation volume for high-resolution inputs (the sequence-parallel
+  analogue; SURVEY.md §5 "long-context equivalent").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(n_data: Optional[int] = None, n_spatial: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(data, spatial)`` mesh.
+
+    Defaults to all visible devices on the data axis — the BASELINE.json
+    data-parallel config ("v5e-8 pmap" equivalent). Device order follows
+    ``jax.devices()`` so the data axis rides ICI within a slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        if len(devices) % n_spatial:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by n_spatial={n_spatial}")
+        n_data = len(devices) // n_spatial
+    arr = np.asarray(devices[: n_data * n_spatial]).reshape(
+        n_data, n_spatial)
+    return Mesh(arr, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_spec() -> P:
+    """PartitionSpec for batch-leading arrays: shard dim 0 over data."""
+    return P(DATA_AXIS)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Device_put a host batch (pytree of arrays with leading batch dim)
+    with the batch dim sharded over the ``data`` axis."""
+    sharding = NamedSharding(mesh, batch_spec())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree (params / opt state) over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree)

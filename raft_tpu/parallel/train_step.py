@@ -1,0 +1,151 @@
+"""The jitted, mesh-sharded train/eval steps.
+
+The whole reference hot loop body (``train.py:368-421``: forward, sequence
+loss, backward, clip, optimizer step, scheduler step, metric computation)
+compiles into ONE XLA program per device. Batch inputs arrive sharded over
+the ``data`` mesh axis, parameters are replicated; XLA inserts the gradient
+all-reduce (the TPU equivalent of ``nn.DataParallel``'s gather +
+``loss.backward()`` sync).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.losses import sequence_loss
+
+
+class RAFTTrainState(struct.PyTreeNode):
+    """Carried training state: step, params, BN running stats, opt state.
+
+    Unlike the reference (which checkpoints only ``model.state_dict()``,
+    ``train.py:398-400``), the full state is checkpointable so training
+    truly resumes (SURVEY.md §5 checkpoint/resume gap).
+    """
+
+    step: jnp.ndarray
+    params: core.FrozenDict
+    batch_stats: core.FrozenDict
+    opt_state: optax.OptState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)
+
+
+def create_train_state(rng, model, tcfg: TrainConfig,
+                       image_shape: Tuple[int, int],
+                       tx: Optional[optax.GradientTransformation] = None,
+                       mesh: Optional[Mesh] = None) -> RAFTTrainState:
+    """Initialize params + opt state (replicated over ``mesh`` if given)."""
+    from raft_tpu.optim import fetch_optimizer
+
+    H, W = image_shape
+    dummy = jnp.zeros((1, H, W, 3), jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng},
+                           dummy, dummy, iters=1)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", core.FrozenDict({}))
+    tx = tx if tx is not None else fetch_optimizer(tcfg)
+    state = RAFTTrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        batch_stats=batch_stats, opt_state=tx.init(params),
+        apply_fn=model.apply, tx=tx)
+    if mesh is not None:
+        from raft_tpu.parallel.mesh import replicate
+        state = replicate(state, mesh)
+    return state
+
+
+def _maybe_add_noise(rng, image1, image2):
+    """Per-batch gaussian noise aug (reference ``train.py:373-376``):
+    stdv ~ U(0, 5), images perturbed then clamped to [0, 255]."""
+    k0, k1, k2 = jax.random.split(rng, 3)
+    stdv = jax.random.uniform(k0, (), minval=0.0, maxval=5.0)
+    image1 = jnp.clip(
+        image1 + stdv * jax.random.normal(k1, image1.shape), 0.0, 255.0)
+    image2 = jnp.clip(
+        image2 + stdv * jax.random.normal(k2, image2.shape), 0.0, 255.0)
+    return image1, image2
+
+
+def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
+                    mesh: Optional[Mesh] = None,
+                    donate: bool = True) -> Callable:
+    """Build the jitted train step.
+
+    ``freeze_bn`` mirrors the reference's post-chairs BN freeze
+    (``train.py:414-415`` / ``core/raft.py:60-63``).
+
+    Returns ``step_fn(state, batch, rng) -> (state, metrics)`` where
+    ``batch`` is a dict with ``image1/image2`` (B,H,W,3) float [0,255],
+    ``flow`` (B,H,W,2), ``valid`` (B,H,W).
+    """
+
+    def step_fn(state: RAFTTrainState, batch: Dict[str, jnp.ndarray], rng):
+        noise_rng, dropout_rng = jax.random.split(
+            jax.random.fold_in(rng, state.step))
+        image1, image2 = batch["image1"], batch["image2"]
+        if tcfg.add_noise:
+            image1, image2 = _maybe_add_noise(noise_rng, image1, image2)
+
+        def loss_fn(params):
+            out, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                image1, image2, iters=tcfg.iters, train=True,
+                freeze_bn=freeze_bn,
+                rngs={"dropout": dropout_rng},
+                mutable=["batch_stats"])
+            loss, metrics = sequence_loss(
+                out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
+            # Under freeze_bn (or a BN-free model) nothing is written to
+            # the batch_stats collection; keep the existing stats then.
+            new_bs = mutated.get("batch_stats")
+            if not new_bs:
+                new_bs = state.batch_stats
+            return loss, (metrics, new_bs)
+
+        grads, (metrics, new_bs) = jax.grad(
+            loss_fn, has_aux=True)(state.params)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        return new_state, metrics
+
+    if mesh is not None:
+        batch_shard = NamedSharding(mesh, P("data"))
+        repl = NamedSharding(mesh, P())
+        batch_spec = {k: batch_shard
+                      for k in ("image1", "image2", "flow", "valid")}
+        return jax.jit(
+            step_fn,
+            in_shardings=(None, batch_spec, repl),
+            donate_argnums=(0,) if donate else ())
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(iters: int = 32) -> Callable:
+    """Jitted inference step: ``(state, image1, image2) -> (flow_low,
+    flow_up)`` (the reference ``test_mode`` interface,
+    ``core/raft.py:142-143``)."""
+
+    @functools.partial(jax.jit, static_argnums=())
+    def eval_fn(state: RAFTTrainState, image1, image2, flow_init=None):
+        return state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            image1, image2, iters=iters, flow_init=flow_init,
+            test_mode=True)
+
+    return eval_fn
