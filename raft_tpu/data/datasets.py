@@ -1,0 +1,373 @@
+"""Flow datasets and the training input pipeline.
+
+Host-side (numpy) counterpart of reference ``core/datasets.py``: a
+``FlowDataset`` base with dense/sparse read paths, the five dataset classes
+(MpiSintel, FlyingChairs, FlyingThings3D, KITTI, HD1K), dataset replication
+for mixture weighting (``__rmul__``, reference ``:99-102``), and
+``fetch_dataloader`` with the per-stage augmentation parameters and mixture
+weights (reference ``:205-240``).
+
+Batches are NHWC numpy dicts (``image1/image2`` float32 [0,255], ``flow``,
+``valid``) — the TPU-facing layout; ``device_put`` / ``shard_batch`` happens
+in the train loop. Batching is done by a thread-pool prefetcher
+(:class:`DataLoader`) instead of torch's fork-based workers.
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+import random
+from glob import glob
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.data import frame_utils
+from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+
+
+class FlowDataset:
+    """Base dataset (reference ``core/datasets.py:23-105``).
+
+    ``__getitem__`` returns NHWC float32 numpy:
+      training: ``(img1, img2, flow, valid)``;
+      test mode: ``(img1, img2, extra_info)``.
+    """
+
+    def __init__(self, aug_params=None, sparse: bool = False,
+                 seed: Optional[int] = None):
+        self.augmentor = None
+        self.sparse = sparse
+        if aug_params is not None:
+            cls = SparseFlowAugmentor if sparse else FlowAugmentor
+            self.augmentor = cls(seed=seed, **aug_params)
+        self.is_test = False
+        self.init_seed = seed is not None
+        self.flow_list: List[str] = []
+        self.image_list: List[Tuple[str, str]] = []
+        self.extra_info: List = []
+
+    def __getitem__(self, index):
+        if self.is_test:
+            img1 = frame_utils.read_gen(self.image_list[index][0])
+            img2 = frame_utils.read_gen(self.image_list[index][1])
+            img1 = np.asarray(img1).astype(np.float32)[..., :3]
+            img2 = np.asarray(img2).astype(np.float32)[..., :3]
+            return img1, img2, self.extra_info[index]
+
+        index = index % len(self.image_list)
+        valid = None
+        if self.sparse:
+            flow, valid = frame_utils.read_flow_kitti(self.flow_list[index])
+        else:
+            flow = frame_utils.read_gen(self.flow_list[index])
+
+        img1 = np.asarray(frame_utils.read_gen(self.image_list[index][0]))
+        img2 = np.asarray(frame_utils.read_gen(self.image_list[index][1]))
+        flow = np.asarray(flow).astype(np.float32)
+
+        # grayscale → 3 channels (reference :75-77)
+        if img1.ndim == 2:
+            img1 = np.tile(img1[..., None], (1, 1, 3))
+            img2 = np.tile(img2[..., None], (1, 1, 3))
+        else:
+            img1 = img1[..., :3]
+            img2 = img2[..., :3]
+        img1 = img1.astype(np.float32)
+        img2 = img2.astype(np.float32)
+
+        if self.augmentor is not None:
+            if self.sparse:
+                img1, img2, flow, valid = self.augmentor(
+                    img1, img2, flow, valid)
+            else:
+                img1, img2, flow = self.augmentor(img1, img2, flow)
+
+        if valid is None:
+            valid = ((np.abs(flow[..., 0]) < 1000)
+                     & (np.abs(flow[..., 1]) < 1000))   # reference :94-97
+        return (img1, img2, flow, valid.astype(np.float32))
+
+    def __rmul__(self, v: int) -> "FlowDataset":
+        """Replicate for mixture weighting (reference ``:99-102``)."""
+        import copy
+
+        out = copy.copy(self)
+        out.flow_list = v * self.flow_list
+        out.image_list = v * self.image_list
+        out.extra_info = v * self.extra_info
+        return out
+
+    def __add__(self, other: "FlowDataset") -> "FlowDataset":
+        return _ConcatDataset([self, other])
+
+    def __len__(self):
+        return len(self.image_list)
+
+
+class _ConcatDataset(FlowDataset):
+    """Concatenation preserving each source's read path/augmentor
+    (torch ``ConcatDataset`` equivalent)."""
+
+    def __init__(self, parts: Sequence[FlowDataset]):
+        super().__init__()
+        self.parts = []
+        for p in parts:
+            if isinstance(p, _ConcatDataset):
+                self.parts.extend(p.parts)
+            else:
+                self.parts.append(p)
+
+    def __len__(self):
+        return sum(len(p) for p in self.parts)
+
+    def __getitem__(self, index):
+        for p in self.parts:
+            if index < len(p):
+                return p[index]
+            index -= len(p)
+        raise IndexError(index)
+
+    def __add__(self, other):
+        return _ConcatDataset(self.parts + [other])
+
+    def __rmul__(self, v):
+        return _ConcatDataset(v * list(self.parts))
+
+
+class MpiSintel(FlowDataset):
+    """reference ``core/datasets.py:108-124``."""
+
+    def __init__(self, aug_params=None, split="training", root=None,
+                 dstype="clean", seed=None):
+        super().__init__(aug_params, seed=seed)
+        root = root or os.environ.get("RAFT_DATASETS",
+                                      "datasets") + "/Sintel"
+        flow_root = osp.join(root, split, "flow")
+        image_root = osp.join(root, split, dstype)
+        if split == "test":
+            self.is_test = True
+        for scene in sorted(os.listdir(image_root)) if osp.isdir(
+                image_root) else []:
+            image_list = sorted(glob(osp.join(image_root, scene, "*.png")))
+            for i in range(len(image_list) - 1):
+                self.image_list.append((image_list[i], image_list[i + 1]))
+                self.extra_info.append((scene, i))
+            if split != "test":
+                self.flow_list.extend(sorted(
+                    glob(osp.join(flow_root, scene, "*.flo"))))
+
+
+class FlyingChairs(FlowDataset):
+    """reference ``core/datasets.py:127-140``; split from chairs_split.txt."""
+
+    def __init__(self, aug_params=None, split="training", root=None,
+                 split_file=None, seed=None):
+        super().__init__(aug_params, seed=seed)
+        root = root or os.environ.get("RAFT_DATASETS",
+                                      "datasets") + "/FlyingChairs_release"
+        images = sorted(glob(osp.join(root, "data", "*.ppm")))
+        flows = sorted(glob(osp.join(root, "data", "*.flo")))
+        assert len(images) // 2 == len(flows)
+
+        # The canonical train/val split (22,872 1/2 labels, reference
+        # ``chairs_split.txt`` consumed at ``core/datasets.py:135-140``),
+        # shipped as a compressed npz; a plain text file of labels is also
+        # accepted via ``split_file``.
+        if split_file is None:
+            split_file = osp.join(osp.dirname(__file__), "chairs_split.npz")
+        if split_file.endswith(".npz"):
+            split_list = np.load(split_file)["split"]
+        else:
+            split_list = np.loadtxt(split_file, dtype=np.int32)
+        for i in range(len(flows)):
+            xid = split_list[i]
+            if (split == "training" and xid == 1) or \
+               (split == "validation" and xid == 2):
+                self.flow_list.append(flows[i])
+                self.image_list.append((images[2 * i], images[2 * i + 1]))
+
+
+class FlyingThings3D(FlowDataset):
+    """reference ``core/datasets.py:143-164``: left camera, both time
+    directions."""
+
+    def __init__(self, aug_params=None, root=None, dstype="frames_cleanpass",
+                 seed=None):
+        super().__init__(aug_params, seed=seed)
+        root = root or os.environ.get("RAFT_DATASETS",
+                                      "datasets") + "/FlyingThings3D"
+        for cam in ["left"]:
+            for direction in ["into_future", "into_past"]:
+                image_dirs = sorted(glob(osp.join(root, dstype, "TRAIN/*/*")))
+                image_dirs = sorted([osp.join(f, cam) for f in image_dirs])
+                flow_dirs = sorted(glob(osp.join(
+                    root, "optical_flow/TRAIN/*/*")))
+                flow_dirs = sorted([osp.join(f, direction, cam)
+                                    for f in flow_dirs])
+                for idir, fdir in zip(image_dirs, flow_dirs):
+                    images = sorted(glob(osp.join(idir, "*.png")))
+                    flows = sorted(glob(osp.join(fdir, "*.pfm")))
+                    for i in range(len(flows) - 1):
+                        if direction == "into_future":
+                            self.image_list.append(
+                                (images[i], images[i + 1]))
+                            self.flow_list.append(flows[i])
+                        else:
+                            self.image_list.append(
+                                (images[i + 1], images[i]))
+                            self.flow_list.append(flows[i + 1])
+
+
+class KITTI(FlowDataset):
+    """reference ``core/datasets.py:167-183`` (sparse)."""
+
+    def __init__(self, aug_params=None, split="training", root=None,
+                 seed=None):
+        super().__init__(aug_params, sparse=True, seed=seed)
+        root = root or os.environ.get("RAFT_DATASETS",
+                                      "datasets") + "/KITTI"
+        if split == "testing":
+            self.is_test = True
+        root = osp.join(root, split)
+        images1 = sorted(glob(osp.join(root, "image_2/*_10.png")))
+        images2 = sorted(glob(osp.join(root, "image_2/*_11.png")))
+        for img1, img2 in zip(images1, images2):
+            frame_id = img1.split("/")[-1]
+            self.extra_info.append([frame_id])
+            self.image_list.append((img1, img2))
+        if split == "training":
+            self.flow_list = sorted(glob(osp.join(root, "flow_occ/*_10.png")))
+
+
+class HD1K(FlowDataset):
+    """reference ``core/datasets.py:186-202`` (sparse)."""
+
+    def __init__(self, aug_params=None, root=None, seed=None):
+        super().__init__(aug_params, sparse=True, seed=seed)
+        root = root or os.environ.get("RAFT_DATASETS",
+                                      "datasets") + "/HD1k"
+        seq_ix = 0
+        while True:
+            flows = sorted(glob(osp.join(
+                root, "hd1k_flow_gt",
+                "flow_occ/%06d_*.png" % seq_ix)))
+            images = sorted(glob(osp.join(
+                root, "hd1k_input", "image_2/%06d_*.png" % seq_ix)))
+            if len(flows) == 0:
+                break
+            for i in range(len(flows) - 1):
+                self.flow_list.append(flows[i])
+                self.image_list.append((images[i], images[i + 1]))
+            seq_ix += 1
+
+
+class DataLoader:
+    """Thread-pool prefetching batch loader.
+
+    Replaces torch ``DataLoader(num_workers=24, pin_memory, drop_last)``
+    (reference ``core/datasets.py:236-237``): worker threads read+augment
+    samples ahead of the train loop; batches are stacked NHWC numpy dicts.
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 num_workers: int = 4, drop_last: bool = True,
+                 seed: int = 0, prefetch: int = 2):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = max(num_workers, 1)
+        self.drop_last = drop_last
+        self.seed = seed
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+    def _batches(self, order):
+        bs = self.batch_size
+        stop = len(order) - (len(order) % bs if self.drop_last else 0)
+        for i in range(0, stop, bs):
+            yield order[i:i + bs]
+
+    def __iter__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        rng = np.random.default_rng(self.seed + self.epoch)
+        self.epoch += 1
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng.shuffle(order)
+
+        def load(idx):
+            img1, img2, flow, valid = self.dataset[int(idx)]
+            return img1, img2, flow, valid
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            pending = []
+            batches = list(self._batches(order))
+            k = 0
+            # Keep `prefetch` batches in flight.
+            while k < len(batches) or pending:
+                while k < len(batches) and len(pending) < self.prefetch:
+                    pending.append([pool.submit(load, i)
+                                    for i in batches[k]])
+                    k += 1
+                futs = pending.pop(0)
+                samples = [f.result() for f in futs]
+                yield {
+                    "image1": np.stack([s[0] for s in samples]),
+                    "image2": np.stack([s[1] for s in samples]),
+                    "flow": np.stack([s[2] for s in samples]),
+                    "valid": np.stack([s[3] for s in samples]),
+                }
+
+
+def fetch_dataloader(stage: str, batch_size: int,
+                     image_size: Tuple[int, int],
+                     num_workers: int = 4, seed: int = 0,
+                     root: Optional[str] = None,
+                     full_mix: bool = True) -> DataLoader:
+    """Stage-specific dataset mixtures (reference
+    ``core/datasets.py:205-240``)."""
+    crop = {"crop_size": image_size}
+    if stage == "chairs":
+        aug = dict(crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
+        train_dataset = FlyingChairs(aug, split="training", root=root and
+                                     root + "/FlyingChairs_release",
+                                     seed=seed)
+    elif stage == "things":
+        aug = dict(crop, min_scale=-0.4, max_scale=0.8, do_flip=True)
+        clean = FlyingThings3D(aug, dstype="frames_cleanpass", seed=seed)
+        final = FlyingThings3D(aug, dstype="frames_finalpass", seed=seed)
+        train_dataset = clean + final
+    elif stage == "sintel":
+        aug = dict(crop, min_scale=-0.2, max_scale=0.6, do_flip=True)
+        things = FlyingThings3D(dict(aug, max_scale=0.8),
+                                dstype="frames_cleanpass", seed=seed)
+        sintel_clean = MpiSintel(aug, split="training", dstype="clean",
+                                 seed=seed)
+        sintel_final = MpiSintel(aug, split="training", dstype="final",
+                                 seed=seed)
+        if full_mix:  # the reference's C+T+K+S+H mixture (:218-230)
+            kitti = KITTI(dict(crop, min_scale=-0.3, max_scale=0.5,
+                               do_flip=True), seed=seed)
+            hd1k = HD1K(dict(crop, min_scale=-0.5, max_scale=0.2,
+                             do_flip=True), seed=seed)
+            train_dataset = (100 * sintel_clean + 100 * sintel_final
+                             + 200 * kitti + 5 * hd1k + things)
+        else:
+            train_dataset = (100 * sintel_clean + 100 * sintel_final
+                             + things)
+    elif stage == "kitti":
+        aug = dict(crop, min_scale=-0.2, max_scale=0.4, do_flip=False)
+        train_dataset = KITTI(aug, split="training", seed=seed)
+    else:
+        raise ValueError(f"unknown stage {stage!r}")
+
+    return DataLoader(train_dataset, batch_size=batch_size, shuffle=True,
+                      num_workers=num_workers, drop_last=True, seed=seed)

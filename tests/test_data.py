@@ -1,0 +1,175 @@
+"""Tests for the data layer: augmentors, datasets, loader."""
+
+import os
+import os.path as osp
+
+import numpy as np
+import pytest
+
+from raft_tpu.data import frame_utils
+from raft_tpu.data.augmentor import (ColorJitter, FlowAugmentor,
+                                     SparseFlowAugmentor)
+from raft_tpu.data.datasets import (DataLoader, FlowDataset, FlyingChairs,
+                                    MpiSintel, _ConcatDataset)
+
+
+class TestColorJitter:
+    def test_range_and_dtype(self, rng):
+        img = rng.uniform(0, 255, size=(40, 60, 3)).astype(np.float32)
+        out = ColorJitter()(img, np.random.default_rng(0))
+        assert out.dtype == np.float32
+        assert out.min() >= 0 and out.max() <= 255
+        assert out.shape == img.shape
+
+    def test_identity_ranges(self, rng):
+        img = rng.uniform(0, 255, size=(20, 30, 3)).astype(np.float32)
+        jit = ColorJitter(brightness=0, contrast=0, saturation=0, hue=0)
+        out = jit(img, np.random.default_rng(0))
+        # hue=0 path still round-trips through HSV uint8; allow 2/255 slop
+        np.testing.assert_allclose(out, img, atol=2.0)
+
+
+class TestFlowAugmentor:
+    def test_output_shapes(self, rng):
+        aug = FlowAugmentor(crop_size=(64, 96), seed=0)
+        img1 = rng.uniform(0, 255, (120, 160, 3)).astype(np.float32)
+        img2 = rng.uniform(0, 255, (120, 160, 3)).astype(np.float32)
+        flow = rng.normal(size=(120, 160, 2)).astype(np.float32)
+        for _ in range(5):
+            o1, o2, of = aug(img1.copy(), img2.copy(), flow.copy())
+            assert o1.shape == (64, 96, 3)
+            assert o2.shape == (64, 96, 3)
+            assert of.shape == (64, 96, 2)
+
+    def test_crop_fits_small_input(self, rng):
+        # Input barely larger than crop: scale floor must upscale.
+        aug = FlowAugmentor(crop_size=(64, 96), min_scale=-0.5,
+                            max_scale=-0.4, seed=0)
+        img = rng.uniform(0, 255, (70, 100, 3)).astype(np.float32)
+        flow = np.zeros((70, 100, 2), np.float32)
+        o1, _, of = aug(img.copy(), img.copy(), flow)
+        assert o1.shape == (64, 96, 3)
+
+    def test_hflip_negates_x(self):
+        aug = FlowAugmentor(crop_size=(32, 32), seed=0)
+        aug.spatial_aug_prob = 0.0
+        aug.v_flip_prob = 0.0
+        aug.h_flip_prob = 1.0
+        img = np.zeros((64, 64, 3), np.float32)
+        flow = np.ones((64, 64, 2), np.float32)
+        _, _, of = aug.spatial_transform(img, img, flow)
+        np.testing.assert_allclose(of[..., 0], -1.0)
+        np.testing.assert_allclose(of[..., 1], 1.0)
+
+
+class TestSparseFlowAugmentor:
+    def test_output_shapes(self, rng):
+        aug = SparseFlowAugmentor(crop_size=(64, 96), seed=0)
+        img1 = rng.uniform(0, 255, (120, 160, 3)).astype(np.float32)
+        img2 = rng.uniform(0, 255, (120, 160, 3)).astype(np.float32)
+        flow = rng.normal(size=(120, 160, 2)).astype(np.float32)
+        valid = (rng.uniform(size=(120, 160)) > 0.5).astype(np.float32)
+        o1, o2, of, ov = aug(img1, img2, flow, valid)
+        assert o1.shape == (64, 96, 3)
+        assert of.shape == (64, 96, 2)
+        assert ov.shape == (64, 96)
+        assert set(np.unique(ov)).issubset({0.0, 1.0})
+
+    def test_sparse_resize_preserves_vectors(self):
+        flow = np.zeros((10, 10, 2), np.float32)
+        valid = np.zeros((10, 10), np.float32)
+        flow[5, 5] = (3.0, -2.0)
+        valid[5, 5] = 1
+        f2, v2 = SparseFlowAugmentor.resize_sparse_flow_map(
+            flow, valid, fx=2.0, fy=2.0)
+        assert f2.shape == (20, 20, 2)
+        assert v2.sum() == 1
+        yy, xx = np.argwhere(v2 == 1)[0]
+        np.testing.assert_allclose(f2[yy, xx], [6.0, -4.0])
+
+
+def _write_synthetic_sintel(root, scenes=2, frames=3, H=64, W=96):
+    """Create a miniature on-disk Sintel-format dataset."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for scene in [f"scene_{i}" for i in range(scenes)]:
+        for sub in ("clean", "final"):
+            d = osp.join(root, "training", sub, scene)
+            os.makedirs(d, exist_ok=True)
+            for f in range(frames):
+                img = rng.integers(0, 255, (H, W, 3), dtype=np.uint8)
+                Image.fromarray(img).save(
+                    osp.join(d, f"frame_{f:04d}.png"))
+        d = osp.join(root, "training", "flow", scene)
+        os.makedirs(d, exist_ok=True)
+        for f in range(frames - 1):
+            flow = rng.normal(size=(H, W, 2)).astype(np.float32)
+            frame_utils.write_flo(
+                osp.join(d, f"frame_{f:04d}.flo"), flow)
+
+
+class TestDatasets:
+    def test_sintel_synthetic(self, tmp_path):
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root)
+        ds = MpiSintel(aug_params={"crop_size": (32, 48)}, root=root,
+                       dstype="clean", seed=0)
+        assert len(ds) == 4                      # 2 scenes x 2 pairs
+        img1, img2, flow, valid = ds[0]
+        assert img1.shape == (32, 48, 3)
+        assert flow.shape == (32, 48, 2)
+        assert valid.shape == (32, 48)
+
+    def test_no_augmentor_returns_full_frames(self, tmp_path):
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root)
+        ds = MpiSintel(root=root, dstype="clean")
+        img1, img2, flow, valid = ds[0]
+        assert img1.shape == (64, 96, 3)
+        assert valid.all()
+
+    def test_rmul_and_concat(self, tmp_path):
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root)
+        clean = MpiSintel(root=root, dstype="clean")
+        final = MpiSintel(root=root, dstype="final")
+        mix = 3 * clean + final
+        assert len(mix) == 3 * len(clean) + len(final)
+        assert isinstance(mix, _ConcatDataset)
+        # Indexing past the replicated part reaches `final`
+        _ = mix[len(mix) - 1]
+
+    def test_chairs_split_npz(self):
+        path = osp.join(osp.dirname(osp.dirname(__file__)),
+                        "raft_tpu", "data", "chairs_split.npz")
+        split = np.load(path)["split"]
+        assert split.shape == (22872,)
+        assert (split == 1).sum() == 22232       # training pairs
+        assert (split == 2).sum() == 640         # validation pairs
+
+
+class TestDataLoader:
+    def test_batches_and_drop_last(self, tmp_path):
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root, scenes=3, frames=4)   # 9 pairs
+        ds = MpiSintel(aug_params={"crop_size": (32, 48)}, root=root,
+                       dstype="clean", seed=0)
+        loader = DataLoader(ds, batch_size=4, num_workers=2, seed=0)
+        batches = list(loader)
+        assert len(batches) == 2                  # 9 // 4, drop_last
+        b = batches[0]
+        assert b["image1"].shape == (4, 32, 48, 3)
+        assert b["flow"].shape == (4, 32, 48, 2)
+        assert b["valid"].shape == (4, 32, 48)
+
+    def test_shuffle_differs_across_epochs(self, tmp_path):
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root, scenes=3, frames=4)
+        ds = MpiSintel(root=root, dstype="clean")
+        loader = DataLoader(ds, batch_size=2, num_workers=1, seed=0)
+        e1 = np.concatenate([b["image1"].sum(axis=(1, 2, 3))
+                             for b in loader])
+        e2 = np.concatenate([b["image1"].sum(axis=(1, 2, 3))
+                             for b in loader])
+        assert not np.allclose(e1, e2)
