@@ -23,6 +23,7 @@ that exactly; converted torch weights then consume identical channel order.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.sampling import avg_pool2x2, bilinear_sampler
@@ -142,15 +143,23 @@ def build_feature_pyramid(fmap2: jnp.ndarray, num_levels: int):
 
 
 def _resolve_window_fn(backend: str):
+    """Resolve the on-demand window implementation.
+
+    ``auto`` picks the Pallas kernel only on TPU — off-TPU the kernel would
+    run through the (slow) Pallas interpreter, so the vectorized jnp
+    reference is the right default there. Note the backends differ in one
+    gradient contract: the Pallas kernel treats coordinates as
+    non-differentiable (zero gradient — the reference extension's behavior,
+    ``alt_cuda_corr/correlation_kernel.cu:307``), while the jnp path
+    propagates bilinear-sampler coordinate gradients. RAFT stop-gradients
+    coords before lookup, so the model is backend-agnostic.
+    """
     if backend == "jnp":
         return windowed_correlation
-    try:
-        from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
-        return windowed_correlation_pallas
-    except Exception:
-        if backend == "pallas":
-            raise
+    if backend == "auto" and jax.default_backend() != "tpu":
         return windowed_correlation
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
+    return windowed_correlation_pallas
 
 
 def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,

@@ -1,0 +1,325 @@
+"""Fused on-demand windowed correlation — Pallas TPU kernel.
+
+TPU-native equivalent of the reference's ``alt_cuda_corr`` CUDA extension
+(reference ``alt_cuda_corr/correlation_kernel.cu:19-119`` forward,
+``:122-256`` backward): compute, for every query pixel, the correlation of
+its feature vector against bilinear samples of the target feature map in a
+``(2r+1)^2`` window around the current flow estimate — without ever
+materializing the ``(B, HW, HW)`` all-pairs volume in HBM.
+
+Design (TPU-first, not a CUDA translation):
+
+* The CUDA kernel walks a ``(2r+2)^2`` integer neighborhood per pixel and
+  bilinear-*scatters* dot products into the output window. Scatters and
+  per-pixel gathers are the wrong shape for TPU. Instead we use two facts:
+
+  1. **Blockwise recompute**: for a tile of ``TQ`` query pixels, the rows of
+     the all-pairs volume they need are ONE MXU matmul of the query tile
+     against the target features. The result lives only in VMEM scratch and
+     is consumed immediately — the flash-attention memory pattern applied
+     to the correlation volume (the quadratic object of this workload,
+     SURVEY.md §5 "long-context equivalent").
+
+  2. **Separable bilinear windows**: a bilinear sample at ``(cx+ox, cy+oy)``
+     factors into 1-D "hat" weights ``max(0, 1-|y-(cy+oy)|)`` times
+     ``max(0, 1-|x-(cx+ox)|)``. Sweeping the target rows ``y`` in order, each
+     row's correlation slice is folded into the ``2r+1`` y-offset
+     accumulators with its scalar hat weight; a final x-side hat contraction
+     emits the window. Pure multiply-accumulate on the VPU — no gather, no
+     scatter. Rows/columns outside the image simply never contribute, which
+     reproduces ``grid_sample(padding_mode='zeros')`` exactly (the
+     semantics of ``raft_tpu.ops.sampling.bilinear_sampler``).
+
+  Everything is strictly 2-D inside the kernel (Mosaic's vector layout
+  requirement) and laid out **query-minor**: the query-tile axis is the lane
+  dimension, so the y-sweep's dynamic row slices land on the sublane axis
+  and the target width only needs 8-alignment (not 128), minimizing padding
+  for narrow training crops.
+
+* Backward is the transpose of the same dense pipeline (hat-weighted
+  assembly of dL/d(corr tile) in scratch, then two MXU matmuls); ``fmap2``
+  gradients accumulate across query tiles in VMEM via output-block
+  revisiting — no atomics, unlike the CUDA kernel's ``atomicAdd``
+  (``correlation_kernel.cu:229-238``). Coordinates get zero gradient,
+  matching the CUDA extension (``coords_grad`` is allocated but never
+  written, ``correlation_kernel.cu:307``) and the per-iteration
+  ``coords1.detach()`` upstream (reference ``core/raft.py:124``).
+
+VMEM envelope: the target level (``H2*W2p x C``), the corr-tile scratch
+(``H2*W2p x TQ``) and (backward only) the fmap2 gradient block must co-reside
+in ~16 MB of VMEM. At stride-8 feature resolution this holds for full Sintel
+and KITTI eval forward passes and for all reference training crop sizes;
+float32 full-resolution *backward* at 1242x375 would not fit — but the
+reference's training never runs full-resolution backward either (crops,
+SURVEY.md §2.5).
+
+Numerics: accumulation in float32 regardless of input dtype; parity with the
+jnp reference ``raft_tpu.models.corr.windowed_correlation`` is asserted in
+``tests/test_corr_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _choose_tile(h2w2p: int, c: int) -> int:
+    """Query-tile size keeping the per-tile VMEM working set bounded.
+
+    Budgeted for the *backward* pass (the larger of the two): fmap2 block +
+    df2 output block (both ``h2w2p * c``) + the g2 scratch (``h2w2p * tq``)
+    must co-reside. The forward reuses the same tile so the cotangent
+    layout always divides evenly."""
+    f2_bytes = h2w2p * c * 4
+    budget = 12 * 2 ** 20
+    if 2 * f2_bytes + 256 * h2w2p * 4 < budget:
+        return 256
+    # 128 is the floor: the query tile is the lane axis, and lane-dim blocks
+    # must be 128-divisible once the grid has more than one tile.
+    return 128
+
+
+def _hat(dist: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(0.0, 1.0 - jnp.abs(dist))
+
+
+def _x_iota(w2p: int, tq: int) -> jnp.ndarray:
+    """(W2P, TQ) iota along the sublane (x-position) axis."""
+    return jax.lax.broadcasted_iota(jnp.int32, (w2p, tq), 0).astype(
+        jnp.float32)
+
+
+def _fwd_kernel(cx_ref, cy_ref, f1_ref, f2_ref, out_ref, corr_ref, *,
+                radius: int, scale: bool, h2: int, w2p: int):
+    win = 2 * radius + 1
+    f1 = f1_ref[0].astype(jnp.float32)                   # (TQ, C)
+    tq, c = f1.shape
+    cx = cx_ref[0].astype(jnp.float32)                   # (1, TQ)
+    cy = cy_ref[0].astype(jnp.float32)
+
+    # The query tile's rows of the all-pairs volume, transposed: ONE large
+    # MXU matmul, held only in VMEM scratch (never HBM).
+    corr_ref[...] = jax.lax.dot_general(
+        f2_ref[0].astype(jnp.float32), f1, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (H2*W2P, TQ)
+
+    # y-sweep: fold each target row's correlation slice into the 2r+1
+    # y-offset accumulators with its scalar hat weight (pure VPU).
+    def body(y, t1):
+        corr_y = corr_ref[pl.ds(y * w2p, w2p), :]        # (W2P, TQ)
+        yf = y.astype(jnp.float32)
+        parts = []
+        for i in range(win):                             # y-offset index
+            wy = _hat(yf - (cy + (i - radius)))          # (1, TQ)
+            parts.append(wy * corr_y)
+        return t1 + jnp.concatenate(parts, axis=0)
+
+    t1 = jax.lax.fori_loop(
+        0, h2, body, jnp.zeros((win * w2p, tq), jnp.float32))
+
+    # x-side hat contraction → window rows in the reference order
+    # (core/corr.py delta grid: first window axis moves x).
+    xi = _x_iota(w2p, tq)
+    rows = []
+    for a in range(win):                                 # x-offset index
+        vx = _hat(xi - (cx + (a - radius)))              # (W2P, TQ)
+        for b in range(win):                             # y-offset index
+            t1_b = t1[b * w2p:(b + 1) * w2p, :]
+            rows.append(jnp.sum(t1_b * vx, axis=0, keepdims=True))
+    out = jnp.concatenate(rows, axis=0)                  # (win*win, TQ)
+    if scale:
+        out = out * (1.0 / (c ** 0.5))
+    out_ref[0] = out
+
+
+def _bwd_kernel(cx_ref, cy_ref, f1_ref, f2_ref, g_ref,
+                df1_ref, df2_ref, g2_ref, *,
+                radius: int, scale: bool, h2: int, w2p: int):
+    win = 2 * radius + 1
+    f1 = f1_ref[0].astype(jnp.float32)                   # (TQ, C)
+    tq, c = f1.shape
+    g = g_ref[0].astype(jnp.float32)                     # (win*win, TQ)
+    if scale:
+        g = g * (1.0 / (c ** 0.5))
+    cx = cx_ref[0].astype(jnp.float32)                   # (1, TQ)
+    cy = cy_ref[0].astype(jnp.float32)
+
+    # U_b[x, n] = sum_a g[a*win+b, n] * hat(x - cx - (a - r)) — the x-side
+    # adjoint, shared across the y sweep.
+    xi = _x_iota(w2p, tq)
+    u = []
+    for b in range(win):
+        acc = jnp.zeros((w2p, tq), jnp.float32)
+        for a in range(win):
+            vx = _hat(xi - (cx + (a - radius)))
+            acc = acc + g[a * win + b:a * win + b + 1, :] * vx
+        u.append(acc)
+    uflat = jnp.concatenate(u, axis=0)                   # (win*W2P, TQ)
+
+    # Assemble dL/d(corr tile) row-block by row-block into VMEM scratch…
+    def body(y, _):
+        yf = y.astype(jnp.float32)
+        g2y = jnp.zeros((w2p, tq), jnp.float32)
+        for b in range(win):
+            wy = _hat(yf - (cy + (b - radius)))          # (1, TQ)
+            g2y = g2y + wy * uflat[b * w2p:(b + 1) * w2p, :]
+        g2_ref[pl.ds(y * w2p, w2p), :] = g2y
+        return 0
+
+    jax.lax.fori_loop(0, h2, body, 0)
+
+    # …then both gradients are single MXU matmuls against the scratch.
+    g2 = g2_ref[...]                                     # (H2*W2P, TQ)
+    df1_ref[0] = jax.lax.dot_general(
+        g2, f2_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (TQ, C)
+    contrib = jax.lax.dot_general(
+        g2, f1, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (H2*W2P, C)
+
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        df2_ref[0] = contrib
+
+    @pl.when(t != 0)
+    def _():
+        df2_ref[0] = df2_ref[0] + contrib
+
+
+def _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
+    """f1: (B, Np, C); f2: (B, H2*W2p, C); cx/cy: (B, 1, Np); Np % tq == 0.
+    Returns (B, win*win, Np) — query-minor; transposed by the wrapper."""
+    b, np_, c = f1.shape
+    h2w2p = f2.shape[1]
+    h2 = h2w2p // w2p
+    win = 2 * radius + 1
+    grid = (b, np_ // tq)
+
+    kernel = functools.partial(_fwd_kernel, radius=radius, scale=scale,
+                               h2=h2, w2p=w2p)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
+            pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
+            pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
+            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, win * win, tq), lambda bi, ti: (bi, 0, ti)),
+        out_shape=jax.ShapeDtypeStruct((b, win * win, np_), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((h2w2p, tq), jnp.float32)],
+        interpret=interpret,
+    )(cx, cy, f1, f2)
+
+
+def _pallas_bwd(f1, f2, cx, cy, g, radius, scale, interpret, w2p, tq):
+    b, np_, c = f1.shape
+    h2w2p = f2.shape[1]
+    h2 = h2w2p // w2p
+    win = 2 * radius + 1
+    grid = (b, np_ // tq)
+
+    kernel = functools.partial(_bwd_kernel, radius=radius, scale=scale,
+                               h2=h2, w2p=w2p)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
+            pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
+            pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
+            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
+            pl.BlockSpec((1, win * win, tq), lambda bi, ti: (bi, 0, ti)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
+            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, np_, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, h2w2p, c), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((h2w2p, tq), jnp.float32)],
+        interpret=interpret,
+    )(cx, cy, f1, f2, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _windowed(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
+    return _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
+
+
+def _windowed_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
+    out = _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
+    return out, (f1, f2, cx, cy)
+
+
+def _windowed_bwd(radius, scale, interpret, w2p, tq, res, g):
+    f1, f2, cx, cy = res
+    df1, df2 = _pallas_bwd(f1, f2, cx, cy, g, radius, scale, interpret,
+                           w2p, tq)
+    # Zero coordinate gradient — the contract of the reference extension
+    # (correlation_kernel.cu:307) and of the detach-per-iteration scan.
+    return (df1.astype(f1.dtype), df2.astype(f2.dtype),
+            jnp.zeros_like(cx), jnp.zeros_like(cy))
+
+
+_windowed.defvjp(_windowed_fwd, _windowed_bwd)
+
+
+def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                                coords: jnp.ndarray, radius: int,
+                                scale: bool = True,
+                                interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in Pallas replacement for
+    ``raft_tpu.models.corr.windowed_correlation``.
+
+    Args:
+      fmap1: ``(B, H, W, C)`` query features.
+      fmap2: ``(B, H2, W2, C)`` target features (one pyramid level).
+      coords: ``(B, H, W, 2)`` pixel coords (x, y) at fmap2's scale.
+      radius: lookup radius r; output window is ``(2r+1)^2``.
+      scale: divide by ``sqrt(C)`` (reference ``core/corr.py:61``).
+      interpret: force Pallas interpreter mode (defaults to True off-TPU so
+        the same tests run on CPU).
+
+    Returns:
+      ``(B, H, W, (2r+1)^2)`` float32 correlation features.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, w, c = fmap1.shape
+    _, h2, w2, _ = fmap2.shape
+    win = 2 * radius + 1
+
+    # Pad W2 to sublane alignment; zero columns get zero hat weight, which
+    # preserves zeros-padding semantics.
+    w2p = _round_up(w2, 8)
+    f2 = jnp.pad(fmap2, ((0, 0), (0, 0), (0, w2p - w2), (0, 0)))
+    f2 = f2.reshape(b, h2 * w2p, c)
+
+    n = h * w
+    tq = min(_choose_tile(h2 * w2p, c), _round_up(n, 8))
+    np_ = _round_up(n, tq)
+    f1 = fmap1.reshape(b, n, c)
+    f1 = jnp.pad(f1, ((0, 0), (0, np_ - n), (0, 0)))
+    cf = coords.reshape(b, n, 2)
+    cf = jnp.pad(cf, ((0, 0), (0, np_ - n), (0, 0)))
+    cx = cf[..., 0][:, None, :]                          # (B, 1, Np)
+    cy = cf[..., 1][:, None, :]
+
+    out = _windowed(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
+    out = jnp.swapaxes(out, 1, 2)                        # (B, Np, win*win)
+    return out[:, :n].reshape(b, h, w, win * win)
