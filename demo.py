@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Root entry point mirroring the reference repo layout: ``python demo.py
+--model ... --path demo-frames`` (see ``raft_tpu/demo.py``)."""
+
+from raft_tpu.demo import main
+
+if __name__ == "__main__":
+    main()

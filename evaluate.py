@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Root entry point mirroring the reference repo layout: ``python
+evaluate.py --model ... --dataset sintel`` (see ``raft_tpu/evaluate.py``)."""
+
+from raft_tpu.evaluate import main
+
+if __name__ == "__main__":
+    main()

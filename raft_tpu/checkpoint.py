@@ -1,0 +1,91 @@
+"""Checkpointing: full-state save/restore with true resume.
+
+The reference checkpoints only ``model.state_dict()`` every 5000 steps and
+"resumes" with ``load_state_dict(strict=False)`` — optimizer, scheduler and
+step state are lost between stages (reference ``train.py:345-346, :398-400``;
+SURVEY.md §5). Here the whole :class:`RAFTTrainState` (step, params, BN
+stats, optimizer state) round-trips through orbax, so preemption recovery
+and exact resume work; the curriculum use-case (chairs → things → sintel →
+kitti, ``train_mixed.sh:3-6``) is served by :func:`load_params`, and
+published torch ``.pth`` weights load through
+:mod:`raft_tpu.utils.torch_convert`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _manager(ckpt_dir: str, max_to_keep: Optional[int] = None):
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                             create=True))
+
+
+def _arrays_of(state) -> dict:
+    """The checkpointable slice of a train state (drops apply_fn/tx)."""
+    return {"step": state.step, "params": state.params,
+            "batch_stats": state.batch_stats, "opt_state": state.opt_state}
+
+
+def save_checkpoint(ckpt_dir: str, state, keep: int = 5) -> None:
+    """Save ``state`` under its current step number."""
+    with _manager(ckpt_dir, keep) as mngr:
+        mngr.save(int(jax.device_get(state.step)),
+                  args=ocp.args.StandardSave(_arrays_of(state)))
+        mngr.wait_until_finished()
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    with _manager(ckpt_dir) as mngr:
+        return mngr.latest_step()
+
+
+def restore_checkpoint(ckpt_dir: str, state,
+                       step: Optional[int] = None):
+    """Restore a full train state saved by :func:`save_checkpoint`.
+
+    ``state`` provides the target structure (and sharding, when its arrays
+    carry shardings); returns the restored state or ``state`` unchanged when
+    the directory holds no checkpoint.
+    """
+    with _manager(ckpt_dir) as mngr:
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            return state
+        target = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                              _arrays_of(state))
+        restored = mngr.restore(step,
+                                args=ocp.args.StandardRestore(target))
+    return state.replace(step=restored["step"], params=restored["params"],
+                         batch_stats=restored["batch_stats"],
+                         opt_state=restored["opt_state"])
+
+
+def load_params(path: str, step: Optional[int] = None) -> Any:
+    """Load parameters only — the stage-curriculum restore
+    (reference ``--restore_ckpt`` + ``strict=False``).
+
+    ``path`` may be an orbax checkpoint directory (params + batch_stats are
+    extracted) or a torch ``.pth`` file (converted with
+    :func:`raft_tpu.utils.torch_convert.load_torch_checkpoint`).
+
+    Returns ``(params, batch_stats)`` pytrees.
+    """
+    if path.endswith((".pth", ".pt")):
+        from raft_tpu.utils.torch_convert import load_torch_checkpoint
+        variables = load_torch_checkpoint(path)
+        return variables["params"], variables.get("batch_stats", {})
+    with _manager(path) as mngr:
+        step = step if step is not None else mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        restored = mngr.restore(step)
+    return restored["params"], restored["batch_stats"]

@@ -71,6 +71,41 @@ class RAFTConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class OursConfig:
+    """The sparse-keypoint ("ours") model family hyperparameters.
+
+    Mirrors the hard-coded values in reference ``core/ours.py:49-123``:
+    d_model 128, 3 feature levels (strides 8/16/32), 6 outer iterations of a
+    deformable decoder over 100 learned keypoint queries, fork-drifted
+    2-level correlation inputs with radius 4.
+    """
+
+    base_channel: int = 64
+    d_model: int = 128
+    num_feature_levels: int = 3
+    outer_iterations: int = 6
+    num_keypoints: int = 100
+    n_heads: int = 8
+    n_points: int = 4
+    dropout: float = 0.1
+    corr_levels: int = 2            # fork default (reference core/corr.py:13)
+    corr_radius: int = 4
+    mixed_precision: bool = False
+
+    @property
+    def up_dim(self) -> int:
+        return round(self.base_channel * 1.5)
+
+    @property
+    def level_channels(self):
+        """Channels of the pyramid levels fed to the decoder (reference
+        ``core/ours.py:57``: ``[96, 128, 192, 256][4 - levels:]``)."""
+        c = self.base_channel
+        return [round(c * 1.5), c * 2, round(c * 3), c * 4][
+            4 - self.num_feature_levels:]
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training hyperparameters (reference ``train.py:431-452`` flags and
     ``train_mixed.sh`` / ``train_standard.sh`` schedules)."""

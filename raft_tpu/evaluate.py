@@ -1,0 +1,307 @@
+"""Validation and leaderboard-submission harness.
+
+Mirrors reference ``evaluate.py`` — Sintel/KITTI submission writers
+(``:21-71``), FlyingChairs / Sintel / Sintel-occ / KITTI validation
+(``:74-98``, ``:101-147``, ``:150-196``, ``:250-300``) — rebuilt around a
+shape-bucketed jitted predictor: torch pads each sample and re-runs eager;
+XLA wants static shapes, so ``FlowPredictor`` compiles once per padded
+resolution bucket (Sintel has one bucket, KITTI a handful) and reuses the
+executable across the whole epoch.
+
+All functions operate on numpy at the edges (datasets produce numpy; flow
+files are written with :mod:`raft_tpu.data.frame_utils`) and return plain
+dicts of floats, the reference's interface for the periodic in-training
+validation (reference ``train.py:402-409``).
+"""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.data import datasets, frame_utils
+from raft_tpu.utils.padder import InputPadder
+from raft_tpu.utils.warm_start import forward_interpolate
+
+
+class FlowPredictor:
+    """Jitted ``test_mode`` forward with a per-resolution compile cache.
+
+    Args:
+      model: a flax module whose apply signature matches
+        :class:`raft_tpu.models.raft.RAFT`.
+      variables: the variable pytree ({'params': ..., ['batch_stats': ...]}).
+      iters: refinement iterations (reference eval defaults: chairs/kitti 24,
+        sintel 32 — ``evaluate.py:75,102,251``).
+    """
+
+    def __init__(self, model, variables, iters: int = 32):
+        self.model = model
+        self.variables = variables
+        self.iters = iters
+        self._cache: Dict = {}
+
+    def _fn(self, shape, warm: bool) -> Callable:
+        key = (shape, warm, self.iters)
+        if key not in self._cache:
+            def run(variables, image1, image2, flow_init=None):
+                return self.model.apply(
+                    variables, image1, image2, iters=self.iters,
+                    flow_init=flow_init, test_mode=True)
+
+            self._cache[key] = jax.jit(run)
+        return self._cache[key]
+
+    def __call__(self, image1: np.ndarray, image2: np.ndarray,
+                 flow_init: Optional[np.ndarray] = None):
+        """image1/2: (H, W, 3) float in [0, 255], already padded to /8.
+
+        Returns ``(flow_low, flow_up)`` numpy arrays, shapes
+        ``(H/8, W/8, 2)`` and ``(H, W, 2)``.
+        """
+        img1 = jnp.asarray(image1)[None]
+        img2 = jnp.asarray(image2)[None]
+        init = None if flow_init is None else jnp.asarray(flow_init)[None]
+        fn = self._fn(img1.shape, flow_init is not None)
+        flow_low, flow_up = fn(self.variables, img1, img2, init)
+        return np.asarray(flow_low[0]), np.asarray(flow_up[0])
+
+
+def _epe_map(flow: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
+    return np.sqrt(np.sum((flow - flow_gt) ** 2, axis=-1))
+
+
+def validate_chairs(predictor: FlowPredictor, root=None) -> Dict[str, float]:
+    """FlyingChairs val-split EPE (reference ``evaluate.py:74-98``)."""
+    val_dataset = datasets.FlyingChairs(split="validation", root=root)
+    epe_list = []
+    for val_id in range(len(val_dataset)):
+        image1, image2, flow_gt, _ = val_dataset[val_id]
+        _, flow = predictor(image1, image2)
+        epe_list.append(_epe_map(flow, flow_gt).reshape(-1))
+    epe = float(np.mean(np.concatenate(epe_list)))
+    print(f"Validation Chairs EPE: {epe:.6f}")
+    return {"chairs": epe}
+
+
+def validate_sintel(predictor: FlowPredictor, root=None) -> Dict[str, float]:
+    """Sintel train-split clean+final EPE and pixel thresholds
+    (reference ``evaluate.py:101-147``)."""
+    results: Dict[str, float] = {}
+    for dstype in ("clean", "final"):
+        val_dataset = datasets.MpiSintel(split="training", dstype=dstype,
+                                         root=root)
+        epe_list = []
+        for val_id in range(len(val_dataset)):
+            image1, image2, flow_gt, _ = val_dataset[val_id]
+            padder = InputPadder(image1.shape)
+            im1, im2 = padder.pad(image1, image2)
+            _, flow = predictor(im1, im2)
+            flow = padder.unpad(flow)
+            epe_list.append(_epe_map(flow, flow_gt).reshape(-1))
+
+        epe_all = np.concatenate(epe_list)
+        epe = float(np.mean(epe_all))
+        px1 = float(np.mean(epe_all < 1))
+        px3 = float(np.mean(epe_all < 3))
+        px5 = float(np.mean(epe_all < 5))
+        print(f"Validation ({dstype}) EPE: {epe:.6f}, 1px: {px1:.6f}, "
+              f"3px: {px3:.6f}, 5px: {px5:.6f}")
+        results[dstype] = epe
+    return results
+
+
+def validate_sintel_occ(predictor: FlowPredictor,
+                        root=None) -> Dict[str, float]:
+    """Sintel validation split by occluded / non-occluded pixels
+    (reference ``evaluate.py:150-196``; the reference's own data path for
+    this is broken fork drift — see ``MpiSintel.read_occlusion``)."""
+    results: Dict[str, float] = {}
+    for dstype in ("albedo", "clean", "final"):
+        val_dataset = datasets.MpiSintel(split="training", dstype=dstype,
+                                         occlusion=True, root=root)
+        if len(val_dataset) == 0 or not val_dataset.occ_list:
+            continue
+        epe_list, occ_list, noc_list = [], [], []
+        for val_id in range(len(val_dataset)):
+            image1, image2, flow_gt, _ = val_dataset[val_id]
+            occ = val_dataset.read_occlusion(val_id)
+            padder = InputPadder(image1.shape)
+            im1, im2 = padder.pad(image1, image2)
+            _, flow = predictor(im1, im2)
+            flow = padder.unpad(flow)
+            epe = _epe_map(flow, flow_gt)
+            epe_list.append(epe.reshape(-1))
+            occ_list.append(epe[occ])
+            noc_list.append(epe[~occ])
+
+        epe_all = np.concatenate(epe_list)
+        epe = float(np.mean(epe_all))
+        epe_occ = float(np.mean(np.concatenate(occ_list)))
+        epe_noc = float(np.mean(np.concatenate(noc_list)))
+        print(f"Validation ({dstype}) EPE: {epe:.6f}, "
+              f"occ: {epe_occ:.6f}, noc: {epe_noc:.6f}")
+        results[dstype] = epe
+        results[f"{dstype}_occ"] = epe_occ
+        results[f"{dstype}_noc"] = epe_noc
+    return results
+
+
+def validate_kitti(predictor: FlowPredictor, root=None) -> Dict[str, float]:
+    """KITTI-2015 train-split EPE and F1-all (reference
+    ``evaluate.py:250-300``; outlier rule ``epe > 3 && epe/mag > 0.05``,
+    ``:285``)."""
+    val_dataset = datasets.KITTI(split="training", root=root)
+    epe_list, out_list = [], []
+    for val_id in range(len(val_dataset)):
+        image1, image2, flow_gt, valid_gt = val_dataset[val_id]
+        padder = InputPadder(image1.shape, mode="kitti")
+        im1, im2 = padder.pad(image1, image2)
+        _, flow = predictor(im1, im2)
+        flow = padder.unpad(flow)
+
+        epe = _epe_map(flow, flow_gt)
+        mag = np.sqrt(np.sum(flow_gt ** 2, axis=-1))
+        val = valid_gt >= 0.5
+        out = ((epe > 3.0) & ((epe / np.maximum(mag, 1e-12)) > 0.05))
+        epe_list.append(np.mean(epe[val]))
+        out_list.append(out[val].reshape(-1))
+
+    epe = float(np.mean(epe_list))
+    f1 = 100 * float(np.mean(np.concatenate(out_list)))
+    print(f"Validation KITTI: {epe:.6f}, {f1:.6f}")
+    return {"kitti-epe": epe, "kitti-f1": f1}
+
+
+def create_sintel_submission(predictor: FlowPredictor,
+                             warm_start: bool = False,
+                             output_path: str = "sintel_submission",
+                             root=None) -> None:
+    """Write Sintel leaderboard ``.flo`` files (reference
+    ``evaluate.py:21-50``), optionally warm-starting each frame from the
+    forward-splatted previous low-res flow (``:40-41``)."""
+    for dstype in ("clean", "final"):
+        test_dataset = datasets.MpiSintel(split="test", aug_params=None,
+                                          dstype=dstype, root=root)
+        flow_prev, sequence_prev = None, None
+        for test_id in range(len(test_dataset)):
+            image1, image2, (sequence, frame) = test_dataset[test_id]
+            if sequence != sequence_prev:
+                flow_prev = None
+            padder = InputPadder(image1.shape)
+            im1, im2 = padder.pad(image1, image2)
+            flow_low, flow = predictor(im1, im2, flow_init=flow_prev)
+            flow = padder.unpad(flow)
+            if warm_start:
+                flow_prev = forward_interpolate(flow_low)
+
+            output_dir = osp.join(output_path, dstype, sequence)
+            os.makedirs(output_dir, exist_ok=True)
+            frame_utils.write_flo(
+                osp.join(output_dir, "frame%04d.flo" % (frame + 1)), flow)
+            sequence_prev = sequence
+
+
+def create_kitti_submission(predictor: FlowPredictor,
+                            output_path: str = "kitti_submission",
+                            root=None) -> None:
+    """Write KITTI leaderboard 16-bit PNGs (reference
+    ``evaluate.py:53-71``)."""
+    test_dataset = datasets.KITTI(split="testing", aug_params=None,
+                                  root=root)
+    os.makedirs(output_path, exist_ok=True)
+    for test_id in range(len(test_dataset)):
+        image1, image2, (frame_id,) = test_dataset[test_id]
+        padder = InputPadder(image1.shape, mode="kitti")
+        im1, im2 = padder.pad(image1, image2)
+        _, flow = predictor(im1, im2)
+        flow = padder.unpad(flow)
+        frame_utils.write_flow_kitti(osp.join(output_path, frame_id), flow)
+
+
+_VALIDATORS = {
+    "chairs": validate_chairs,
+    "sintel": validate_sintel,
+    "sintel_occ": validate_sintel_occ,
+    "kitti": validate_kitti,
+}
+
+
+def run_validation(predictor: FlowPredictor, names) -> Dict[str, float]:
+    """Dispatch by dataset name — the train loop's periodic validation hook
+    (reference ``train.py:402-409``)."""
+    results: Dict[str, float] = {}
+    for name in names:
+        results.update(_VALIDATORS[name](predictor))
+    return results
+
+
+def load_predictor(model_path: str, small: bool = False,
+                   alternate_corr: bool = False,
+                   mixed_precision: bool = False,
+                   iters: int = 32) -> FlowPredictor:
+    """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
+    (published reference weights, converted) or an orbax run directory
+    (the reference ``evaluate.py:312-313`` model-loading path)."""
+    from raft_tpu import checkpoint as ckpt_lib
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
+                     mixed_precision=mixed_precision)
+    model = RAFT(cfg)
+    params, batch_stats = ckpt_lib.load_params(model_path)
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    return FlowPredictor(model, variables, iters=iters)
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Validate / create submissions (reference "
+                    "evaluate.py:303-329).")
+    parser.add_argument("--model", required=True,
+                        help="torch .pth or orbax checkpoint dir")
+    parser.add_argument("--dataset", required=True,
+                        choices=list(_VALIDATORS) + ["sintel_submission",
+                                                     "kitti_submission"])
+    parser.add_argument("--small", action="store_true")
+    parser.add_argument("--iters", type=int, default=None)
+    parser.add_argument("--alternate_corr", action="store_true")
+    parser.add_argument("--mixed_precision", action="store_true")
+    parser.add_argument("--warm_start", action="store_true")
+    parser.add_argument("--data_root", default=None)
+    parser.add_argument("--output_path", default=None)
+    args = parser.parse_args(argv)
+
+    default_iters = {"chairs": 24, "kitti": 24, "sintel": 32,
+                     "sintel_occ": 32, "sintel_submission": 32,
+                     "kitti_submission": 24}
+    iters = args.iters or default_iters[args.dataset]
+    predictor = load_predictor(args.model, small=args.small,
+                               alternate_corr=args.alternate_corr,
+                               mixed_precision=args.mixed_precision,
+                               iters=iters)
+    if args.dataset == "sintel_submission":
+        create_sintel_submission(
+            predictor, warm_start=args.warm_start,
+            output_path=args.output_path or "sintel_submission",
+            root=args.data_root)
+    elif args.dataset == "kitti_submission":
+        create_kitti_submission(
+            predictor, output_path=args.output_path or "kitti_submission",
+            root=args.data_root)
+    else:
+        _VALIDATORS[args.dataset](predictor, root=args.data_root)
+
+
+if __name__ == "__main__":
+    main()

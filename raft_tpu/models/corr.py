@@ -69,19 +69,24 @@ def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     return tuple(pyramid)
 
 
-def pyramid_lookup(pyramid, coords: jnp.ndarray, radius: int) -> jnp.ndarray:
+def pyramid_lookup(pyramid, coords: jnp.ndarray, radius: int,
+                   rescale: bool = True) -> jnp.ndarray:
     """Windowed bilinear lookup into a materialized pyramid.
 
     ``coords``: (B, H, W, 2) pixel (x, y); per level the centroid is scaled
-    by ``1/2^level`` (canonical RAFT — the fork dropped this rescale,
-    reference ``core/corr.py:42``). Returns (B, H, W, L*(2r+1)^2).
+    by ``1/2^level`` (canonical RAFT). ``rescale=False`` reproduces the fork
+    drift that dropped this rescale (reference ``core/corr.py:38-42``) —
+    the semantics the sparse-keypoint ("ours") family was trained with.
+    Returns (B, H, W, L*(2r+1)^2).
     """
     B, H, W, _ = coords.shape
     r = radius
     delta = _window_delta(r).reshape(1, 2 * r + 1, 2 * r + 1, 2)
     out = []
     for lvl, corr in enumerate(pyramid):
-        centroid = coords.reshape(B * H * W, 1, 1, 2) / (2 ** lvl)
+        centroid = coords.reshape(B * H * W, 1, 1, 2)
+        if rescale:
+            centroid = centroid / (2 ** lvl)
         sampled = bilinear_sampler(corr, centroid + delta)
         out.append(sampled.reshape(B, H, W, -1))
     return jnp.concatenate(out, axis=-1)
@@ -91,12 +96,15 @@ class CorrBlock:
     """Materialized all-pairs correlation pyramid with windowed lookup."""
 
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
-                 num_levels: int = 4, radius: int = 4, scale: bool = True):
+                 num_levels: int = 4, radius: int = 4, scale: bool = True,
+                 rescale: bool = True):
         self.radius = radius
+        self.rescale = rescale
         self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels, scale)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
-        return pyramid_lookup(self.pyramid, coords, self.radius)
+        return pyramid_lookup(self.pyramid, coords, self.radius,
+                              self.rescale)
 
 
 def windowed_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,

@@ -67,13 +67,15 @@ class Norm(nn.Module):
 
 class ResidualBlock(nn.Module):
     """Two 3x3 convs + norm + residual 1x1 downsample when stride > 1
-    (reference ``core/extractor_origin.py:6-55``)."""
+    (reference ``core/extractor_origin.py:6-55``; the fork's rewritten
+    encoders use the same block with GELU, ``core/extractor.py:13``)."""
 
     planes: int
     norm_fn: str = "group"
     stride: int = 1
     axis_name: Optional[str] = None
     dtype: Any = jnp.float32
+    act: str = "relu"
 
     def setup(self):
         self.conv1 = nn.Conv(self.planes, (3, 3), strides=self.stride,
@@ -88,11 +90,12 @@ class ResidualBlock(nn.Module):
             self.norm3 = Norm(self.norm_fn, self.axis_name, self.dtype)
 
     def __call__(self, x, train: bool = False):
-        y = nn.relu(self.norm1(self.conv1(x), train))
-        y = nn.relu(self.norm2(self.conv2(y), train))
+        act = nn.relu if self.act == "relu" else nn.gelu
+        y = act(self.norm1(self.conv1(x), train))
+        y = act(self.norm2(self.conv2(y), train))
         if self.stride != 1:
             x = self.norm3(self.downsample(x), train)
-        return nn.relu(x + y)
+        return act(x + y)
 
 
 class BottleneckBlock(nn.Module):

@@ -1,0 +1,130 @@
+"""The fork's multi-scale CNN encoders feeding the sparse-keypoint model.
+
+Reference ``core/extractor.py:342-438`` (``CNNEncoder``) and ``:441-563``
+(``CNNDecoder``): a GELU residual trunk — 7x7/2 stem then five double-
+ResidualBlock stages at channels ``(c, 1.5c, 2c, 3c, 4c)`` with strides
+``(1, 2, 2, 2, 2)`` — returning per-image feature pyramids at strides
+(4, 8, 16, 32); the decoder adds one FPN top-down merge producing the
+stride-4 context map ``U1`` (``up_top1``/``up_lateral1``/``up_smooth1``,
+``:446-455``, forward ``:531-536``).
+
+Quirk preserved deliberately: the reference returns ``X2[0] = D2_x1`` (the
+*first* image's level-0 features in the second image's pyramid,
+``core/extractor.py:437``) — harmless because the live model drops level 0
+(``core/ours.py:327-330``), and replicated so converted weights/activations
+match exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.models.extractor import Norm, ResidualBlock
+
+
+class _Trunk(nn.Module):
+    """Stem + five down stages shared by encoder and decoder."""
+
+    base_channel: int
+    norm_fn: str
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        c, d = self.base_channel, self.dtype
+
+        def stage(dim, stride):
+            return [ResidualBlock(dim, self.norm_fn, stride, self.axis_name,
+                                  d, act="gelu"),
+                    ResidualBlock(dim, self.norm_fn, 1, self.axis_name,
+                                  d, act="gelu")]
+
+        self.conv1 = nn.Conv(c, (7, 7), strides=2, padding=3, dtype=d)
+        self.norm1 = Norm(self.norm_fn, self.axis_name, d)
+        self.down_layer1 = stage(c, 1)
+        self.down_layer2 = stage(round(c * 1.5), 2)
+        self.down_layer3 = stage(c * 2, 2)
+        self.down_layer4 = stage(round(c * 3), 2)
+        self.down_layer5 = stage(c * 4, 2)
+
+    def __call__(self, x, train: bool = False):
+        x = nn.gelu(self.norm1(self.conv1(x), train))
+        outs = []
+        for stage in (self.down_layer1, self.down_layer2, self.down_layer3,
+                      self.down_layer4, self.down_layer5):
+            for blk in stage:
+                x = blk(x, train)
+            outs.append(x)
+        return outs  # D1..D5, strides 2, 4, 8, 16, 32
+
+
+def _split_pyramids(levels):
+    """Twin-image batch split, preserving the reference's X2[0] quirk."""
+    d2, d3, d4, d5 = levels
+    d2_x1, d2_x2 = jnp.split(d2, 2, axis=0)
+    d3_x1, d3_x2 = jnp.split(d3, 2, axis=0)
+    d4_x1, d4_x2 = jnp.split(d4, 2, axis=0)
+    d5_x1, d5_x2 = jnp.split(d5, 2, axis=0)
+    x1 = (d2_x1, d3_x1, d4_x1, d5_x1)
+    x2 = (d2_x1, d3_x2, d4_x2, d5_x2)   # sic — reference core/extractor.py:437
+    return x1, x2
+
+
+class CNNEncoder(nn.Module):
+    """Downsampling-only pyramid encoder (reference
+    ``core/extractor.py:342-438``). Input: both images concatenated on the
+    batch axis; returns ``(X1, X2)`` 4-level pyramids."""
+
+    base_channel: int = 64
+    norm_fn: str = "instance"
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        outs = _Trunk(self.base_channel, self.norm_fn, self.axis_name,
+                      self.dtype, name="trunk")(x, train)
+        return _split_pyramids(outs[1:])
+
+
+class CNNDecoder(nn.Module):
+    """Pyramid encoder + FPN top-down context map (reference
+    ``core/extractor.py:441-563``). Returns ``(X1, X2, U1)`` where ``U1``
+    is the stride-4 context map of the first image (``up_dim = 1.5c``)."""
+
+    base_channel: int = 64
+    norm_fn: str = "batch"
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @property
+    def up_dim(self) -> int:
+        return round(self.base_channel * 1.5)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c, d = self.base_channel, self.dtype
+        outs = _Trunk(c, self.norm_fn, self.axis_name, d,
+                      name="trunk")(x, train)
+        x1, x2 = _split_pyramids(outs[1:])
+        d2_x1, d3_x1 = x1[0], x1[1]
+
+        up = round(c * 1.5)
+        t1 = Norm(self.norm_fn, self.axis_name, d, name="up_top1_norm")(
+            nn.Conv(up, (1, 1), dtype=d, name="up_top1")(d3_x1), train)
+        l2 = Norm(self.norm_fn, self.axis_name, d, name="up_lateral1_norm")(
+            nn.Conv(up, (1, 1), dtype=d, name="up_lateral1")(d2_x1), train)
+        # F.interpolate(..., mode='bilinear', align_corners=False)
+        t1 = jax.image.resize(t1.astype(jnp.float32),
+                              (t1.shape[0],) + l2.shape[1:3] + (up,),
+                              method="linear").astype(l2.dtype)
+        u1 = nn.gelu(t1 + l2)
+        u1 = nn.gelu(Norm(self.norm_fn, self.axis_name, d,
+                          name="up_smooth1_norm")(
+            nn.Conv(up, (3, 3), padding=1, dtype=d,
+                    name="up_smooth1")(u1), train))
+        return x1, x2, u1

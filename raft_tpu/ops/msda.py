@@ -1,0 +1,75 @@
+"""Multi-scale deformable attention sampling core.
+
+TPU-native equivalent of the reference's ``MultiScaleDeformableAttention``
+CUDA extension (reference ``core/ops/src/cuda/ms_deform_im2col_cuda.cuh:238``
+forward kernel; pure-torch reference implementation
+``core/ops/functions/ms_deform_attn_func.py:41-61``): per (query, head,
+level, point), bilinearly sample the value map at a predicted normalized
+location and accumulate with a predicted attention weight.
+
+Design note (TPU-first): in the live "ours" model the query set is 100
+keypoints × 8 heads × 6 levels × 4 points ≈ 19k samples per image — three
+orders of magnitude smaller than the token grid. The op is
+bandwidth-trivial; what matters is that the gathers vectorize and fuse under
+XLA, so the core is expressed as one batched ``bilinear_sampler`` call per
+level (static level loop) and a single weighted reduction. A Pallas kernel
+would only pay off for dense-query encoder layers (reference keeps those
+disabled, ``core/ours.py:97-109``); revisit if that regime is enabled.
+
+Sampling convention matches ``F.grid_sample(align_corners=False,
+padding_mode='zeros')``: normalized location ``u ∈ [0,1]`` maps to pixel
+``u*W - 0.5`` (reference ``ms_deform_attn_func.py:48`` builds
+``2*loc - 1`` grids for grid_sample).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from raft_tpu.ops.sampling import bilinear_sampler
+
+
+def ms_deform_attn(value: jnp.ndarray,
+                   spatial_shapes: Sequence[Tuple[int, int]],
+                   sampling_locations: jnp.ndarray,
+                   attention_weights: jnp.ndarray) -> jnp.ndarray:
+    """Deformable attention sampling.
+
+    Args:
+      value: ``(B, S, M, D)`` flattened multi-level value maps,
+        ``S = sum(H_l * W_l)``.
+      spatial_shapes: static list of per-level ``(H, W)``.
+      sampling_locations: ``(B, Lq, M, L, P, 2)`` normalized (x, y) in
+        [0, 1].
+      attention_weights: ``(B, Lq, M, L, P)``, softmaxed over ``L*P``.
+
+    Returns:
+      ``(B, Lq, M*D)``.
+    """
+    B, S, M, D = value.shape
+    _, Lq, _, L, P, _ = sampling_locations.shape
+    assert L == len(spatial_shapes)
+    assert S == sum(h * w for h, w in spatial_shapes)
+
+    start = 0
+    sampled_levels = []
+    for lvl, (H, W) in enumerate(spatial_shapes):
+        v = value[:, start:start + H * W]                    # (B, HW, M, D)
+        start += H * W
+        # (B, HW, M, D) → (B*M, H, W, D)
+        v = v.transpose(0, 2, 1, 3).reshape(B * M, H, W, D)
+        loc = sampling_locations[:, :, :, lvl]               # (B, Lq, M, P, 2)
+        px = loc[..., 0] * W - 0.5                           # align=False
+        py = loc[..., 1] * H - 0.5
+        coords = jnp.stack([px, py], axis=-1)
+        coords = coords.transpose(0, 2, 1, 3, 4).reshape(B * M, Lq * P, 2)
+        out = bilinear_sampler(v, coords)                    # (B*M, Lq*P, D)
+        sampled_levels.append(out.reshape(B, M, Lq, P, D))
+
+    # (B, M, Lq, L, P, D)
+    sampled = jnp.stack(sampled_levels, axis=3)
+    weights = attention_weights.transpose(0, 2, 1, 3, 4)     # (B, M, Lq, L, P)
+    out = jnp.einsum("bmqlpd,bmqlp->bqmd", sampled, weights)
+    return out.reshape(B, Lq, M * D)

@@ -146,6 +146,15 @@ def convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return up.reshape(B, 8 * H, 8 * W, 2)
 
 
+def inverse_sigmoid(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Clamped logit (reference ``core/utils/misc.py:512-516``) — the
+    working space of the sparse model's iterative flow refinement."""
+    x = jnp.clip(x, 0.0, 1.0)
+    x1 = jnp.maximum(x, eps)
+    x2 = jnp.maximum(1.0 - x, eps)
+    return jnp.log(x1 / x2)
+
+
 def avg_pool2x2(x: jnp.ndarray) -> jnp.ndarray:
     """2x2 stride-2 average pool (NHWC), the pyramid builder of
     ``CorrBlock`` (reference ``core/corr.py:24-27``)."""

@@ -1,0 +1,209 @@
+"""Training metrics: smoothed meters, periodic console status, scalar sinks.
+
+Equivalents of the reference's observability stack:
+
+* :class:`SmoothedValue` / :class:`MetricLogger` — the vendored DETR meters
+  (reference ``core/utils/misc.py:61-120, :193-280``), with the distributed
+  sync expressed as a jax ``process_allgather`` instead of
+  ``torch.distributed.all_reduce``.
+* :class:`TrainLogger` — the trainer's ``Logger`` (reference
+  ``train.py:127-168``): running means printed every ``SUM_FREQ`` steps with
+  the current LR, plus scalar time-series sinks. Scalars always stream to a
+  JSONL file (greppable, dependency-free); TensorBoard event files are
+  written too when ``torch.utils.tensorboard`` is importable (torch-cpu is
+  an allowed host-side dependency, used exactly like the reference uses
+  ``SummaryWriter``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict, deque
+from typing import Dict, Iterable, Optional
+
+
+class SmoothedValue:
+    """Window-smoothed scalar with global average
+    (reference ``core/utils/misc.py:61-120``)."""
+
+    def __init__(self, window_size: int = 20, fmt: str = "{median:.4f} "
+                 "({global_avg:.4f})"):
+        self.deque: deque = deque(maxlen=window_size)
+        self.total = 0.0
+        self.count = 0
+        self.fmt = fmt
+
+    def update(self, value, n: int = 1):
+        value = float(value)
+        self.deque.append(value)
+        self.count += n
+        self.total += value * n
+
+    def synchronize_between_processes(self):
+        """Pool count/total across hosts (reference ``:79-90``); no-op for
+        single-process runs."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        arr = multihost_utils.process_allgather(
+            np.asarray([self.count, self.total], np.float64))
+        self.count = int(arr[:, 0].sum())
+        self.total = float(arr[:, 1].sum())
+
+    @property
+    def median(self) -> float:
+        d = sorted(self.deque)
+        return d[len(d) // 2] if d else 0.0
+
+    @property
+    def avg(self) -> float:
+        return sum(self.deque) / len(self.deque) if self.deque else 0.0
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    @property
+    def max(self) -> float:
+        return max(self.deque) if self.deque else 0.0
+
+    @property
+    def value(self) -> float:
+        return self.deque[-1] if self.deque else 0.0
+
+    def __str__(self):
+        return self.fmt.format(median=self.median, avg=self.avg,
+                               global_avg=self.global_avg, max=self.max,
+                               value=self.value)
+
+
+class MetricLogger:
+    """Meter collection + timed iteration logging
+    (reference ``core/utils/misc.py:193-280``)."""
+
+    def __init__(self, delimiter: str = "  "):
+        self.meters: Dict[str, SmoothedValue] = defaultdict(SmoothedValue)
+        self.delimiter = delimiter
+
+    def update(self, **kwargs):
+        for k, v in kwargs.items():
+            self.meters[k].update(float(v))
+
+    def __getattr__(self, attr):
+        if attr in self.meters:
+            return self.meters[attr]
+        raise AttributeError(attr)
+
+    def __str__(self):
+        return self.delimiter.join(
+            f"{name}: {meter}" for name, meter in self.meters.items())
+
+    def synchronize_between_processes(self):
+        for meter in self.meters.values():
+            meter.synchronize_between_processes()
+
+    def add_meter(self, name: str, meter: SmoothedValue):
+        self.meters[name] = meter
+
+    def log_every(self, iterable: Iterable, print_freq: int,
+                  header: str = ""):
+        i = 0
+        start = time.time()
+        iter_time = SmoothedValue(fmt="{avg:.4f}")
+        data_time = SmoothedValue(fmt="{avg:.4f}")
+        end = time.time()
+        for obj in iterable:
+            data_time.update(time.time() - end)
+            yield obj
+            iter_time.update(time.time() - end)
+            if i % print_freq == 0:
+                print(self.delimiter.join([
+                    header, f"[{i}]", str(self),
+                    f"time: {iter_time}", f"data: {data_time}"]))
+            i += 1
+            end = time.time()
+        total = time.time() - start
+        print(f"{header} Total time: {total:.1f}s "
+              f"({total / max(i, 1):.4f} s / it)")
+
+
+class _JsonlWriter:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def add_scalars(self, step: int, scalars: Dict[str, float]):
+        self._f.write(json.dumps({"step": step, **scalars}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+
+class TrainLogger:
+    """The trainer's periodic status printer + scalar sinks
+    (reference ``train.py:127-168``).
+
+    Args:
+      log_dir: run directory; scalars go to ``log_dir/scalars.jsonl`` and
+        (if available) TensorBoard event files.
+      sum_freq: console/scalar flush period (reference SUM_FREQ=100).
+    """
+
+    def __init__(self, log_dir: str, sum_freq: int = 100,
+                 tensorboard: bool = True):
+        self.log_dir = log_dir
+        self.sum_freq = sum_freq
+        self.total_steps = 0
+        self.running: Dict[str, float] = {}
+        self._jsonl = _JsonlWriter(os.path.join(log_dir, "scalars.jsonl"))
+        self._tb = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(log_dir=log_dir)
+            except Exception:
+                self._tb = None
+        self._t0 = time.time()
+
+    def _status(self, lr: Optional[float]) -> str:
+        rate = self.sum_freq / max(time.time() - self._t0, 1e-9)
+        parts = [f"[{self.total_steps + 1:6d}"]
+        parts.append(f"lr {lr:10.7f}]" if lr is not None else "]")
+        parts += [f"{k}: {v / self.sum_freq:10.4f}"
+                  for k, v in sorted(self.running.items())]
+        parts.append(f"({rate:.2f} it/s)")
+        return " ".join(parts)
+
+    def push(self, metrics: Dict[str, float], lr: Optional[float] = None):
+        """Accumulate one step's metrics; print + flush every sum_freq."""
+        self.total_steps += 1
+        for k, v in metrics.items():
+            self.running[k] = self.running.get(k, 0.0) + float(v)
+        if self.total_steps % self.sum_freq == 0:
+            print(self._status(lr))
+            scalars = {k: v / self.sum_freq for k, v in self.running.items()}
+            if lr is not None:
+                scalars["lr"] = lr
+            self.write_dict(scalars)
+            self.running = {}
+            self._t0 = time.time()
+
+    def write_dict(self, results: Dict[str, float],
+                   step: Optional[int] = None):
+        step = step if step is not None else self.total_steps
+        self._jsonl.add_scalars(step, {k: float(v)
+                                       for k, v in results.items()})
+        if self._tb is not None:
+            for k, v in results.items():
+                self._tb.add_scalar(k, float(v), step)
+
+    def close(self):
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

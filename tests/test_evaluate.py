@@ -1,0 +1,194 @@
+"""Evaluation-harness tests over synthetic on-disk datasets.
+
+Builds miniature FlyingChairs / Sintel / KITTI trees in tmp dirs, drives the
+validators with controlled predictors (so expected EPE / F1 are known in
+closed form), checks the submission writers' file outputs round-trip, and
+smoke-tests the jitted ``FlowPredictor`` on the real model.
+"""
+
+import os.path as osp
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from raft_tpu import evaluate
+from raft_tpu.data import frame_utils
+
+H, W = 40, 64                 # divisible by 8: no padding needed for chairs
+FLOW_U, FLOW_V = 1.5, -0.75
+
+
+def _img(rng):
+    return rng.integers(0, 255, (H, W, 3), np.uint8)
+
+
+def _const_flow():
+    f = np.zeros((H, W, 2), np.float32)
+    f[..., 0], f[..., 1] = FLOW_U, FLOW_V
+    return f
+
+
+@pytest.fixture
+def chairs_root(tmp_path, rng):
+    root = tmp_path / "chairs"
+    (root / "data").mkdir(parents=True)
+    for i in range(2):
+        for j in (1, 2):
+            Image.fromarray(_img(rng)).save(
+                root / "data" / f"{i:05d}_img{j}.ppm")
+        frame_utils.write_flo(str(root / "data" / f"{i:05d}_flow.flo"),
+                              _const_flow())
+    split = tmp_path / "split.txt"
+    split.write_text("2\n2\n")
+    return str(root), str(split)
+
+
+@pytest.fixture
+def sintel_root(tmp_path, rng):
+    root = tmp_path / "sintel"
+    for split in ("training", "test"):
+        for scene in ("alley_1",):
+            (root / split / "clean" / scene).mkdir(parents=True)
+            (root / split / "final" / scene).mkdir(parents=True)
+            n = 3
+            for i in range(1, n + 1):
+                for dstype in ("clean", "final"):
+                    Image.fromarray(_img(rng)).save(
+                        root / split / dstype / scene / f"frame_{i:04d}.png")
+            if split == "training":
+                (root / split / "flow" / scene).mkdir(parents=True)
+                (root / split / "occlusions" / scene).mkdir(parents=True)
+                for i in range(1, n):
+                    frame_utils.write_flo(
+                        str(root / split / "flow" / scene /
+                            f"frame_{i:04d}.flo"), _const_flow())
+                    occ = np.zeros((H, W), np.uint8)
+                    occ[: H // 2] = 255      # top half occluded
+                    Image.fromarray(occ).save(
+                        root / split / "occlusions" / scene /
+                        f"frame_{i:04d}.png")
+    return str(root)
+
+
+@pytest.fixture
+def kitti_root(tmp_path, rng):
+    root = tmp_path / "kitti"
+    # deliberately NOT /8-divisible → exercises the kitti padder mode
+    kh, kw = H - 3, W - 5
+    for split in ("training", "testing"):
+        (root / split / "image_2").mkdir(parents=True)
+        for i in range(2):
+            for t in ("10", "11"):
+                Image.fromarray(
+                    np.asarray(_img(rng))[:kh, :kw]).save(
+                        root / split / "image_2" / f"{i:06d}_{t}.png")
+    (root / "training" / "flow_occ").mkdir(parents=True)
+    for i in range(2):
+        frame_utils.write_flow_kitti(
+            str(root / "training" / "flow_occ" / f"{i:06d}_10.png"),
+            _const_flow()[:kh, :kw])
+    return str(root)
+
+
+class ConstPredictor:
+    """Predicts ground truth plus a fixed offset — EPE is known exactly."""
+
+    def __init__(self, du=0.0, dv=0.0):
+        self.du, self.dv = du, dv
+
+    def __call__(self, image1, image2, flow_init=None):
+        h, w = image1.shape[:2]
+        up = np.zeros((h, w, 2), np.float32)
+        up[..., 0] = FLOW_U + self.du
+        up[..., 1] = FLOW_V + self.dv
+        low = up[::8, ::8] / 8.0
+        return low, up
+
+
+def test_validate_chairs_exact_epe(chairs_root):
+    root, split_file = chairs_root
+    import raft_tpu.data.datasets as ds
+
+    class Chairs(ds.FlyingChairs):
+        def __init__(self, split="validation", root=None):
+            super().__init__(split=split, root=root, split_file=split_file)
+
+    orig = ds.FlyingChairs
+    ds.FlyingChairs = Chairs
+    try:
+        res = evaluate.validate_chairs(ConstPredictor(), root=root)
+        assert res["chairs"] == pytest.approx(0.0, abs=1e-6)
+        res = evaluate.validate_chairs(ConstPredictor(du=3.0, dv=4.0),
+                                       root=root)
+        assert res["chairs"] == pytest.approx(5.0, abs=1e-5)
+    finally:
+        ds.FlyingChairs = orig
+
+
+def test_validate_sintel_and_occ(sintel_root):
+    res = evaluate.validate_sintel(ConstPredictor(du=1.0), root=sintel_root)
+    assert res["clean"] == pytest.approx(1.0, abs=1e-5)
+    assert res["final"] == pytest.approx(1.0, abs=1e-5)
+
+    res = evaluate.validate_sintel_occ(ConstPredictor(du=2.0),
+                                       root=sintel_root)
+    # albedo pass images don't exist in the fixture; clean/final do.
+    assert res["clean"] == pytest.approx(2.0, abs=1e-5)
+    assert res["clean_occ"] == pytest.approx(2.0, abs=1e-5)
+    assert res["clean_noc"] == pytest.approx(2.0, abs=1e-5)
+
+
+def test_validate_kitti_epe_f1(kitti_root):
+    res = evaluate.validate_kitti(ConstPredictor(), root=kitti_root)
+    assert res["kitti-epe"] == pytest.approx(0.0, abs=1e-5)
+    assert res["kitti-f1"] == pytest.approx(0.0)
+
+    # offset 6px: epe=6 > 3 and 6/|gt|≈3.6 > 0.05 everywhere → F1 = 100%
+    res = evaluate.validate_kitti(ConstPredictor(du=6.0), root=kitti_root)
+    assert res["kitti-epe"] == pytest.approx(6.0, abs=1e-4)
+    assert res["kitti-f1"] == pytest.approx(100.0)
+
+
+def test_sintel_submission_writes_flo(sintel_root, tmp_path):
+    out = tmp_path / "submission"
+    evaluate.create_sintel_submission(ConstPredictor(), warm_start=True,
+                                      output_path=str(out), root=sintel_root)
+    f = out / "clean" / "alley_1" / "frame0001.flo"
+    assert f.exists()
+    flow = frame_utils.read_flo(str(f))
+    assert flow.shape == (H, W, 2)
+    np.testing.assert_allclose(flow[..., 0], FLOW_U, atol=1e-6)
+
+
+def test_kitti_submission_writes_png(kitti_root, tmp_path):
+    out = tmp_path / "kitti_sub"
+    evaluate.create_kitti_submission(ConstPredictor(), output_path=str(out),
+                                     root=kitti_root)
+    f = out / "000000_10.png"
+    assert f.exists()
+    flow, valid = frame_utils.read_flow_kitti(str(f))
+    assert flow.shape == (H - 3, W - 5, 2)
+    np.testing.assert_allclose(flow[..., 0], FLOW_U, atol=1 / 64.0)
+    assert valid.min() == 1
+
+
+def test_flow_predictor_real_model(rng):
+    import jax
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    cfg = RAFTConfig(small=True, iters=2)
+    model = RAFT(cfg)
+    k = jax.random.PRNGKey(0)
+    im = np.asarray(rng.uniform(0, 255, (64, 96, 3)), np.float32)
+    variables = model.init({"params": k, "dropout": k},
+                           im[None], im[None], iters=1)
+    pred = evaluate.FlowPredictor(model, variables, iters=2)
+    low, up = pred(im, im)
+    assert low.shape == (8, 12, 2) and up.shape == (64, 96, 2)
+    # warm start path compiles a second executable and accepts flow_init
+    low2, up2 = pred(im, im, flow_init=low)
+    assert up2.shape == (64, 96, 2)
+    assert len(pred._cache) == 2

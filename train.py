@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Root entry point mirroring the reference repo layout: ``python train.py
+--stage chairs ...`` (see ``raft_tpu/train.py`` for the implementation)."""
+
+from raft_tpu.train import main
+
+if __name__ == "__main__":
+    main()
