@@ -111,3 +111,25 @@ def test_avg_pool2x2_matches_torch(rng):
     ref = F.avg_pool2d(t, 2, stride=2)
     np.testing.assert_allclose(
         ours, np.transpose(ref.numpy(), (0, 2, 3, 1)), atol=1e-6)
+
+
+def test_windowed_bilinear_matmul_matches_sampler(rng):
+    # The TPU fast path (separable dense-weight matmuls) must agree with the
+    # gather-based bilinear_sampler on every window point, including
+    # out-of-bounds coordinates (zeros padding).
+    from raft_tpu.ops.sampling import windowed_bilinear_matmul
+
+    Q, H, W, r = 5, 7, 11, 3
+    img = jnp.asarray(rng.standard_normal((Q, H, W, 1)), jnp.float32)
+    cx = jnp.asarray(rng.uniform(-3, W + 2, (Q,)), jnp.float32)
+    cy = jnp.asarray(rng.uniform(-3, H + 2, (Q,)), jnp.float32)
+
+    got = windowed_bilinear_matmul(img[..., 0], cx, cy, r)
+
+    off = jnp.arange(-r, r + 1, dtype=jnp.float32)
+    ox, oy = jnp.meshgrid(off, off, indexing="ij")
+    pts = jnp.stack([cx[:, None, None] + ox, cy[:, None, None] + oy],
+                    axis=-1)                               # (Q, w, w, 2)
+    ref = bilinear_sampler(img, pts)[..., 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
