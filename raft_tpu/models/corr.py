@@ -59,10 +59,14 @@ def _window_delta(radius: int) -> jnp.ndarray:
 def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                        num_levels: int = 4, scale: bool = True):
     """All-pairs volume → avg-pooled pyramid, each level
-    ``(B*H*W, H/2^l, W/2^l, 1)`` (reference ``core/corr.py:18-27``)."""
+    ``(B*H*W, H/2^l, W/2^l)`` (reference ``core/corr.py:18-27``).
+
+    Levels are 3D — a trailing singleton channel would be padded to a full
+    128-lane tile by TPU layout, inflating HBM footprint and every read.
+    """
     B, H, W, _ = fmap1.shape
     corr = all_pairs_correlation(fmap1, fmap2, scale=scale)
-    corr = corr.reshape(B * H * W, H, W, 1)
+    corr = corr.reshape(B * H * W, H, W)
     pyramid = [corr]
     for _ in range(num_levels - 1):
         corr = avg_pool2x2(corr)
@@ -92,7 +96,7 @@ def pyramid_lookup(pyramid, coords: jnp.ndarray, radius: int,
     for lvl, corr in enumerate(pyramid):
         centroid = flat / (2 ** lvl) if rescale else flat
         sampled = windowed_bilinear_matmul(
-            corr[..., 0], centroid[:, 0], centroid[:, 1], radius)
+            corr, centroid[:, 0], centroid[:, 1], radius)
         out.append(sampled.reshape(B, H, W, -1))
     return jnp.concatenate(out, axis=-1)
 

@@ -42,20 +42,41 @@ class _UpdateStep(nn.Module):
         else:
             self.update_block = BasicUpdateBlock(self.config.hdim, dtype)
 
-    def __call__(self, carry, corr_state, inp, coords0):
-        net, coords1 = carry
+    def __call__(self, carry, compute_up, corr_state, inp, coords0):
+        """``compute_up``: Python ``True`` (every iteration upsamples —
+        training) or a traced per-iteration bool (``test_mode``: only the
+        final iteration pays for the mask head + convex upsampling)."""
+        net, coords1 = carry[0], carry[1]
         coords1 = jax.lax.stop_gradient(coords1)
         corr = _lookup(self.config, corr_state, coords1)
         corr = corr.astype(net.dtype)
         flow = (coords1 - coords0).astype(net.dtype)
-        net, up_mask, delta_flow = self.update_block(net, inp, corr, flow)
+        net, up_mask, delta_flow = self.update_block(
+            net, inp, corr, flow, compute_mask=compute_up)
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         new_flow = coords1 - coords0
-        if up_mask is None:
-            flow_up = upflow8(new_flow)
-        else:
-            flow_up = convex_upsample(new_flow, up_mask.astype(jnp.float32))
-        return (net, coords1), flow_up
+
+        def _upsample(nf_mask):
+            nf, m = nf_mask
+            if m is None:
+                return upflow8(nf)
+            return convex_upsample(nf, m.astype(jnp.float32))
+
+        if isinstance(compute_up, bool) or self.is_initializing():
+            # Training / init: every iteration's upsampled flow is a scan
+            # output (the sequence loss consumes all of them).
+            flow_up = _upsample((new_flow, up_mask))
+            return (net, coords1), flow_up
+
+        # test_mode: only the flagged (last) iteration upsamples, and the
+        # result rides in the carry — stacking `iters` full-resolution
+        # outputs would cost iters x (B, 8H, 8W, 2) HBM for buffers of
+        # which only the last is read.
+        net_prev_up = carry[2]
+        flow_up = jax.lax.cond(
+            compute_up, _upsample, lambda _: net_prev_up,
+            (new_flow, up_mask))
+        return (net, coords1, flow_up), ()
 
 
 def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
@@ -148,17 +169,36 @@ class RAFT(nn.Module):
         if flow_init is not None:
             coords1 = coords1 + flow_init
 
+        # In test_mode only the last iteration computes the (expensive)
+        # upsampling-mask head and convex upsampling; training needs every
+        # intermediate upsampled flow for the sequence loss.
+        last_only = test_mode and not self.is_initializing()
+        if last_only:
+            flags = jnp.arange(iters) == iters - 1
+            flags_axis = 0
+            B8 = image1.shape[0]
+            carry = (net, coords1,
+                     jnp.zeros((B8, 8 * H8, 8 * W8, 2), jnp.float32))
+        else:
+            flags = True
+            flags_axis = nn.broadcast
+            carry = (net, coords1)
         scan = nn.scan(
             _UpdateStep,
             variable_broadcast="params",
             split_rngs={"params": False},
-            in_axes=nn.broadcast,
+            in_axes=(flags_axis, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
         )(cfg, name="update")
-        (net, coords1), flow_predictions = scan(
-            (net, coords1), corr_state, inp, coords0)
+        carry, flow_predictions = scan(
+            carry, flags, corr_state, inp, coords0)
 
+        if last_only:
+            net, coords1, flow_up = carry
+            return coords1 - coords0, flow_up
+        net, coords1 = carry
         if test_mode:
+            # init-time test_mode (static path): all iterations upsample.
             return coords1 - coords0, flow_predictions[-1]
         return flow_predictions

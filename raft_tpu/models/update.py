@@ -136,7 +136,8 @@ class SmallUpdateBlock(nn.Module):
         self.gru = ConvGRU(self.hidden_dim, self.dtype)
         self.flow_head = FlowHead(128, self.dtype)
 
-    def __call__(self, net, inp, corr, flow):
+    def __call__(self, net, inp, corr, flow, compute_mask=True):
+        del compute_mask  # no mask head in the small model
         motion_features = self.encoder(flow, corr)
         inp = jnp.concatenate([inp, motion_features], axis=-1)
         net = self.gru(net, inp)
@@ -158,11 +159,25 @@ class BasicUpdateBlock(nn.Module):
         self.mask_conv1 = nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)
         self.mask_conv2 = nn.Conv(64 * 9, (1, 1), dtype=self.dtype)
 
-    def __call__(self, net, inp, corr, flow):
+    def __call__(self, net, inp, corr, flow, compute_mask=True):
+        """``compute_mask`` may be a traced scalar bool: the mask head then
+        runs under ``nn.cond`` so iterations that don't need the convex-
+        upsampling mask (all but the last in ``test_mode``) skip its two
+        convolutions — they are ~40% of the per-iteration FLOPs."""
         motion_features = self.encoder(flow, corr)
         inp = jnp.concatenate([inp, motion_features], axis=-1)
         net = self.gru(net, inp)
         delta_flow = self.flow_head(net)
+
         # 0.25 balances gradients into the mask head (core/update.py:133).
-        mask = 0.25 * self.mask_conv2(nn.relu(self.mask_conv1(net)))
+        def _mask(mdl, n):
+            return 0.25 * mdl.mask_conv2(nn.relu(mdl.mask_conv1(n)))
+
+        if isinstance(compute_mask, bool) or self.is_initializing():
+            mask = _mask(self, net)
+        else:
+            mask = nn.cond(compute_mask, _mask,
+                           lambda mdl, n: jnp.zeros(
+                               n.shape[:3] + (64 * 9,), n.dtype),
+                           self, net)
         return net, mask, delta_flow

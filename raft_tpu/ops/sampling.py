@@ -194,9 +194,11 @@ def inverse_sigmoid(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     return jnp.log(x1 / x2)
 
 
-def avg_pool2x2(x: jnp.ndarray) -> jnp.ndarray:
-    """2x2 stride-2 average pool (NHWC), the pyramid builder of
-    ``CorrBlock`` (reference ``core/corr.py:24-27``)."""
+def avg_pool2x2(x: jnp.ndarray, spatial_axes=(1, 2)) -> jnp.ndarray:
+    """2x2 stride-2 average pool over ``spatial_axes`` of an arbitrary-rank
+    array, the pyramid builder of ``CorrBlock`` (reference
+    ``core/corr.py:24-27``). Default axes fit NHWC; 3D ``(Q, H, W)``
+    correlation volumes pass ``spatial_axes=(1, 2)`` too."""
+    window = tuple(2 if i in spatial_axes else 1 for i in range(x.ndim))
     return jax.lax.reduce_window(
-        x, 0.0, jax.lax.add, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
-    ) * 0.25
+        x, 0.0, jax.lax.add, window, window, "VALID") * 0.25

@@ -88,3 +88,22 @@ def test_gradients_flow(small_model):
     g = jax.grad(loss)(v["params"])
     norms = [float(jnp.abs(x).max()) for x in jax.tree.leaves(g)]
     assert max(norms) > 0
+
+
+def test_large_model_gated_test_mode_matches_training_path():
+    """test_mode runs the mask head + convex upsampling only on the last
+    iteration (traced nn.cond/lax.cond path); its output must equal the
+    ungated training path's final prediction exactly."""
+    cfg = RAFTConfig(iters=4)      # large model: mask head present
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(3)
+    img1 = jax.random.uniform(rng, (1, 32, 48, 3)) * 255.0
+    img2 = jax.random.uniform(jax.random.fold_in(rng, 1),
+                              (1, 32, 48, 3)) * 255.0
+    vs = model.init({"params": rng, "dropout": rng}, img1, img2, iters=1)
+
+    preds = model.apply(vs, img1, img2)                 # ungated, all iters
+    low, up = model.apply(vs, img1, img2, test_mode=True)   # gated
+    np.testing.assert_allclose(np.asarray(up), np.asarray(preds[-1]),
+                               rtol=1e-6, atol=1e-5)
+    assert up.shape == (1, 32, 48, 2)
