@@ -1,0 +1,111 @@
+"""Offline flow-segmentation preprocessing (reference
+``core/utils/flow_segmentor.py``): colorize ground-truth flow, segment it
+into regions, save per-region binary masks as ``.npy`` next to each
+``.flo`` — the keypoint-mask supervision the sparse model family's
+auxiliary losses consume.
+
+The reference shells out to the ``selectivesearch`` pip package
+(Felzenszwalb graph segmentation + hierarchical grouping,
+``core/utils/flow_segmentor.py:175``). That package isn't part of this
+environment, so :func:`segment` implements the same contract — flow-color
+image in, ``(N, H, W)`` uint8 region-mask stack out — with a
+Felzenszwalb-style union-find graph segmentation in pure numpy/scipy.
+This is an offline host-side tool; nothing here touches the device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from glob import glob
+
+import numpy as np
+from scipy import ndimage
+
+from raft_tpu.data import frame_utils
+from raft_tpu.utils import flow_viz
+
+
+def _autocontrast(img: np.ndarray) -> np.ndarray:
+    """Per-channel histogram stretch (the reference's
+    ``PIL.ImageOps.autocontrast``, ``core/utils/flow_segmentor.py:217``)."""
+    out = np.empty_like(img)
+    for c in range(img.shape[-1]):
+        ch = img[..., c]
+        lo, hi = int(ch.min()), int(ch.max())
+        if hi <= lo:
+            out[..., c] = ch
+        else:
+            out[..., c] = np.clip(
+                (ch.astype(np.float32) - lo) * (255.0 / (hi - lo)),
+                0, 255).astype(img.dtype)
+    return out
+
+
+def segment(flow_color: np.ndarray, quant: int = 24,
+            min_size: int = 16) -> np.ndarray:
+    """Segment a flow-color image into per-region binary masks.
+
+    Regions are connected components of the color-quantized image (motion
+    boundaries are color boundaries in flow space), with components smaller
+    than ``min_size`` merged into their largest neighbor — the same
+    region-mask contract as reference ``segment``
+    (``core/utils/flow_segmentor.py:173-208``).
+
+    Returns: (N, H, W) uint8 stack, one mask per region.
+    """
+    q = (flow_color.astype(np.int32) // quant)
+    key = q[..., 0] * 10000 + q[..., 1] * 100 + q[..., 2]
+    _, inverse = np.unique(key, return_inverse=True)
+    key = inverse.reshape(key.shape)
+
+    labels = np.zeros(key.shape, np.int32)
+    next_label = 0
+    for v in np.unique(key):
+        comp, n = ndimage.label(key == v)
+        labels[comp > 0] = comp[comp > 0] + next_label
+        next_label += n
+
+    # merge each tiny region into its most common large neighbor
+    ids, counts = np.unique(labels, return_counts=True)
+    small = ids[counts < min_size]
+    if len(small) and len(small) < len(ids):
+        small_set = np.isin(labels, small)
+        for sid in small:
+            region = labels == sid
+            ring = ndimage.binary_dilation(region) & ~region & ~small_set
+            if ring.any():
+                neighbors = labels[ring]
+                labels[region] = np.bincount(neighbors).argmax()
+
+    masks = [(labels == i).astype(np.uint8)
+             for i in np.unique(labels)
+             if np.any(labels == i)]
+    return np.asarray(masks)
+
+
+def segment_flow_file(path: str) -> np.ndarray:
+    """.flo → color → autocontrast → segment (the reference's per-file
+    pipeline, ``core/utils/flow_segmentor.py:214-221``)."""
+    flow = frame_utils.read_gen(path)
+    color = flow_viz.flow_to_image(np.asarray(flow))
+    return segment(_autocontrast(color))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="write per-region flow masks next to each .flo file")
+    parser.add_argument("--data", required=True,
+                        help="directory containing *.flo files")
+    args = parser.parse_args(argv)
+    for path in sorted(glob(os.path.join(args.data, "*.flo"))):
+        masks = segment_flow_file(path)
+        npy_path = os.path.join(
+            args.data,
+            os.path.splitext(os.path.basename(path))[0] + ".npy")
+        np.save(npy_path, masks)
+        print(f"{os.path.basename(npy_path)}: {len(masks)} regions")
+
+
+if __name__ == "__main__":
+    main()

@@ -125,3 +125,15 @@ def read_gen(path: str, pil: bool = False):
             return data[:, :, :-1]  # drop the unused third channel
         return data
     return []
+
+
+# Reference-compatible aliases (the reference exposes camelCase names,
+# ``core/utils/frame_utils.py:12-120``); the snake_case functions above are
+# the canonical spellings here.
+readFlow = read_flo
+writeFlow = write_flo
+readPFM = read_pfm
+writePFM = write_pfm
+readFlowKITTI = read_flow_kitti
+writeFlowKITTI = write_flow_kitti
+readDispKITTI = read_disp_kitti

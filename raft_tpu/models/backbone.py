@@ -1,0 +1,243 @@
+"""DETR ResNet backbone family (reference ``core/backbone.py``).
+
+Rebuilt NHWC/flax: :class:`FrozenBatchNorm` (fixed statistics + affine,
+reference ``core/backbone.py:27-63``), a bottleneck ResNet-50 body
+returning the layer2/3/4 pyramid at strides 8/16/32 with channels
+512/1024/2048 (``:66-110``), sine/learned position embeddings (the
+reference's ``build_position_encoding`` import is commented out at
+``core/backbone.py:24`` — the standard DETR embeddings are supplied here so
+:class:`Joiner` is functional), and :class:`Joiner` pairing the two
+(``:113-130``).
+
+The reference marks this stack "imported by ours.py but unused at runtime"
+(SURVEY.md §2.3); it is provided as a working capability: feature pyramids
+for the sparse-keypoint family when driven from raw images.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.misc import NestedTensor, downsample_mask
+
+
+class FrozenBatchNorm(nn.Module):
+    """BatchNorm with *fixed* statistics and affine parameters (reference
+    ``core/backbone.py:27-63``). All four tensors are parameters so
+    torchvision weights convert 1:1, but gradients are cut — matching the
+    frozen-buffer semantics."""
+
+    features: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param("weight", nn.initializers.ones,
+                            (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        mean = self.param("running_mean", nn.initializers.zeros,
+                          (self.features,))
+        var = self.param("running_var", nn.initializers.ones,
+                         (self.features,))
+        weight, bias, mean, var = (jax.lax.stop_gradient(t) for t in
+                                   (weight, bias, mean, var))
+        scale = weight * jax.lax.rsqrt(var + self.eps)
+        return x * scale + (bias - mean * scale)
+
+
+class _Bottleneck(nn.Module):
+    """ResNet bottleneck: 1x1 reduce → 3x3 → 1x1 expand (x4), frozen BN."""
+
+    planes: int
+    stride: int = 1
+    dilation: int = 1
+    downsample: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype,
+                      name="conv1")(x)
+        out = nn.relu(FrozenBatchNorm(self.planes, name="bn1")(out))
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride,
+                      padding=self.dilation,
+                      kernel_dilation=self.dilation, use_bias=False,
+                      dtype=self.dtype, name="conv2")(out)
+        out = nn.relu(FrozenBatchNorm(self.planes, name="bn2")(out))
+        out = nn.Conv(self.planes * 4, (1, 1), use_bias=False,
+                      dtype=self.dtype, name="conv3")(out)
+        out = FrozenBatchNorm(self.planes * 4, name="bn3")(out)
+        if self.downsample:
+            x = nn.Conv(self.planes * 4, (1, 1), strides=self.stride,
+                        use_bias=False, dtype=self.dtype,
+                        name="downsample_conv")(x)
+            x = FrozenBatchNorm(self.planes * 4, name="downsample_bn")(x)
+        return nn.relu(out + x)
+
+
+class ResNet50(nn.Module):
+    """Torchvision-topology ResNet-50 body returning the intermediate
+    pyramid ``{layer2, layer3, layer4}`` (the DETR
+    ``IntermediateLayerGetter`` selection, reference
+    ``core/backbone.py:76-77``)."""
+
+    blocks: Tuple[int, ...] = (3, 4, 6, 3)
+    dilation: bool = False      # replace layer4 stride with dilation
+    return_interm_layers: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(FrozenBatchNorm(64, name="bn1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        outs = []
+        planes = (64, 128, 256, 512)
+        for li, (n_blocks, p) in enumerate(zip(self.blocks, planes)):
+            stride = 1 if li == 0 else 2
+            dilation = 1
+            if self.dilation and li == 3:
+                stride, dilation = 1, 2
+            for bi in range(n_blocks):
+                x = _Bottleneck(
+                    p, stride=stride if bi == 0 else 1,
+                    dilation=dilation, downsample=(bi == 0),
+                    dtype=self.dtype, name=f"layer{li + 1}_{bi}")(x)
+            if li >= 1:
+                outs.append(x)
+        if self.return_interm_layers:
+            return tuple(outs)                 # strides 8, 16, 32
+        return (outs[-1],)
+
+
+class Backbone(nn.Module):
+    """ResNet backbone with frozen BatchNorm (reference
+    ``core/backbone.py:97-110``). ``strides``/``num_channels`` mirror the
+    reference's hard-coded resnet50 values."""
+
+    arch: str = "resnet50"      # ("name" is reserved by flax modules)
+    return_interm_layers: bool = True
+    dilation: bool = False
+    dtype: Any = jnp.float32
+
+    @property
+    def strides(self):
+        s = [8, 16, 32] if self.return_interm_layers else [32]
+        if self.dilation:
+            s[-1] //= 2
+        return s
+
+    @property
+    def num_channels(self):
+        return ([512, 1024, 2048] if self.return_interm_layers
+                else [2048])
+
+    @nn.compact
+    def __call__(self, tensor_list: NestedTensor):
+        assert self.arch == "resnet50", "channel counts are hard-coded"
+        xs = ResNet50(dilation=self.dilation,
+                      return_interm_layers=self.return_interm_layers,
+                      dtype=self.dtype, name="body")(tensor_list.tensors)
+        out = []
+        for x in xs:
+            mask = None
+            if tensor_list.mask is not None:
+                mask = downsample_mask(tensor_list.mask,
+                                       x.shape[1], x.shape[2])
+            out.append(NestedTensor(x, mask))
+        return out
+
+
+class PositionEmbeddingSine(nn.Module):
+    """Standard DETR sine position embedding over valid pixels."""
+
+    num_pos_feats: int = 64
+    temperature: int = 10000
+    normalize: bool = True
+    scale: Optional[float] = None
+
+    def __call__(self, x: NestedTensor):
+        t, mask = x.tensors, x.mask
+        B, H, W, _ = t.shape
+        if mask is None:
+            mask = jnp.zeros((B, H, W), bool)
+        not_mask = ~mask
+        y_embed = jnp.cumsum(not_mask.astype(jnp.float32), axis=1)
+        x_embed = jnp.cumsum(not_mask.astype(jnp.float32), axis=2)
+        if self.normalize:
+            scale = self.scale if self.scale is not None else 2 * math.pi
+            eps = 1e-6
+            y_embed = y_embed / (y_embed[:, -1:, :] + eps) * scale
+            x_embed = x_embed / (x_embed[:, :, -1:] + eps) * scale
+        dim_t = jnp.arange(self.num_pos_feats, dtype=jnp.float32)
+        dim_t = self.temperature ** (2 * (dim_t // 2) / self.num_pos_feats)
+        pos_x = x_embed[..., None] / dim_t
+        pos_y = y_embed[..., None] / dim_t
+        pos_x = jnp.stack([jnp.sin(pos_x[..., 0::2]),
+                           jnp.cos(pos_x[..., 1::2])], -1).reshape(
+                               B, H, W, -1)
+        pos_y = jnp.stack([jnp.sin(pos_y[..., 0::2]),
+                           jnp.cos(pos_y[..., 1::2])], -1).reshape(
+                               B, H, W, -1)
+        return jnp.concatenate([pos_y, pos_x], axis=-1)
+
+
+class PositionEmbeddingLearned(nn.Module):
+    """Learned row/column position embedding (DETR variant)."""
+
+    num_pos_feats: int = 64
+    max_size: int = 50
+
+    @nn.compact
+    def __call__(self, x: NestedTensor):
+        t = x.tensors
+        B, H, W, _ = t.shape
+        row = self.param("row_embed", nn.initializers.uniform(1.0),
+                         (self.max_size, self.num_pos_feats))
+        col = self.param("col_embed", nn.initializers.uniform(1.0),
+                         (self.max_size, self.num_pos_feats))
+        pos = jnp.concatenate([
+            jnp.broadcast_to(col[None, :W], (H, W, self.num_pos_feats)),
+            jnp.broadcast_to(row[:H, None], (H, W, self.num_pos_feats)),
+        ], axis=-1)
+        return jnp.broadcast_to(pos[None], (B,) + pos.shape)
+
+
+class Joiner(nn.Module):
+    """Backbone + position embedding (reference
+    ``core/backbone.py:113-130``): returns the feature pyramid and the
+    matching position embeddings."""
+
+    backbone: nn.Module
+    position_embedding: nn.Module
+
+    def __call__(self, tensor_list: NestedTensor):
+        xs = self.backbone(tensor_list)
+        out, pos = [], []
+        for x in xs:
+            out.append(x)
+            pos.append(self.position_embedding(x).astype(
+                x.tensors.dtype))
+        return out, pos
+
+
+def build_backbone(num_feature_levels: int = 3, dilation: bool = False,
+                   position_embedding: str = "sine",
+                   hidden_dim: int = 256, dtype: Any = jnp.float32):
+    """Assemble Backbone + position embedding (reference
+    ``core/backbone.py:133-139``)."""
+    pos: nn.Module
+    if position_embedding == "sine":
+        pos = PositionEmbeddingSine(hidden_dim // 2)
+    else:
+        pos = PositionEmbeddingLearned(hidden_dim // 2)
+    backbone = Backbone(return_interm_layers=num_feature_levels > 1,
+                        dilation=dilation, dtype=dtype)
+    return Joiner(backbone=backbone, position_embedding=pos)

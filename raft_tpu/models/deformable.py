@@ -239,3 +239,278 @@ class NerfPositionalEncoding(nn.Module):
             [jnp.sin(b * math.pi * x) for b in bases]
             + [jnp.cos(b * math.pi * x) for b in bases], axis=-1)
         return jax.lax.stop_gradient(out)
+
+
+
+def normalized_center_grid(spatial_shapes):
+    """(1, sum(H*W), 2) pixel-center grid of every level, normalized to
+    [0, 1] in (x, y) order — the reference-point convention shared by the
+    encoder, decoder, and two-stage proposal machinery."""
+    refs = []
+    for h, w in spatial_shapes:
+        ry = (jnp.arange(h, dtype=jnp.float32) + 0.5) / h
+        rx = (jnp.arange(w, dtype=jnp.float32) + 0.5) / w
+        gy, gx = jnp.meshgrid(ry, rx, indexing="ij")
+        refs.append(jnp.stack([gx.reshape(-1), gy.reshape(-1)], -1))
+    return jnp.concatenate(refs, axis=0)[None]
+
+
+class DeformableTransformerEncoder(nn.Module):
+    """Stack of deformable encoder layers (reference
+    ``core/deformable.py:234-261``).
+
+    Reference points are the per-level pixel-center grid normalized to
+    [0, 1] — the convention :class:`MSDeformAttn` samples with. (The fork's
+    encoder passes *unnormalized* centers, ``core/deformable.py:245-249``,
+    which would sample only the top-left corner; that is fork drift away
+    from canonical Deformable-DETR, not behavior worth preserving.)
+    """
+
+    d_model: int = 256
+    d_ffn: int = 1024
+    num_layers: int = 6
+    dropout: float = 0.1
+    activation: str = "relu"
+    n_levels: int = 4
+    n_heads: int = 8
+    n_points: int = 4
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def get_reference_points(spatial_shapes: Sequence[Tuple[int, int]]):
+        """(1, sum(H*W), L, 2) normalized per-level center grid."""
+        ref = normalized_center_grid(spatial_shapes)       # (1, S, 2)
+        return jnp.broadcast_to(ref[:, :, None, :],
+                                (1, ref.shape[1], len(spatial_shapes), 2))
+
+    @nn.compact
+    def __call__(self, src, spatial_shapes: Sequence[Tuple[int, int]],
+                 pos=None, deterministic: bool = True):
+        reference_points = self.get_reference_points(spatial_shapes)
+        reference_points = jnp.broadcast_to(
+            reference_points, (src.shape[0],) + reference_points.shape[1:])
+        out = src
+        for i in range(self.num_layers):
+            out = DeformableTransformerEncoderLayer(
+                self.d_model, self.d_ffn, self.dropout, self.activation,
+                self.n_levels, self.n_heads, self.n_points, dtype=self.dtype,
+                name=f"layers_{i}")(out, pos, reference_points,
+                                    spatial_shapes, deterministic)
+        return out
+
+
+class DeformableTransformerDecoder(nn.Module):
+    """Stack of deformable decoder layers with the iterative-refinement
+    hook (reference ``core/deformable.py:348-405``).
+
+    ``num_flow_dims``: when > 0, a per-layer ``flow_embed`` MLP refines the
+    2-dim reference points in inverse-sigmoid space and the refined points
+    are ``stop_gradient``-ed before the next layer (reference ``:383-396``,
+    the ``reference_points.detach()``). Returns stacked per-layer outputs
+    and reference points when ``return_intermediate`` (reference default).
+    """
+
+    d_model: int = 256
+    d_ffn: int = 1024
+    num_layers: int = 6
+    dropout: float = 0.1
+    activation: str = "relu"
+    n_levels: int = 4
+    n_heads: int = 8
+    n_points: int = 4
+    return_intermediate: bool = True
+    num_flow_dims: int = 0
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def get_reference_points(spatial_shapes: Sequence[Tuple[int, int]]):
+        """(1, sum(H*W), 2) normalized center grid (reference ``:361-373``,
+        already squeezed of the level axis as ``DeformableTransformer``
+        does at ``:166``)."""
+        return normalized_center_grid(spatial_shapes)
+
+    @nn.compact
+    def __call__(self, tgt, reference_points, src,
+                 spatial_shapes: Sequence[Tuple[int, int]],
+                 query_pos=None, deterministic: bool = True):
+        from raft_tpu.ops.sampling import inverse_sigmoid
+
+        out = tgt
+        intermediate, intermediate_refs = [], []
+        for i in range(self.num_layers):
+            ref_input = reference_points[:, :, None]
+            if reference_points.shape[-1] == 2:
+                ref_input = jnp.broadcast_to(
+                    ref_input, ref_input.shape[:2]
+                    + (len(spatial_shapes), 2))
+            out = DeformableTransformerDecoderLayer(
+                self.d_model, self.d_ffn, self.dropout, self.activation,
+                self.n_levels, self.n_heads, self.n_points, dtype=self.dtype,
+                name=f"layers_{i}")(out, query_pos, ref_input, src, None,
+                                    spatial_shapes, deterministic)
+            if self.num_flow_dims:
+                delta = MLP(self.d_model, self.num_flow_dims, 3,
+                            dtype=self.dtype, name=f"flow_embed_{i}")(out)
+                new_refs = nn.sigmoid(
+                    delta[..., :2] + inverse_sigmoid(reference_points))
+                reference_points = jax.lax.stop_gradient(new_refs)
+            if self.return_intermediate:
+                intermediate.append(out)
+                intermediate_refs.append(reference_points)
+        if self.return_intermediate:
+            return jnp.stack(intermediate), jnp.stack(intermediate_refs)
+        return out, reference_points
+
+
+class DeformableTransformer(nn.Module):
+    """Full deformable transformer (reference ``core/deformable.py:23-188``).
+
+    ``__call__(srcs_01, srcs_02, pos_embeds)`` takes per-level NHWC feature
+    pyramids of both images plus positional embeddings and mirrors the
+    fork's dataflow: shared encoder over both pyramids, a dense decoder
+    whose queries are ``tgt_embed(memory_01)`` cross-attending into
+    ``memory_02`` (reference ``:160-174``), and a single-layer "prop"
+    decoder over ``memory_01`` with 50 extra learned queries (``:176-186``).
+    Returns ``(hs, init_reference, inter_references, prop_hs)``.
+
+    ``two_stage`` adds the canonical proposal machinery
+    (:meth:`gen_encoder_output_proposals`; the fork declares the flag but
+    never creates ``enc_output``/``enc_output_norm``, so its two-stage path
+    is dead code — here it is functional).
+    """
+
+    d_model: int = 128
+    n_heads: int = 8
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    d_ffn: int = 128 * 4
+    dropout: float = 0.1
+    activation: str = "relu"
+    return_intermediate_dec: bool = True
+    num_feature_levels: int = 3
+    dec_n_points: int = 4
+    enc_n_points: int = 4
+    two_stage: bool = False
+    two_stage_num_proposals: int = 300
+    num_prop_queries: int = 50
+    dtype: Any = jnp.float32
+
+    def get_proposal_pos_embed(self, proposals):
+        """Sine embedding of sigmoid-space proposals
+        (reference ``:76-90``)."""
+        num_pos_feats, temperature = 128, 10000
+        dim_t = jnp.arange(num_pos_feats, dtype=jnp.float32)
+        dim_t = temperature ** (2 * (dim_t // 2) / num_pos_feats)
+        proposals = nn.sigmoid(proposals) * (2 * math.pi)
+        pos = proposals[..., None] / dim_t
+        pos = jnp.stack([jnp.sin(pos[..., 0::2]), jnp.cos(pos[..., 1::2])],
+                        axis=-1)
+        return pos.reshape(*proposals.shape[:2], -1)
+
+    def gen_encoder_output_proposals(self, memory, memory_padding_mask,
+                                     spatial_shapes, enc_output,
+                                     enc_output_norm):
+        """Turn encoder memory into (proposal logits, proposal boxes)
+        (reference ``:92-122``), with inf-masking of invalid/padded cells."""
+        B = memory.shape[0]
+        proposals = []
+        for lvl, (h, w) in enumerate(spatial_shapes):
+            grid = normalized_center_grid([(h, w)])
+            wh = jnp.full_like(grid, 0.05 * (2.0 ** lvl))
+            proposals.append(jnp.broadcast_to(
+                jnp.concatenate([grid, wh], -1), (B, h * w, 4)))
+        output_proposals = jnp.concatenate(proposals, 1)
+        valid = jnp.all((output_proposals > 0.01)
+                        & (output_proposals < 0.99), -1, keepdims=True)
+        from raft_tpu.ops.sampling import inverse_sigmoid
+        output_proposals = inverse_sigmoid(output_proposals)
+        if memory_padding_mask is not None:
+            pad = memory_padding_mask[..., None]
+            output_proposals = jnp.where(pad, jnp.inf, output_proposals)
+            memory = jnp.where(pad, 0.0, memory)
+        output_proposals = jnp.where(valid, output_proposals, jnp.inf)
+        memory = jnp.where(valid, memory, 0.0)
+        return enc_output_norm(enc_output(memory)), output_proposals
+
+    @nn.compact
+    def __call__(self, srcs_01, srcs_02, pos_embeds,
+                 deterministic: bool = True):
+        L = self.num_feature_levels
+        assert len(srcs_01) == len(srcs_02) == len(pos_embeds) == L
+        spatial_shapes = tuple(
+            (s.shape[1], s.shape[2]) for s in srcs_01)
+        B = srcs_01[0].shape[0]
+
+        level_embed = self.param(
+            "level_embed", nn.initializers.normal(1.0),
+            (L, self.d_model))
+        flat = lambda seq: jnp.concatenate(
+            [s.reshape(B, -1, s.shape[-1]) for s in seq], axis=1)
+        src1, src2 = flat(srcs_01), flat(srcs_02)
+        pos = jnp.concatenate([
+            p.reshape(B, -1, p.shape[-1]) + level_embed[i]
+            for i, p in enumerate(pos_embeds)], axis=1)
+
+        encoder = DeformableTransformerEncoder(
+            self.d_model, self.d_ffn, self.num_encoder_layers, self.dropout,
+            self.activation, L, self.n_heads, self.enc_n_points,
+            dtype=self.dtype, name="encoder")
+        memory_01 = encoder(src1, spatial_shapes, pos, deterministic)
+        memory_02 = encoder(src2, spatial_shapes, pos, deterministic)
+
+        reference_points = jnp.broadcast_to(
+            DeformableTransformerDecoder.get_reference_points(
+                spatial_shapes),
+            (B, src1.shape[1], 2))
+        tgt = nn.Dense(self.d_model, dtype=self.dtype,
+                       name="tgt_embed")(memory_01)
+        hs, inter_references = DeformableTransformerDecoder(
+            self.d_model, self.d_ffn, self.num_decoder_layers, self.dropout,
+            self.activation, L, self.n_heads, self.dec_n_points,
+            self.return_intermediate_dec, dtype=self.dtype,
+            name="decoder")(tgt, reference_points, memory_02,
+                            spatial_shapes, pos, deterministic)
+
+        # "prop" decoder: dense queries + num_prop_queries learned ones
+        # over memory_01 (reference :176-186).
+        n = self.num_prop_queries
+        prop_query = self.param("prop_tgt_N_query",
+                                nn.initializers.uniform(1.0),
+                                (n, self.d_model))
+        prop_query_pos = self.param("prop_tgt_N_query_pos",
+                                    nn.initializers.uniform(1.0),
+                                    (n, self.d_model))
+        prop_tgt = nn.Dense(self.d_model, dtype=self.dtype,
+                            name="prop_tgt_embed")(memory_01)
+        prop_tgt = jnp.concatenate(
+            [prop_tgt, jnp.broadcast_to(prop_query[None],
+                                        (B, n, self.d_model))], axis=1)
+        prop_n_refs = nn.sigmoid(nn.Dense(
+            2, dtype=self.dtype, name="prop_N_reference_points")(
+                prop_query_pos))[None]
+        prop_refs = jnp.concatenate(
+            [reference_points,
+             jnp.broadcast_to(prop_n_refs, (B, n, 2))], axis=1)
+        prop_pos = jnp.concatenate(
+            [pos, jnp.broadcast_to(prop_query_pos[None],
+                                   (B, n, self.d_model))], axis=1)
+        prop_hs, _ = DeformableTransformerDecoder(
+            self.d_model, self.d_ffn, 1, self.dropout, self.activation,
+            L, self.n_heads, self.dec_n_points,
+            self.return_intermediate_dec, dtype=self.dtype,
+            name="prop_decoder")(prop_tgt, prop_refs, memory_01,
+                                 spatial_shapes, prop_pos, deterministic)
+
+        if self.two_stage:
+            enc_output = nn.Dense(self.d_model, dtype=self.dtype,
+                                  name="enc_output")
+            enc_output_norm = nn.LayerNorm(dtype=self.dtype,
+                                           name="enc_output_norm")
+            output_memory, output_proposals = \
+                self.gen_encoder_output_proposals(
+                    memory_01, None, spatial_shapes, enc_output,
+                    enc_output_norm)
+            proposal_pos = self.get_proposal_pos_embed(output_proposals)
+            return (hs, reference_points, inter_references, prop_hs,
+                    output_memory, output_proposals, proposal_pos)
+        return hs, reference_points, inter_references, prop_hs

@@ -14,6 +14,7 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import jax
 import optax
 
 from raft_tpu.config import TrainConfig
@@ -114,6 +115,30 @@ def make_schedule(cfg: TrainConfig) -> optax.Schedule:
     raise ValueError(f"unknown scheduler {cfg.scheduler!r}")
 
 
+def _decay_mask(params):
+    """True where AdamW weight decay applies.
+
+    ``FrozenBatchNorm`` keeps its fixed statistics/affine as params (so
+    torch weights convert 1:1) with gradients cut; decay must be masked
+    off them too or they would shrink by ``(1 - lr*wd)`` every step. In
+    torch they are buffers, which AdamW never touches — this mask restores
+    that semantics. A frozen-BN subtree is recognized by its
+    ``running_mean``/``running_var`` keys.
+    """
+    def mask_tree(tree):
+        if isinstance(tree, dict):
+            if "running_mean" in tree and "running_var" in tree:
+                return {k: False for k in tree}
+            return {k: mask_tree(v) for k, v in tree.items()}
+        return True
+
+    # unwrap FrozenDict-likes into plain dicts for optax
+    plain = jax.tree_util.tree_map(lambda x: x, params)
+    if hasattr(plain, "unfreeze"):
+        plain = plain.unfreeze()
+    return mask_tree(plain)
+
+
 def fetch_optimizer(cfg: TrainConfig,
                     schedule: Optional[optax.Schedule] = None
                     ) -> optax.GradientTransformation:
@@ -127,5 +152,5 @@ def fetch_optimizer(cfg: TrainConfig,
     return optax.chain(
         optax.clip_by_global_norm(cfg.clip),
         optax.adamw(sched, b1=0.9, b2=0.999, eps=cfg.epsilon,
-                    weight_decay=cfg.wdecay),
+                    weight_decay=cfg.wdecay, mask=_decay_mask),
     )

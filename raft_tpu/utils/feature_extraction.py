@@ -1,0 +1,82 @@
+"""Intermediate-feature extraction (reference
+``core/utils/feature_extraction.py`` — vendored torchvision FX
+``create_feature_extractor`` / ``get_graph_node_names``).
+
+The torch version rewrites the module graph with ``torch.fx``. The JAX
+equivalent needs no graph surgery: flax modules already expose every
+submodule's output through ``capture_intermediates``, so feature
+extraction is a *pure function transform* of ``module.apply``:
+
+  * :func:`get_graph_node_names` — one traced forward, returns the sorted
+    list of tappable node paths (``"fnet/layer1_0/conv1"``-style), the
+    analogue of reference ``:332`` (train/eval graphs coincide — flax
+    modules are mode-free functions, the dual-graph machinery of reference
+    ``:266`` has no TPU counterpart to need).
+  * :func:`create_feature_extractor` — returns a jittable
+    ``fn(variables, *args) -> {name: feature}`` for the requested nodes,
+    the analogue of reference ``:204``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import flax.linen as nn
+import jax
+
+
+def _flatten_intermediates(tree, prefix="") -> Dict[str, Any]:
+    """Flatten flax's ``intermediates`` collection to path-keyed outputs.
+    Each captured value is a tuple of per-call outputs; single-call nodes
+    are unwrapped."""
+    flat: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            path = f"{prefix}/{k}" if prefix else str(k)
+            if k == "__call__":
+                vals = v if not isinstance(v, tuple) or len(v) != 1 else v[0]
+                flat[prefix] = vals
+            else:
+                flat.update(_flatten_intermediates(v, path))
+    else:
+        flat[prefix] = tree
+    return flat
+
+
+def get_graph_node_names(module: nn.Module, variables, *args,
+                         **kwargs) -> List[str]:
+    """List every tappable submodule path of ``module`` for the given
+    example inputs (reference ``get_graph_node_names``,
+    ``core/utils/feature_extraction.py:332``)."""
+    _, state = module.apply(variables, *args, capture_intermediates=True,
+                            mutable=["intermediates"], **kwargs)
+    return sorted(_flatten_intermediates(state["intermediates"]).keys())
+
+
+def create_feature_extractor(module: nn.Module,
+                             return_nodes: Sequence[str]
+                             ) -> Callable[..., Dict[str, Any]]:
+    """Build ``fn(variables, *args, **kwargs) -> {node: output}`` tapping
+    ``return_nodes`` (reference ``create_feature_extractor``,
+    ``core/utils/feature_extraction.py:204``). The returned function is
+    jittable; only the requested submodules' outputs are captured, so XLA
+    dead-code-eliminates everything downstream of the last tap."""
+    wanted = set(return_nodes)
+
+    def _filter(mdl, method_name):
+        del method_name
+        return "/".join(mdl.path) in wanted
+
+    def extract(variables, *args, **kwargs):
+        _, state = module.apply(variables, *args,
+                                capture_intermediates=_filter,
+                                mutable=["intermediates"], **kwargs)
+        flat = _flatten_intermediates(state["intermediates"])
+        missing = wanted - set(flat)
+        if missing:
+            raise KeyError(
+                f"nodes {sorted(missing)} not found; available: "
+                f"{sorted(flat)}")
+        return {k: flat[k] for k in return_nodes}
+
+    return extract

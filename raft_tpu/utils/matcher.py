@@ -1,0 +1,106 @@
+"""Hungarian matching between predicted and target keypoint masks/flows
+(reference ``core/utils/matcher.py``, the Mask2Former-style matcher the
+sparse-keypoint family's auxiliary losses were designed around — dormant in
+the reference, functional here).
+
+TPU split: the cost matrices (focal + dice + class) are computed on device
+in one jitted function; only the LSAP solve (``scipy
+linear_sum_assignment``) runs on host — the same split the reference uses
+(costs on GPU, ``C.cpu()`` then scipy, ``core/utils/matcher.py:134-137``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.optimize import linear_sum_assignment
+
+
+def batch_dice_cost(inputs: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise DICE cost between predicted mask logits and binary targets
+    (reference ``core/utils/matcher.py:12-27``).
+
+    ``inputs``: (N, HW) logits; ``targets``: (M, HW) in {0, 1}. → (N, M).
+    """
+    probs = jax.nn.sigmoid(inputs)
+    numerator = 2 * jnp.einsum("nc,mc->nm", probs, targets)
+    denominator = probs.sum(-1)[:, None] + targets.sum(-1)[None, :]
+    return 1 - (numerator + 1) / (denominator + 1)
+
+
+def batch_sigmoid_focal_cost(inputs: jnp.ndarray, targets: jnp.ndarray,
+                             alpha: float = 0.25,
+                             gamma: float = 2.0) -> jnp.ndarray:
+    """Pairwise focal-loss cost (reference
+    ``core/utils/matcher.py:30-64``). Shapes as :func:`batch_dice_cost`."""
+    hw = inputs.shape[1]
+    prob = jax.nn.sigmoid(inputs)
+    # log-sigmoid forms of BCE against all-ones / all-zeros targets
+    ce_pos = -jax.nn.log_sigmoid(inputs)
+    ce_neg = -jax.nn.log_sigmoid(-inputs)
+    focal_pos = ((1 - prob) ** gamma) * ce_pos
+    focal_neg = (prob ** gamma) * ce_neg
+    if alpha >= 0:
+        focal_pos = focal_pos * alpha
+        focal_neg = focal_neg * (1 - alpha)
+    cost = (jnp.einsum("nc,mc->nm", focal_pos, targets)
+            + jnp.einsum("nc,mc->nm", focal_neg, 1 - targets))
+    return cost / hw
+
+
+@jax.jit
+def _cost_matrix(out_prob, out_mask, tgt_onehot, tgt_mask, weights):
+    cost_class = -jnp.einsum("nk,mk->nm", out_prob, tgt_onehot)
+    cost_mask = batch_sigmoid_focal_cost(out_mask, tgt_mask)
+    cost_dice = batch_dice_cost(out_mask, tgt_mask)
+    return (weights[0] * cost_class + weights[1] * cost_mask
+            + weights[2] * cost_dice)
+
+
+class HungarianMatcher:
+    """1-to-1 assignment of predictions to targets minimizing
+    class + focal-mask + dice costs (reference
+    ``core/utils/matcher.py:66-137``)."""
+
+    def __init__(self, cost_class: float = 1.0, cost_mask: float = 1.0,
+                 cost_dice: float = 1.0):
+        assert cost_class != 0 or cost_mask != 0 or cost_dice != 0, \
+            "all costs cant be 0"
+        self.weights = jnp.asarray([cost_class, cost_mask, cost_dice],
+                                   jnp.float32)
+
+    def __call__(self, outputs: Dict[str, jnp.ndarray],
+                 targets: Sequence[Dict[str, np.ndarray]]
+                 ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """``outputs``: {"pred_logits": (B, Q, K), "pred_masks":
+        (B, Q, H, W)}; ``targets``[b]: {"labels": (M,), "masks":
+        (M, H, W)}. Returns per-batch (pred_idx, tgt_idx) arrays."""
+        logits = outputs["pred_logits"]
+        masks = outputs["pred_masks"]
+        B, Q = logits.shape[:2]
+        K = logits.shape[-1]
+        indices = []
+        for b in range(B):
+            tgt = targets[b]
+            m = np.asarray(tgt["masks"], np.float32).reshape(
+                len(tgt["labels"]), -1)
+            onehot = np.eye(K, dtype=np.float32)[
+                np.asarray(tgt["labels"], np.int64)]
+            C = _cost_matrix(jax.nn.softmax(logits[b], -1),
+                             masks[b].reshape(Q, -1),
+                             jnp.asarray(onehot), jnp.asarray(m),
+                             self.weights)
+            i, j = linear_sum_assignment(np.asarray(C))
+            indices.append((np.asarray(i, np.int64),
+                            np.asarray(j, np.int64)))
+        return indices
+
+    def __repr__(self):
+        body = [f"cost_class: {float(self.weights[0])}",
+                f"cost_mask: {float(self.weights[1])}",
+                f"cost_dice: {float(self.weights[2])}"]
+        return "\n".join(["Matcher HungarianMatcher"]
+                         + ["    " + line for line in body])

@@ -1,0 +1,75 @@
+"""DETR-misc equivalents (reference ``core/utils/misc.py``).
+
+Only the pieces that are load-bearing for the model families are rebuilt
+natively; the reference's torch.distributed bootstrap/collectives
+(``core/utils/misc.py:366-460``) map to ``raft_tpu.parallel.distributed``
+(JAX collectives need no NCCL process-group plumbing), and its metric
+loggers live in ``raft_tpu.utils.logger``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class NestedTensor(NamedTuple):
+    """A batch of images + per-image validity mask (reference
+    ``core/utils/misc.py:318-363``). ``tensors``: (B, H, W, C) padded
+    batch; ``mask``: (B, H, W) bool, True on *padded* (invalid) pixels —
+    the DETR convention."""
+
+    tensors: jnp.ndarray
+    mask: Optional[jnp.ndarray]
+
+    def decompose(self):
+        return self.tensors, self.mask
+
+
+def nested_tensor_from_images(images: Sequence[np.ndarray]) -> NestedTensor:
+    """Pad variable-size NHWC images to a common static shape with a mask
+    (reference ``nested_tensor_from_tensor_list``,
+    ``core/utils/misc.py:303-315``). Host-side (numpy): batching of ragged
+    shapes happens before device transfer; on device everything is static.
+    """
+    max_h = max(im.shape[0] for im in images)
+    max_w = max(im.shape[1] for im in images)
+    c = images[0].shape[2]
+    batch = np.zeros((len(images), max_h, max_w, c), np.float32)
+    mask = np.ones((len(images), max_h, max_w), bool)
+    for i, im in enumerate(images):
+        h, w = im.shape[:2]
+        batch[i, :h, :w] = im
+        mask[i, :h, :w] = False
+    return NestedTensor(jnp.asarray(batch), jnp.asarray(mask))
+
+
+def downsample_mask(mask: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """Nearest-resize a (B, H, W) bool mask to a feature resolution — the
+    ``F.interpolate(m[None].float(), size=...)`` idiom of DETR backbones
+    (reference ``core/backbone.py:91``)."""
+    return jax.image.resize(mask.astype(jnp.float32),
+                            (mask.shape[0], h, w), "nearest") > 0.5
+
+
+def accuracy(output: jnp.ndarray, target: jnp.ndarray,
+             topk: Sequence[int] = (1,)):
+    """Top-k precision (reference ``core/utils/misc.py:463-479``)."""
+    maxk = max(topk)
+    pred = jnp.argsort(output, axis=-1)[..., ::-1][..., :maxk]
+    correct = pred == target[..., None]
+    return [100.0 * jnp.mean(jnp.any(correct[..., :k], axis=-1))
+            for k in topk]
+
+
+def get_total_grad_norm(grads, norm_type: float = 2.0) -> jnp.ndarray:
+    """Global gradient norm over a pytree (reference
+    ``core/utils/misc.py:504-510``)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.asarray([jnp.abs(g).max() for g in leaves]))
+    norms = jnp.asarray([jnp.sum(jnp.abs(g) ** norm_type) for g in leaves])
+    return jnp.sum(norms) ** (1.0 / norm_type)

@@ -1,0 +1,100 @@
+"""Shape/semantics tests for the full deformable-transformer stack
+(reference ``core/deformable.py:23-405``). The reference's own stack only
+runs with its CUDA extension; here the sampling core is jnp, so the whole
+transformer is CPU-testable (SURVEY.md §4 implication)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.models.deformable import (DeformableTransformer,
+                                        DeformableTransformerDecoder,
+                                        DeformableTransformerEncoder)
+
+D, HEADS, LEVELS = 32, 4, 2
+SHAPES = ((4, 6), (2, 3))
+S = sum(h * w for h, w in SHAPES)
+
+
+def _pyramids(rng, batch=2):
+    srcs1 = [jnp.asarray(rng.standard_normal((batch, h, w, D)), jnp.float32)
+             for h, w in SHAPES]
+    srcs2 = [jnp.asarray(rng.standard_normal((batch, h, w, D)), jnp.float32)
+             for h, w in SHAPES]
+    pos = [jnp.asarray(rng.standard_normal((batch, h, w, D)), jnp.float32)
+           for h, w in SHAPES]
+    return srcs1, srcs2, pos
+
+
+def test_encoder_shapes_and_grads(rng):
+    enc = DeformableTransformerEncoder(D, 2 * D, num_layers=2,
+                                       n_levels=LEVELS, n_heads=HEADS,
+                                       n_points=2)
+    src = jnp.asarray(rng.standard_normal((2, S, D)), jnp.float32)
+    vs = enc.init(jax.random.PRNGKey(0), src, SHAPES)
+    out = enc.apply(vs, src, SHAPES)
+    assert out.shape == (2, S, D)
+
+    g = jax.grad(lambda p: enc.apply({"params": p}, src, SHAPES).sum())(
+        vs["params"])
+    norms = [float(jnp.linalg.norm(x))
+             for x in jax.tree_util.tree_leaves(g)]
+    assert any(n > 0 for n in norms)
+
+
+def test_encoder_reference_points_normalized():
+    refs = DeformableTransformerEncoder.get_reference_points(SHAPES)
+    assert refs.shape == (1, S, LEVELS, 2)
+    assert float(refs.min()) > 0.0 and float(refs.max()) < 1.0
+
+
+def test_decoder_iterative_refinement_moves_references(rng):
+    dec = DeformableTransformerDecoder(D, 2 * D, num_layers=3,
+                                       n_levels=LEVELS, n_heads=HEADS,
+                                       n_points=2, num_flow_dims=2)
+    src = jnp.asarray(rng.standard_normal((1, S, D)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((1, 5, D)), jnp.float32)
+    refs0 = jnp.full((1, 5, 2), 0.5)
+    vs = dec.init(jax.random.PRNGKey(1), tgt, refs0, src, SHAPES)
+    hs, inter_refs = dec.apply(vs, tgt, refs0, src, SHAPES)
+    assert hs.shape == (3, 1, 5, D)
+    assert inter_refs.shape == (3, 1, 5, 2)
+    # refinement must actually move the reference points layer-over-layer
+    assert float(jnp.abs(inter_refs[1] - inter_refs[0]).max()) > 0
+    assert float(inter_refs.min()) >= 0.0 and float(inter_refs.max()) <= 1.0
+
+
+def test_full_transformer_outputs(rng):
+    tr = DeformableTransformer(d_model=D, n_heads=HEADS,
+                               num_encoder_layers=1, num_decoder_layers=2,
+                               d_ffn=2 * D, num_feature_levels=LEVELS,
+                               num_prop_queries=7)
+    srcs1, srcs2, pos = _pyramids(rng)
+    vs = tr.init(jax.random.PRNGKey(2), srcs1, srcs2, pos)
+    hs, init_ref, inter_refs, prop_hs = tr.apply(vs, srcs1, srcs2, pos)
+    assert hs.shape == (2, 2, S, D)              # (layers, B, S, D)
+    assert init_ref.shape == (2, S, 2)
+    assert inter_refs.shape == (2, 2, S, 2)
+    assert prop_hs.shape == (1, 2, S + 7, D)     # 1 prop layer, +7 queries
+
+
+def test_two_stage_proposals(rng):
+    tr = DeformableTransformer(d_model=D, n_heads=HEADS,
+                               num_encoder_layers=1, num_decoder_layers=1,
+                               d_ffn=2 * D, num_feature_levels=LEVELS,
+                               two_stage=True, num_prop_queries=3)
+    srcs1, srcs2, pos = _pyramids(rng, batch=1)
+    vs = tr.init(jax.random.PRNGKey(3), srcs1, srcs2, pos)
+    out = tr.apply(vs, srcs1, srcs2, pos)
+    assert len(out) == 7
+    output_memory, output_proposals, proposal_pos = out[4], out[5], out[6]
+    assert output_memory.shape == (1, S, D)
+    assert output_proposals.shape == (1, S, 4)
+    # all cells of these small grids sit inside the (0.01, 0.99) valid
+    # band, so every proposal is finite inverse-sigmoid space
+    assert bool(jnp.isfinite(output_proposals).all())
+    # round-trip: sigmoid of the logits recovers the normalized centers
+    centers = jax.nn.sigmoid(output_proposals[..., :2])
+    assert float(centers.min()) > 0.0 and float(centers.max()) < 1.0
+    assert proposal_pos.shape == (1, S, 4 * 128)
+    assert bool(jnp.isfinite(proposal_pos).all())
