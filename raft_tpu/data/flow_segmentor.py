@@ -55,9 +55,9 @@ def segment(flow_color: np.ndarray, quant: int = 24,
     Returns: (N, H, W) uint8 stack, one mask per region.
     """
     q = (flow_color.astype(np.int32) // quant)
-    key = q[..., 0] * 10000 + q[..., 1] * 100 + q[..., 2]
-    _, inverse = np.unique(key, return_inverse=True)
-    key = inverse.reshape(key.shape)
+    _, inverse = np.unique(q.reshape(-1, q.shape[-1]), axis=0,
+                           return_inverse=True)
+    key = inverse.reshape(q.shape[:-1])
 
     labels = np.zeros(key.shape, np.int32)
     next_label = 0

@@ -203,9 +203,20 @@ class PositionEmbeddingLearned(nn.Module):
                          (self.max_size, self.num_pos_feats))
         col = self.param("col_embed", nn.initializers.uniform(1.0),
                          (self.max_size, self.num_pos_feats))
+
+        def table(emb, n):
+            # DETR sized its 50-entry table for stride-32 features; larger
+            # levels linearly interpolate the table instead of crashing.
+            if n <= self.max_size:
+                return emb[:n]
+            return jax.image.resize(emb, (n, self.num_pos_feats),
+                                    "linear")
+
         pos = jnp.concatenate([
-            jnp.broadcast_to(col[None, :W], (H, W, self.num_pos_feats)),
-            jnp.broadcast_to(row[:H, None], (H, W, self.num_pos_feats)),
+            jnp.broadcast_to(table(col, W)[None],
+                             (H, W, self.num_pos_feats)),
+            jnp.broadcast_to(table(row, H)[:, None],
+                             (H, W, self.num_pos_feats)),
         ], axis=-1)
         return jnp.broadcast_to(pos[None], (B,) + pos.shape)
 

@@ -21,9 +21,13 @@ from raft_tpu.parallel.mesh import (DATA_AXIS, SPATIAL_AXIS, make_mesh,
                                     replicate, shard_batch)
 from raft_tpu.parallel.train_step import (RAFTTrainState, create_train_state,
                                           make_eval_step, make_train_step)
+from raft_tpu.parallel.ring_corr import (ring_corr_pyramid, ring_lookup,
+                                         sequence_parallel_specs)
+from raft_tpu.parallel.spatial import image_spec, spatial_jit
 
 __all__ = [
     "DATA_AXIS", "SPATIAL_AXIS", "make_mesh", "shard_batch", "replicate",
     "RAFTTrainState", "create_train_state", "make_train_step",
-    "make_eval_step",
+    "make_eval_step", "ring_corr_pyramid", "ring_lookup",
+    "sequence_parallel_specs", "image_spec", "spatial_jit",
 ]

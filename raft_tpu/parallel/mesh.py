@@ -47,11 +47,18 @@ def batch_spec() -> P:
 
 
 def shard_batch(batch, mesh: Mesh):
-    """Device_put a host batch (pytree of arrays with leading batch dim)
-    with the batch dim sharded over the ``data`` axis."""
-    sharding = NamedSharding(mesh, batch_spec())
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch)
+    """Device_put a host batch (pytree of arrays with leading batch dim):
+    batch dim over ``data``; for spatial arrays (ndim >= 3: images, flows,
+    valid masks) the row dim additionally shards over ``spatial``, so a 2-D
+    mesh runs data x sequence parallel with XLA inserting halo exchanges
+    and collectives."""
+    def put(x):
+        spec = (P(DATA_AXIS, SPATIAL_AXIS) if getattr(x, "ndim", 0) >= 3
+                and x.shape[1] % mesh.shape[SPATIAL_AXIS] == 0
+                else batch_spec())
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
 
 
 def replicate(tree, mesh: Mesh):

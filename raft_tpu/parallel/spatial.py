@@ -1,0 +1,57 @@
+"""Whole-model sequence(spatial)-parallel execution.
+
+Two composable mechanisms cover the long-context axis (image resolution —
+SURVEY.md §5 "long-context equivalent"):
+
+* :mod:`raft_tpu.parallel.ring_corr` — explicit ring correlation via
+  ``shard_map`` + ``ppermute`` (memory-bounded, ring-attention pattern).
+* This module — *compiler-partitioned* spatial parallelism: annotate the
+  image inputs as sharded over rows (``spatial`` mesh axis) and jit the
+  unmodified model; XLA's SPMD partitioner inserts the halo exchanges for
+  every convolution and the collectives for the correlation einsums. This
+  is the "pick a mesh, annotate shardings, let XLA insert collectives"
+  recipe — no model surgery, works for the full RAFT forward including
+  encoders, scan, and convex upsampling.
+
+Both shard rows of the image; they interoperate (same mesh, same specs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+
+def image_spec(shard_batch: bool = True) -> P:
+    """(B, H, W, C) images: batch over ``data``, rows over ``spatial``."""
+    return P(DATA_AXIS if shard_batch else None, SPATIAL_AXIS)
+
+
+def spatial_jit(apply_fn: Callable, mesh: Mesh,
+                shard_batch: bool = True,
+                donate: bool = False) -> Callable:
+    """Jit ``apply_fn(variables, image1, image2)`` with both images
+    sharded over (data, spatial) and params replicated.
+
+    The returned callable runs the full model spatially partitioned: at
+    Sintel/KITTI resolution each device holds ``1/d`` of every activation
+    and of the (HW)²-sized correlation volume. Outputs are produced with
+    the same (batch, rows) sharding; ``jax.device_get`` assembles them.
+
+    ``apply_fn`` must be positional-only in (variables, image1, image2) —
+    ``jax.jit`` with ``in_shardings`` rejects kwargs, so bind options like
+    ``test_mode`` into ``apply_fn`` first (``functools.partial`` /
+    closure).
+    """
+    ispec = NamedSharding(mesh, image_spec(shard_batch))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        apply_fn,
+        in_shardings=(rep, ispec, ispec),
+        donate_argnums=(1, 2) if donate else (),
+    )

@@ -125,13 +125,15 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
         return new_state, metrics
 
     if mesh is not None:
-        batch_shard = NamedSharding(mesh, P("data"))
+        # Batch arrays arrive committed by ``shard_batch`` — batch dim on
+        # ``data`` and, for spatial arrays, rows on ``spatial`` (2-D
+        # data x sequence-parallel mesh). Let jit adopt those input
+        # shardings rather than pinning (which would reject the
+        # sequence-parallel layout); params/rng are replicated.
         repl = NamedSharding(mesh, P())
-        batch_spec = {k: batch_shard
-                      for k in ("image1", "image2", "flow", "valid")}
         return jax.jit(
             step_fn,
-            in_shardings=(None, batch_spec, repl),
+            in_shardings=(None, None, repl),
             donate_argnums=(0,) if donate else ())
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 

@@ -169,3 +169,19 @@ def test_weight_decay_masks_frozen_batchnorm():
     mask = _decay_mask(params)
     assert mask["body"]["conv1"]["kernel"] is True
     assert all(v is False for v in mask["body"]["bn1"].values())
+
+
+def test_learned_position_embedding_exceeds_table_size(rng):
+    """Levels wider than the 50-entry DETR table interpolate instead of
+    crashing (stride-8 Sintel features are 128 wide)."""
+    from raft_tpu.models.backbone import PositionEmbeddingLearned
+    from raft_tpu.utils.misc import NestedTensor
+
+    pe = PositionEmbeddingLearned(num_pos_feats=8)
+    nt = NestedTensor(
+        jnp.asarray(rng.standard_normal((1, 4, 128, 16)), jnp.float32),
+        None)
+    vs = pe.init(jax.random.PRNGKey(0), nt)
+    pos = pe.apply(vs, nt)
+    assert pos.shape == (1, 4, 128, 16)
+    assert bool(jnp.isfinite(pos).all())

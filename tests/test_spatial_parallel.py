@@ -1,0 +1,50 @@
+"""Compiler-partitioned spatial parallelism: the unmodified RAFT forward
+jitted with row-sharded images must equal the replicated forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import RAFTConfig
+from raft_tpu.models import RAFT
+from raft_tpu.parallel.mesh import make_mesh
+from raft_tpu.parallel.spatial import image_spec, spatial_jit
+
+
+def test_spatial_forward_matches_replicated(rng):
+    cfg = RAFTConfig(small=True, iters=3)
+    model = RAFT(cfg)
+    B, H, W = 2, 32, 48
+    img1 = jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    vs = model.init({"params": key, "dropout": key}, img1, img2, iters=1)
+
+    ref = model.apply(vs, img1, img2, test_mode=True)[1]
+
+    mesh = make_mesh(n_data=2, n_spatial=4)
+    fwd = spatial_jit(
+        lambda v, a, b: model.apply(v, a, b, test_mode=True)[1], mesh)
+    got = fwd(vs, img1, img2)
+
+    # each device computes with halos; numerics identical up to reduction
+    # order inside XLA collectives
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_spatial_sharding_actually_partitions(rng):
+    cfg = RAFTConfig(small=True, iters=2)
+    model = RAFT(cfg)
+    B, H, W = 1, 16, 32
+    img = jnp.asarray(rng.uniform(0, 255, (B, H, W, 3)), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    vs = model.init({"params": key, "dropout": key}, img, img, iters=1)
+
+    mesh = make_mesh(n_data=1, n_spatial=8)
+    fwd = spatial_jit(
+        lambda v, a, b: model.apply(v, a, b, test_mode=True)[1], mesh,
+        shard_batch=False)
+    out = fwd(vs, img, img)
+    assert out.sharding.num_devices == 8
+    assert out.shape == (B, H, W, 2)
