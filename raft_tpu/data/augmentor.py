@@ -33,24 +33,41 @@ except Exception:  # pragma: no cover
 
 def _resize(img: np.ndarray, fx: float, fy: float,
             nearest: bool = False) -> np.ndarray:
+    h, w = img.shape[:2]
+    h2, w2 = int(round(h * fy)), int(round(w * fx))
+    if img.dtype == np.float32:
+        from raft_tpu import native
+        if native.available():   # C++ hot path (cv2 semantics)
+            fn = native.resize_nearest if nearest else native.resize_bilinear
+            return fn(img, h2, w2, fx=fx, fy=fy)
     if _HAS_CV2:
         interp = cv2.INTER_NEAREST if nearest else cv2.INTER_LINEAR
         return cv2.resize(img, None, fx=fx, fy=fy, interpolation=interp)
     from PIL import Image  # pragma: no cover
-    h, w = img.shape[:2]
-    size = (int(round(w * fx)), int(round(h * fy)))
     mode = Image.NEAREST if nearest else Image.BILINEAR
-    return np.asarray(Image.fromarray(img).resize(size, mode))
+    return np.asarray(Image.fromarray(img).resize((w2, h2), mode))
 
 
 # ---------------------------------------------------------------------------
 # numpy color jitter (torchvision-equivalent factor semantics)
 
+def _native_rgb(img: np.ndarray) -> bool:
+    from raft_tpu import native
+    return (img.dtype == np.float32 and img.ndim == 3
+            and img.shape[-1] == 3 and native.available())
+
+
 def _adjust_brightness(img: np.ndarray, f: float) -> np.ndarray:
+    if _native_rgb(img):
+        from raft_tpu import native
+        return native.adjust_brightness(img, f)
     return np.clip(img * f, 0, 255)
 
 
 def _adjust_contrast(img: np.ndarray, f: float) -> np.ndarray:
+    if _native_rgb(img):
+        from raft_tpu import native
+        return native.adjust_contrast(img, f)
     # torchvision blends toward the mean of the grayscale image
     gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
             + 0.114 * img[..., 2]).mean()
@@ -58,6 +75,9 @@ def _adjust_contrast(img: np.ndarray, f: float) -> np.ndarray:
 
 
 def _adjust_saturation(img: np.ndarray, f: float) -> np.ndarray:
+    if _native_rgb(img):
+        from raft_tpu import native
+        return native.adjust_saturation(img, f)
     gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
             + 0.114 * img[..., 2])[..., None]
     return np.clip(img * f + gray * (1 - f), 0, 255)
@@ -143,13 +163,21 @@ class FlowAugmentor:
         (reference ``:52-65``)."""
         ht, wd = img1.shape[:2]
         if self.rng.random() < self.eraser_aug_prob:
+            from raft_tpu import native
+            use_native = (native.available() and img2.dtype == np.float32
+                          and img2.flags.c_contiguous)
             mean_color = img2.reshape(-1, 3).mean(axis=0)
             for _ in range(int(self.rng.integers(1, 3))):
                 x0 = int(self.rng.integers(0, wd))
                 y0 = int(self.rng.integers(0, ht))
                 dx = int(self.rng.integers(bounds[0], bounds[1]))
                 dy = int(self.rng.integers(bounds[0], bounds[1]))
-                img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+                if use_native:
+                    native.erase_rect(img2, y0, x0, dy, dx,
+                                      mean_color.astype(np.float32),
+                                      inplace=True)
+                else:
+                    img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
         return img1, img2
 
     # -- spatial ----------------------------------------------------------
@@ -220,6 +248,9 @@ class SparseFlowAugmentor(FlowAugmentor):
     def resize_sparse_flow_map(flow, valid, fx=1.0, fy=1.0):
         """Resize a sparse flow map by scattering the valid vectors onto
         the resized grid (reference ``:161-193``)."""
+        from raft_tpu import native
+        if native.available():   # C++ scatter (identical semantics)
+            return native.resize_sparse_flow(flow, valid, fx, fy)
         ht, wd = flow.shape[:2]
         coords = np.meshgrid(np.arange(wd), np.arange(ht))
         coords = np.stack(coords, axis=-1).astype(np.float32)
