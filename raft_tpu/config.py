@@ -112,6 +112,9 @@ class TrainConfig:
 
     name: str = "raft"
     stage: str = "chairs"
+    # "raft" (canonical) or "sparse" (the fork's active "ours" trainer,
+    # reference train.py:19 → core/ours.py)
+    model_family: str = "raft"
     lr: float = 4e-4
     num_steps: int = 100000
     batch_size: int = 8

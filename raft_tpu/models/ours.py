@@ -73,10 +73,21 @@ class SparseRAFT(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
-                 test_mode: bool = False, train: bool = False):
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False, freeze_bn: bool = False):
+        """``flow_init`` must be None — warm starting is a canonical-RAFT
+        capability the sparse family does not define (reference
+        ``core/ours.py:303`` has no such input). ``freeze_bn`` freezes the
+        CNNDecoder's BatchNorm post-chairs (reference train.py:414-415).
+        ``test_mode`` returns ``(flow_low, flow_up)`` like RAFT so the
+        shared evaluation harness drives both families."""
+        if flow_init is not None:
+            raise ValueError("the sparse family does not support warm "
+                             "starting (flow_init)")
         cfg = self.config
         del iters  # the reference signature accepts it; outer_iterations rule
         deterministic = not train
+        norm_train = train and not freeze_bn
         dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
         B, I_H, I_W, _ = image1.shape
         L, N, Dm = cfg.num_feature_levels, cfg.num_keypoints, cfg.d_model
@@ -89,8 +100,8 @@ class SparseRAFT(nn.Module):
                              name="cnn_encoder")
         decoder_cnn = CNNDecoder(cfg.base_channel, "batch", dtype=dtype,
                                  name="cnn_decoder")
-        E1, E2 = encoder(both, train=train)
-        D1, D2, U1 = decoder_cnn(both, train=train)
+        E1, E2 = encoder(both, train=norm_train)
+        D1, D2, U1 = decoder_cnn(both, train=norm_train)
         E1, E2 = E1[4 - L:], E2[4 - L:]
         D1, D2 = D1[4 - L:], D2[4 - L:]   # U1 is already the image-1 half
         shapes = [f.shape[1:3] for f in D1]          # [(H_l, W_l)] * L
@@ -192,6 +203,10 @@ class SparseRAFT(nn.Module):
                           for i in range(cfg.outer_iterations)]
 
         root = round(math.sqrt(N))
+        assert root * root == N, (
+            f"num_keypoints must be a perfect square (got {N}): the "
+            "initial reference points form a sqrt(N) x sqrt(N) grid "
+            "(reference core/ours.py:122-123, N=100)")
         base = jnp.broadcast_to(
             _center_grid(root, root, normalize=True).reshape(1, N, 2),
             (B, N, 2))
@@ -250,6 +265,12 @@ class SparseRAFT(nn.Module):
             flow_predictions.append(flow)
             sparse_predictions.append((src_points, key_flow, masks, scores))
 
+        if test_mode:
+            flow_up = flow_predictions[-1]
+            B_, FH, FW, _ = flow_up.shape
+            flow_low = jax.image.resize(
+                flow_up, (B_, FH // 8, FW // 8, 2), "linear") / 8.0
+            return flow_low, flow_up
         return flow_predictions, sparse_predictions
 
 

@@ -103,14 +103,45 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
             image1, image2 = _maybe_add_noise(noise_rng, image1, image2)
 
         def loss_fn(params):
-            out, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                image1, image2, iters=tcfg.iters, train=True,
-                freeze_bn=freeze_bn,
-                rngs={"dropout": dropout_rng},
-                mutable=["batch_stats"])
-            loss, metrics = sequence_loss(
-                out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
+            variables = {"params": params,
+                         "batch_stats": state.batch_stats}
+            if tcfg.model_family == "sparse":
+                # The fork's active trainer (reference train.py:19 →
+                # core/ours.py): list of per-outer-iteration dense flows
+                # plus sparse keypoint predictions, with the auxiliary
+                # sparse loss gated to the first sparse_lambda_steps
+                # (reference train.py:379-383).
+                (flow_preds, sparse_preds), mutated = state.apply_fn(
+                    variables, image1, image2, iters=tcfg.iters,
+                    train=True, freeze_bn=freeze_bn,
+                    rngs={"dropout": dropout_rng},
+                    mutable=["batch_stats"])
+                out = jnp.stack(list(flow_preds))
+                loss, metrics = sequence_loss(
+                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
+                if tcfg.sparse_lambda > 0:
+                    from raft_tpu.losses import sparse_keypoint_loss
+                    # key flows are normalized src-dst offsets; the loss
+                    # compares in pixels, scaled by (W-1, H-1) like the
+                    # reference (train.py:73-82)
+                    _, H_, W_, _ = batch["flow"].shape
+                    scale = jnp.asarray([W_ - 1, H_ - 1], jnp.float32)
+                    sparse = sparse_keypoint_loss(
+                        [(p[0], p[1] * scale) for p in sparse_preds],
+                        batch["flow"], batch["valid"])
+                    lam = tcfg.sparse_lambda * (
+                        state.step < tcfg.sparse_lambda_steps)
+                    loss = loss + lam * sparse
+                    metrics["sparse_loss"] = sparse
+                    metrics["loss"] = loss
+            else:
+                out, mutated = state.apply_fn(
+                    variables, image1, image2, iters=tcfg.iters,
+                    train=True, freeze_bn=freeze_bn,
+                    rngs={"dropout": dropout_rng},
+                    mutable=["batch_stats"])
+                loss, metrics = sequence_loss(
+                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
             # Under freeze_bn (or a BN-free model) nothing is written to
             # the batch_stats collection; keep the existing stats then.
             new_bs = mutated.get("batch_stats")

@@ -58,7 +58,13 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     np.random.seed(tcfg.seed)                 # host-side aug reproducibility
 
     mesh = make_mesh()
-    model = RAFT(mcfg)
+    if tcfg.model_family == "sparse":
+        from raft_tpu.config import OursConfig
+        from raft_tpu.models import SparseRAFT
+        model = SparseRAFT(OursConfig(
+            mixed_precision=mcfg.mixed_precision))
+    else:
+        model = RAFT(mcfg)
     run_ckpt_dir = os.path.join(ckpt_dir, tcfg.name)
 
     with mesh:
@@ -124,6 +130,13 @@ def main(argv=None):
     parser.add_argument("--name", default="raft", help="experiment name")
     parser.add_argument("--stage", default="chairs",
                         choices=["chairs", "things", "sintel", "kitti"])
+    parser.add_argument("--model_family", default="raft",
+                        choices=["raft", "sparse"],
+                        help="canonical RAFT or the fork's sparse-keypoint "
+                             "(ours) family")
+    parser.add_argument("--sparse_lambda", type=float, default=0.0,
+                        help="auxiliary sparse loss weight (first 20k "
+                             "steps; reference train.py:379-383)")
     parser.add_argument("--restore_ckpt", default=None,
                         help="orbax dir or torch .pth (params only)")
     parser.add_argument("--resume", action="store_true",
@@ -156,7 +169,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     tcfg = TrainConfig(
-        name=args.name, stage=args.stage, lr=args.lr,
+        name=args.name, stage=args.stage,
+        model_family=args.model_family, sparse_lambda=args.sparse_lambda,
+        lr=args.lr,
         num_steps=args.num_steps, batch_size=args.batch_size,
         image_size=tuple(args.image_size), wdecay=args.wdecay,
         epsilon=args.epsilon, clip=args.clip, gamma=args.gamma,

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Fetch the published pretrained RAFT weights (reference
+# download_models.sh:2-3). Convert for this framework with:
+#   python -c "from raft_tpu.checkpoint import load_params; load_params('models/raft-things.pth')"
+wget https://dl.dropboxusercontent.com/s/4j4z58wuv8o0mfz/models.zip
+unzip models.zip

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Original RAFT 4-stage curriculum (reference train_mixed.sh:3-6):
+# chairs -> things -> sintel -> kitti, mixed precision (bf16 on TPU).
+mkdir -p checkpoints
+python -u train.py --name raft-chairs --stage chairs --validation chairs \
+  --lr 0.0004 --num_steps 120000 --batch_size 8 --image_size 368 496 \
+  --wdecay 0.0001 --mixed_precision
+python -u train.py --name raft-things --stage things --validation sintel \
+  --restore_ckpt checkpoints/raft-chairs --lr 0.000125 --num_steps 120000 \
+  --batch_size 5 --image_size 400 720 --wdecay 0.0001 --mixed_precision
+python -u train.py --name raft-sintel --stage sintel --validation sintel \
+  --restore_ckpt checkpoints/raft-things --lr 0.000125 --num_steps 120000 \
+  --batch_size 5 --image_size 368 768 --wdecay 0.00001 --gamma 0.85 \
+  --mixed_precision
+python -u train.py --name raft-kitti --stage kitti --validation kitti \
+  --restore_ckpt checkpoints/raft-sintel --lr 0.0001 --num_steps 50000 \
+  --batch_size 5 --image_size 288 960 --wdecay 0.00001 --gamma 0.85 \
+  --mixed_precision

@@ -1,0 +1,7 @@
+#!/bin/bash
+# The fork's active schedule (reference train_standard.sh:6): chairs stage,
+# batch 10, lr 2e-4, 352x480, 1M steps, sparse ("ours") family.
+mkdir -p checkpoints
+python -u train.py --name raft-ours --stage chairs --model_family sparse \
+  --validation chairs --lr 0.0002 --num_steps 1000000 --batch_size 10 \
+  --image_size 352 480 --sparse_lambda 0.1

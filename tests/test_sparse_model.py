@@ -175,3 +175,30 @@ def test_sparse_raft_gradients_flow(rng):
     gnorm = sum(float(jnp.sum(jnp.abs(g)))
                 for g in jax.tree.leaves(grads))
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_sparse_test_mode_drives_shared_eval_harness(rng):
+    """SparseRAFT must satisfy the (flow_low, flow_up) test_mode contract
+    so FlowPredictor/validators drive both families."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import OursConfig
+    from raft_tpu.evaluate import FlowPredictor
+    from raft_tpu.models import SparseRAFT
+
+    cfg = OursConfig(base_channel=16, d_model=32, num_feature_levels=2,
+                     outer_iterations=2, num_keypoints=4, n_heads=4,
+                     n_points=2, dropout=0.0)
+    model = SparseRAFT(cfg)
+    img = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)), jnp.float32)
+    vs = model.init({"params": jax.random.PRNGKey(0),
+                     "dropout": jax.random.PRNGKey(0)}, img, img, iters=1)
+    pred = FlowPredictor(model, vs, iters=2, batch_size=1)
+    low, up = pred(np.asarray(img[0]), np.asarray(img[0]))
+    assert up.shape == (32, 48, 2) and low.shape == (4, 6, 2)
+    assert np.isfinite(up).all()
+
+    # warm start is a canonical-RAFT capability; the sparse family refuses
+    with pytest.raises(ValueError):
+        model.apply(vs, img, img, flow_init=jnp.zeros((1, 4, 6, 2)))
