@@ -301,7 +301,8 @@ def run_validation(predictor: FlowPredictor, names) -> Dict[str, float]:
 def load_predictor(model_path: str, small: bool = False,
                    alternate_corr: bool = False,
                    mixed_precision: bool = False,
-                   iters: int = 32) -> FlowPredictor:
+                   iters: int = 32,
+                   model_family: str = "raft") -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
     (the reference ``evaluate.py:312-313`` model-loading path)."""
@@ -309,9 +310,19 @@ def load_predictor(model_path: str, small: bool = False,
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
 
-    cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
-                     mixed_precision=mixed_precision)
-    model = RAFT(cfg)
+    if model_family == "sparse":
+        from raft_tpu.config import OursConfig
+        from raft_tpu.models import SparseRAFT
+        if model_path.endswith((".pth", ".pt")):
+            raise ValueError(
+                "torch-checkpoint conversion covers the canonical RAFT "
+                "family only (no published sparse/ours weights exist); "
+                "load the sparse family from an orbax run directory")
+        model = SparseRAFT(OursConfig(mixed_precision=mixed_precision))
+    else:
+        cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
+                         mixed_precision=mixed_precision)
+        model = RAFT(cfg)
     params, batch_stats = ckpt_lib.load_params(model_path)
     variables = {"params": params}
     if batch_stats:
@@ -331,6 +342,8 @@ def main(argv=None):
                         choices=list(_VALIDATORS) + ["sintel_submission",
                                                      "kitti_submission"])
     parser.add_argument("--small", action="store_true")
+    parser.add_argument("--model_family", default="raft",
+                        choices=["raft", "sparse"])
     parser.add_argument("--iters", type=int, default=None)
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
@@ -342,11 +355,15 @@ def main(argv=None):
     default_iters = {"chairs": 24, "kitti": 24, "sintel": 32,
                      "sintel_occ": 32, "sintel_submission": 32,
                      "kitti_submission": 24}
+    if args.model_family == "sparse" and args.warm_start:
+        parser.error("--warm_start requires the canonical RAFT family "
+                     "(the sparse family does not support flow_init)")
     iters = args.iters or default_iters[args.dataset]
     predictor = load_predictor(args.model, small=args.small,
                                alternate_corr=args.alternate_corr,
                                mixed_precision=args.mixed_precision,
-                               iters=iters)
+                               iters=iters,
+                               model_family=args.model_family)
     if args.dataset == "sintel_submission":
         create_sintel_submission(
             predictor, warm_start=args.warm_start,
