@@ -302,7 +302,8 @@ def load_predictor(model_path: str, small: bool = False,
                    alternate_corr: bool = False,
                    mixed_precision: bool = False,
                    iters: int = 32,
-                   model_family: str = "raft") -> FlowPredictor:
+                   model_family: str = "raft",
+                   corr_dtype: str = "float32") -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
     (the reference ``evaluate.py:312-313`` model-loading path)."""
@@ -321,7 +322,8 @@ def load_predictor(model_path: str, small: bool = False,
         model = SparseRAFT(OursConfig(mixed_precision=mixed_precision))
     else:
         cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
-                         mixed_precision=mixed_precision)
+                         mixed_precision=mixed_precision,
+                         corr_dtype=corr_dtype)
         model = RAFT(cfg)
     params, batch_stats = ckpt_lib.load_params(model_path)
     variables = {"params": params}
@@ -348,6 +350,11 @@ def main(argv=None):
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--warm_start", action="store_true")
+    parser.add_argument("--corr_dtype", default="float32",
+                        choices=["float32", "bfloat16", "auto"],
+                        help="storage dtype of the correlation pyramid "
+                             "(float32 = reference autocast semantics; "
+                             "bfloat16 halves its HBM footprint)")
     parser.add_argument("--data_root", default=None)
     parser.add_argument("--output_path", default=None)
     args = parser.parse_args(argv)
@@ -358,12 +365,21 @@ def main(argv=None):
     if args.model_family == "sparse" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
                      "(the sparse family does not support flow_init)")
+    if args.model_family == "sparse":
+        for flag, on in (("--small", args.small),
+                         ("--alternate_corr", args.alternate_corr),
+                         ("--corr_dtype", args.corr_dtype != "float32")):
+            if on:
+                parser.error(f"{flag} applies to the canonical RAFT family "
+                             "only (the sparse family has no small variant "
+                             "and fixed fork-corr semantics)")
     iters = args.iters or default_iters[args.dataset]
     predictor = load_predictor(args.model, small=args.small,
                                alternate_corr=args.alternate_corr,
                                mixed_precision=args.mixed_precision,
                                iters=iters,
-                               model_family=args.model_family)
+                               model_family=args.model_family,
+                               corr_dtype=args.corr_dtype)
     if args.dataset == "sintel_submission":
         create_sintel_submission(
             predictor, warm_start=args.warm_start,

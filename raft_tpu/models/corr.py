@@ -57,19 +57,28 @@ def _window_delta(radius: int) -> jnp.ndarray:
 
 
 def build_corr_pyramid(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
-                       num_levels: int = 4, scale: bool = True):
+                       num_levels: int = 4, scale: bool = True,
+                       storage_dtype=jnp.float32):
     """All-pairs volume → avg-pooled pyramid, each level
     ``(B*H*W, H/2^l, W/2^l)`` (reference ``core/corr.py:18-27``).
 
     Levels are 3D — a trailing singleton channel would be padded to a full
     128-lane tile by TPU layout, inflating HBM footprint and every read.
+
+    ``storage_dtype``: dtype the levels are *stored* in between refinement
+    iterations (see ``RAFTConfig.corr_dtype``). The matmul and the pooling
+    chain always run in float32; bfloat16 storage halves the HBM footprint
+    and read traffic of the framework's dominant memory object.
     """
     B, H, W, _ = fmap1.shape
     corr = all_pairs_correlation(fmap1, fmap2, scale=scale)
-    corr = corr.reshape(B * H * W, H, W)
+    # Cast level 0 BEFORE pooling so the float32 volume dies at the cast —
+    # pooling from the float32 original would keep both copies live in HBM.
+    # Each pool still accumulates in float32.
+    corr = corr.reshape(B * H * W, H, W).astype(storage_dtype)
     pyramid = [corr]
     for _ in range(num_levels - 1):
-        corr = avg_pool2x2(corr)
+        corr = avg_pool2x2(corr.astype(jnp.float32)).astype(storage_dtype)
         pyramid.append(corr)
     return tuple(pyramid)
 
@@ -106,10 +115,11 @@ class CorrBlock:
 
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True,
-                 rescale: bool = True):
+                 rescale: bool = True, storage_dtype=jnp.float32):
         self.radius = radius
         self.rescale = rescale
-        self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels, scale)
+        self.pyramid = build_corr_pyramid(fmap1, fmap2, num_levels, scale,
+                                          storage_dtype)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
         return pyramid_lookup(self.pyramid, coords, self.radius,

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models import corr
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
+from raft_tpu.models.update import (UPSAMPLE_MASK_CHANNELS,
+                                    BasicUpdateBlock, SmallUpdateBlock)
 from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
 
 
@@ -56,27 +57,26 @@ class _UpdateStep(nn.Module):
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         new_flow = coords1 - coords0
 
-        def _upsample(nf_mask):
-            nf, m = nf_mask
-            if m is None:
-                return upflow8(nf)
-            return convex_upsample(nf, m.astype(jnp.float32))
-
         if isinstance(compute_up, bool) or self.is_initializing():
             # Training / init: every iteration's upsampled flow is a scan
             # output (the sequence loss consumes all of them).
-            flow_up = _upsample((new_flow, up_mask))
+            if up_mask is None:
+                flow_up = upflow8(new_flow)
+            else:
+                flow_up = convex_upsample(new_flow,
+                                          up_mask.astype(jnp.float32))
             return (net, coords1), flow_up
 
-        # test_mode: only the flagged (last) iteration upsamples, and the
-        # result rides in the carry — stacking `iters` full-resolution
-        # outputs would cost iters x (B, 8H, 8W, 2) HBM for buffers of
-        # which only the last is read.
-        net_prev_up = carry[2]
-        flow_up = jax.lax.cond(
-            compute_up, _upsample, lambda _: net_prev_up,
-            (new_flow, up_mask))
-        return (net, coords1, flow_up), ()
+        # test_mode: the mask head runs (under cond) only on the flagged
+        # last iteration; the mask rides in the carry (zeros until then)
+        # and the single convex upsample runs after the scan. This moves
+        # the full-resolution upsample einsum and its (B, 8H, 8W, 2)
+        # buffer out of the loop body entirely — measured ~5% faster than
+        # carrying the upsampled flow through a per-iteration cond, even
+        # though the mask itself is the larger buffer.
+        if up_mask is None:
+            return (net, coords1), ()
+        return (net, coords1, up_mask), ()
 
 
 def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
@@ -91,7 +91,8 @@ def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
         return ("alt", (fmap1, corr.build_feature_pyramid(
             fmap2, cfg.corr_levels)))
     return ("allpairs", corr.build_corr_pyramid(
-        fmap1, fmap2, cfg.corr_levels, cfg.corr_scale))
+        fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
+        cfg.corr_storage_dtype))
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
@@ -176,9 +177,12 @@ class RAFT(nn.Module):
         if last_only:
             flags = jnp.arange(iters) == iters - 1
             flags_axis = 0
-            B8 = image1.shape[0]
-            carry = (net, coords1,
-                     jnp.zeros((B8, 8 * H8, 8 * W8, 2), jnp.float32))
+            if cfg.small:
+                carry = (net, coords1)
+            else:
+                carry = (net, coords1,
+                         jnp.zeros((B, H8, W8, UPSAMPLE_MASK_CHANNELS),
+                                   net.dtype))
         else:
             flags = True
             flags_axis = nn.broadcast
@@ -195,8 +199,14 @@ class RAFT(nn.Module):
             carry, flags, corr_state, inp, coords0)
 
         if last_only:
-            net, coords1, flow_up = carry
-            return coords1 - coords0, flow_up
+            if cfg.small:
+                net, coords1 = carry
+                flow_low = coords1 - coords0
+                return flow_low, upflow8(flow_low)
+            net, coords1, up_mask = carry
+            flow_low = coords1 - coords0
+            return flow_low, convex_upsample(flow_low,
+                                             up_mask.astype(jnp.float32))
         net, coords1 = carry
         if test_mode:
             # init-time test_mode (static path): all iterations upsample.

@@ -14,6 +14,10 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
+# Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
+# (reference core/update.py:121, core/raft.py:74-85).
+UPSAMPLE_MASK_CHANNELS = 9 * 8 * 8
+
 
 class FlowHead(nn.Module):
     """3x3 conv → relu → 3x3 conv to 2 channels (core/update.py:6-14)."""
@@ -157,7 +161,8 @@ class BasicUpdateBlock(nn.Module):
         self.gru = SepConvGRU(self.hidden_dim, self.dtype)
         self.flow_head = FlowHead(256, self.dtype)
         self.mask_conv1 = nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)
-        self.mask_conv2 = nn.Conv(64 * 9, (1, 1), dtype=self.dtype)
+        self.mask_conv2 = nn.Conv(UPSAMPLE_MASK_CHANNELS, (1, 1),
+                                  dtype=self.dtype)
 
     def __call__(self, net, inp, corr, flow, compute_mask=True):
         """``compute_mask`` may be a traced scalar bool: the mask head then
@@ -178,6 +183,6 @@ class BasicUpdateBlock(nn.Module):
         else:
             mask = nn.cond(compute_mask, _mask,
                            lambda mdl, n: jnp.zeros(
-                               n.shape[:3] + (64 * 9,), n.dtype),
+                               n.shape[:3] + (UPSAMPLE_MASK_CHANNELS,), n.dtype),
                            self, net)
         return net, mask, delta_flow

@@ -159,6 +159,11 @@ def main(argv=None):
     parser.add_argument("--add_noise", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--alternate_corr", action="store_true")
+    parser.add_argument("--corr_dtype", default="float32",
+                        choices=["float32", "bfloat16", "auto"],
+                        help="storage dtype of the correlation pyramid "
+                             "(float32 = reference autocast semantics; "
+                             "bfloat16 halves its HBM footprint)")
     parser.add_argument("--scheduler", default="onecycle",
                         choices=["onecycle", "step", "cosine_warmup"])
     parser.add_argument("--val_freq", type=int, default=5000)
@@ -167,6 +172,17 @@ def main(argv=None):
     parser.add_argument("--log_dir", default="runs")
     parser.add_argument("--seed", type=int, default=2022)
     args = parser.parse_args(argv)
+
+    if args.model_family == "sparse":
+        # SparseRAFT is built from OursConfig; these RAFT-only flags would
+        # be silently dropped (mirrors evaluate.py's upfront validation).
+        for flag, on in (("--small", args.small),
+                         ("--alternate_corr", args.alternate_corr),
+                         ("--corr_dtype", args.corr_dtype != "float32")):
+            if on:
+                parser.error(f"{flag} applies to the canonical RAFT family "
+                             "only (the sparse family has no small variant "
+                             "and fixed fork-corr semantics)")
 
     tcfg = TrainConfig(
         name=args.name, stage=args.stage,
@@ -180,7 +196,8 @@ def main(argv=None):
     mcfg = RAFTConfig(
         small=args.small, dropout=args.dropout, iters=args.iters,
         alternate_corr=args.alternate_corr,
-        mixed_precision=args.mixed_precision)
+        mixed_precision=args.mixed_precision,
+        corr_dtype=args.corr_dtype)
 
     t0 = time.time()
     train(tcfg, mcfg, data_root=args.data_root, ckpt_dir=args.ckpt_dir,

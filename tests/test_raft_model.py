@@ -107,3 +107,21 @@ def test_large_model_gated_test_mode_matches_training_path():
     np.testing.assert_allclose(np.asarray(up), np.asarray(preds[-1]),
                                rtol=1e-6, atol=1e-5)
     assert up.shape == (1, 32, 48, 2)
+
+
+def test_bfloat16_corr_storage_close_to_float32():
+    """corr_dtype='bfloat16' stores the correlation pyramid in half the
+    bytes; outputs must stay within bfloat16 rounding of the float32 path
+    (the volume is still computed and pooled in float32)."""
+    rng = jax.random.PRNGKey(5)
+    img1 = jax.random.uniform(rng, (1, 32, 48, 3)) * 255.0
+    img2 = jax.random.uniform(jax.random.fold_in(rng, 1),
+                              (1, 32, 48, 3)) * 255.0
+    m32 = RAFT(RAFTConfig(iters=3))
+    m16 = RAFT(RAFTConfig(iters=3, corr_dtype="bfloat16"))
+    vs = m32.init({"params": rng, "dropout": rng}, img1, img2, iters=1)
+    up32 = m32.apply(vs, img1, img2, test_mode=True)[1]
+    up16 = m16.apply(vs, img1, img2, test_mode=True)[1]
+    diff = float(jnp.abs(up32 - up16).max())
+    scale = float(jnp.abs(up32).max()) + 1e-6
+    assert diff / scale < 0.02, (diff, scale)
