@@ -9,11 +9,12 @@ at 10 image-pairs/sec (RAFT paper reports ~10 fps at 1088x436 / 12 iters on
 a 1080Ti-class GPU; BASELINE.md records no in-repo number, so the target
 "≥4x vs V100" is normalized to this documented estimate).
 
-Throughput is measured at batch=8: per-chip eval throughput is the metric,
-and batching frame pairs is how the framework evaluates a 1000-frame Sintel
-pass on TPU; reps are dispatched back-to-back and synced once so the device
-pipeline rate is measured, not the host↔device round-trip latency of a
-lone request.
+Throughput is measured at batch=24 (the sweep's knee on v5e-1; the f32
+all-pairs volume pyramid for 24 pairs is ~6 GB of the 16 GB HBM): per-chip
+eval throughput is the metric, and batching frame pairs is how the
+framework evaluates a 1000-frame Sintel pass on TPU; reps are dispatched
+back-to-back and synced once so the device pipeline rate is measured, not
+the host↔device round-trip latency of a lone request.
 """
 
 from __future__ import annotations
@@ -27,7 +28,7 @@ import jax.numpy as jnp
 BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
 H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
 ITERS = 12
-BATCH = 8
+BATCH = 24
 WARMUP = 2
 REPS = 10
 

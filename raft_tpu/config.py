@@ -58,6 +58,12 @@ class RAFTConfig:
             raise ValueError(
                 f"corr_dtype must be 'auto', 'float32' or 'bfloat16', "
                 f"got {self.corr_dtype!r}")
+        if self.alternate_corr and self.corr_dtype == "bfloat16":
+            # The on-demand path never materializes a volume pyramid, so an
+            # explicit bfloat16 request would be a silent no-op.
+            raise ValueError(
+                "corr_dtype='bfloat16' has no effect with alternate_corr "
+                "(the on-demand path stores no correlation pyramid)")
 
     @property
     def fnet_dim(self) -> int:

@@ -314,6 +314,14 @@ def load_predictor(model_path: str, small: bool = False,
     if model_family == "sparse":
         from raft_tpu.config import OursConfig
         from raft_tpu.models import SparseRAFT
+        dropped = [name for name, on in
+                   (("small", small), ("alternate_corr", alternate_corr),
+                    ("corr_dtype", corr_dtype != "float32")) if on]
+        if dropped:
+            raise ValueError(
+                f"{dropped} apply to the canonical RAFT family only; the "
+                "sparse family is built from OursConfig and would silently "
+                "ignore them")
         if model_path.endswith((".pth", ".pt")):
             raise ValueError(
                 "torch-checkpoint conversion covers the canonical RAFT "
@@ -330,6 +338,21 @@ def load_predictor(model_path: str, small: bool = False,
     if batch_stats:
         variables["batch_stats"] = batch_stats
     return FlowPredictor(model, variables, iters=iters)
+
+
+def reject_raft_only_flags(parser, args) -> None:
+    """Upfront CLI validation shared by train.py and evaluate.py: flags
+    that only configure the canonical RAFT family must not be silently
+    dropped when ``--model_family sparse`` builds from ``OursConfig``."""
+    if args.model_family != "sparse":
+        return
+    for flag, on in (("--small", args.small),
+                     ("--alternate_corr", args.alternate_corr),
+                     ("--corr_dtype", args.corr_dtype != "float32")):
+        if on:
+            parser.error(f"{flag} applies to the canonical RAFT family "
+                         "only (the sparse family has no small variant "
+                         "and fixed fork-corr semantics)")
 
 
 def main(argv=None):
@@ -365,14 +388,7 @@ def main(argv=None):
     if args.model_family == "sparse" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
                      "(the sparse family does not support flow_init)")
-    if args.model_family == "sparse":
-        for flag, on in (("--small", args.small),
-                         ("--alternate_corr", args.alternate_corr),
-                         ("--corr_dtype", args.corr_dtype != "float32")):
-            if on:
-                parser.error(f"{flag} applies to the canonical RAFT family "
-                             "only (the sparse family has no small variant "
-                             "and fixed fork-corr semantics)")
+    reject_raft_only_flags(parser, args)
     iters = args.iters or default_iters[args.dataset]
     predictor = load_predictor(args.model, small=args.small,
                                alternate_corr=args.alternate_corr,

@@ -14,6 +14,8 @@ reference's ``coords_grid`` (reference ``core/utils/utils.py:74-77``).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -104,6 +106,7 @@ def interp_axis_weights(t: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.maximum(0.0, 1.0 - jnp.abs(t[..., None] - x))
 
 
+@functools.partial(jax.checkpoint, static_argnums=(3,), prevent_cse=False)
 def windowed_bilinear_matmul(img: jnp.ndarray, cx: jnp.ndarray,
                              cy: jnp.ndarray, radius: int) -> jnp.ndarray:
     """Windowed bilinear lookup as two batched matmuls (TPU fast path).
@@ -115,6 +118,12 @@ def windowed_bilinear_matmul(img: jnp.ndarray, cx: jnp.ndarray,
     ``bilinear_sampler`` over the same points (linearity of interpolation),
     but contracts over full rows/columns with dense separable weights
     instead of gathering 4 corners per point.
+
+    ``jax.checkpoint``: without it, autodiff under the refinement scan saves
+    the dense (Q, win, W)/(Q, win, H) weight tensors of EVERY iteration as
+    scan residuals (~5 GB with tile padding at chairs-training scale — an
+    OOM on one v5e chip); rematerializing them from the (Q,) coords in the
+    backward pass is a few cheap elementwise ops.
     """
     Q, H, W = img.shape
     win = 2 * radius + 1
@@ -161,11 +170,16 @@ def _neighborhood3x3(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(shifts, axis=3)
 
 
+@functools.partial(jax.checkpoint, prevent_cse=False)
 def convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Convex combination 8x upsampling (reference ``core/raft.py:74-85``).
 
     Each fine pixel is a softmax-weighted combination of the 3x3 coarse
     neighborhood of ``8 * flow``.
+
+    ``jax.checkpoint``: recompute the softmaxed mask in the backward pass
+    instead of saving a per-iteration (B, H, W, 9, 8, 8) float copy under
+    the training scan (~1.8 GB of scan residuals at chairs-training scale).
 
     Args:
       flow: ``(B, H, W, 2)`` coarse flow.

@@ -173,16 +173,7 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=2022)
     args = parser.parse_args(argv)
 
-    if args.model_family == "sparse":
-        # SparseRAFT is built from OursConfig; these RAFT-only flags would
-        # be silently dropped (mirrors evaluate.py's upfront validation).
-        for flag, on in (("--small", args.small),
-                         ("--alternate_corr", args.alternate_corr),
-                         ("--corr_dtype", args.corr_dtype != "float32")):
-            if on:
-                parser.error(f"{flag} applies to the canonical RAFT family "
-                             "only (the sparse family has no small variant "
-                             "and fixed fork-corr semantics)")
+    evaluate.reject_raft_only_flags(parser, args)
 
     tcfg = TrainConfig(
         name=args.name, stage=args.stage,
