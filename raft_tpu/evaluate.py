@@ -314,14 +314,15 @@ def load_predictor(model_path: str, small: bool = False,
     if model_family == "sparse":
         from raft_tpu.config import OursConfig
         from raft_tpu.models import SparseRAFT
-        dropped = [name for name, on in
-                   (("small", small), ("alternate_corr", alternate_corr),
-                    ("corr_dtype", corr_dtype != "float32")) if on]
+        dropped = [name for name, on in _raft_only_selections(
+            small, alternate_corr, corr_dtype) if on]
         if dropped:
             raise ValueError(
-                f"{dropped} apply to the canonical RAFT family only; the "
-                "sparse family is built from OursConfig and would silently "
-                "ignore them")
+                f"{', '.join(dropped)} appl"
+                f"{'ies' if len(dropped) == 1 else 'y'} to the canonical "
+                "RAFT family only; the sparse family is built from "
+                "OursConfig and would silently ignore "
+                f"{'it' if len(dropped) == 1 else 'them'}")
         if model_path.endswith((".pth", ".pt")):
             raise ValueError(
                 "torch-checkpoint conversion covers the canonical RAFT "
@@ -340,17 +341,24 @@ def load_predictor(model_path: str, small: bool = False,
     return FlowPredictor(model, variables, iters=iters)
 
 
+def _raft_only_selections(small, alternate_corr, corr_dtype):
+    """The single source of truth for options that configure only the
+    canonical RAFT family: ``(name, non-default?)`` pairs."""
+    return (("small", small),
+            ("alternate_corr", alternate_corr),
+            ("corr_dtype", corr_dtype != "float32"))
+
+
 def reject_raft_only_flags(parser, args) -> None:
     """Upfront CLI validation shared by train.py and evaluate.py: flags
     that only configure the canonical RAFT family must not be silently
     dropped when ``--model_family sparse`` builds from ``OursConfig``."""
     if args.model_family != "sparse":
         return
-    for flag, on in (("--small", args.small),
-                     ("--alternate_corr", args.alternate_corr),
-                     ("--corr_dtype", args.corr_dtype != "float32")):
+    for name, on in _raft_only_selections(args.small, args.alternate_corr,
+                                          args.corr_dtype):
         if on:
-            parser.error(f"{flag} applies to the canonical RAFT family "
+            parser.error(f"--{name} applies to the canonical RAFT family "
                          "only (the sparse family has no small variant "
                          "and fixed fork-corr semantics)")
 
