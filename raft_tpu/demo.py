@@ -59,7 +59,8 @@ def demo(args) -> None:
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", required=True,
-                        help="torch .pth or orbax checkpoint dir")
+                        help="torch .pth, orbax checkpoint dir, or 'random' "
+                             "(pipeline smoke test, random weights)")
     parser.add_argument("--path", required=True,
                         help="directory of ordered frames")
     parser.add_argument("--out", default="demo_out")

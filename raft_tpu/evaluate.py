@@ -306,7 +306,11 @@ def load_predictor(model_path: str, small: bool = False,
                    corr_dtype: str = "float32") -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
-    (the reference ``evaluate.py:312-313`` model-loading path)."""
+    (the reference ``evaluate.py:312-313`` model-loading path).
+
+    ``model_path="random"`` skips checkpoint loading and uses randomly
+    initialized weights — a pipeline smoke-test mode for hosts without
+    downloaded checkpoints (outputs are meaningless flow)."""
     from raft_tpu import checkpoint as ckpt_lib
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
@@ -334,6 +338,12 @@ def load_predictor(model_path: str, small: bool = False,
                          mixed_precision=mixed_precision,
                          corr_dtype=corr_dtype)
         model = RAFT(cfg)
+    if model_path == "random":
+        rng = jax.random.PRNGKey(0)
+        dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        variables = model.init({"params": rng, "dropout": rng},
+                               dummy, dummy, iters=1)
+        return FlowPredictor(model, variables, iters=iters)
     params, batch_stats = ckpt_lib.load_params(model_path)
     variables = {"params": params}
     if batch_stats:
@@ -370,7 +380,8 @@ def main(argv=None):
         description="Validate / create submissions (reference "
                     "evaluate.py:303-329).")
     parser.add_argument("--model", required=True,
-                        help="torch .pth or orbax checkpoint dir")
+                        help="torch .pth, orbax checkpoint dir, or 'random' "
+                             "(pipeline smoke test, random weights)")
     parser.add_argument("--dataset", required=True,
                         choices=list(_VALIDATORS) + ["sintel_submission",
                                                      "kitti_submission"])

@@ -234,3 +234,14 @@ def test_predict_dataset_batched_matches_single(rng):
     for i in range(5):
         np.testing.assert_allclose(got_b[i], got_s[i],
                                    rtol=1e-5, atol=1e-4)
+
+
+def test_load_predictor_random_weights():
+    """``--model random`` builds a working predictor without any
+    checkpoint on disk (pipeline smoke-test mode)."""
+    predictor = evaluate.load_predictor("random", small=True, iters=2)
+    im = np.random.default_rng(0).uniform(
+        0, 255, (64, 96, 3)).astype(np.float32)
+    low, up = predictor(im, im)
+    assert up.shape == (64, 96, 2)
+    assert np.isfinite(up).all()
