@@ -185,3 +185,26 @@ def test_learned_position_embedding_exceeds_table_size(rng):
     pos = pe.apply(vs, nt)
     assert pos.shape == (1, 4, 128, 16)
     assert bool(jnp.isfinite(pos).all())
+
+
+def test_profiling_trace_and_breakdown(tmp_path):
+    """profiling.trace captures a device trace and op_breakdown parses
+    per-op self-times out of the raw xplane protobuf."""
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.utils import profiling
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((128, 128))
+    f(x).block_until_ready()
+    with profiling.trace(str(tmp_path / "trace")) as t:
+        for _ in range(2):
+            f(x).block_until_ready()
+    rows = profiling.op_breakdown(t.logdir)
+    assert rows, "no ops parsed from the trace"
+    names = [name for name, _, _ in rows]
+    assert any("dot" in n for n in names), names
