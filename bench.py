@@ -20,10 +20,31 @@ the host↔device round-trip latency of a lone request.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+
+def _wait_for_backend(attempts: int = 4, delay_s: int = 120) -> None:
+    """Survive transient accelerator-tunnel outages: backend init failures
+    are retried by re-execing (jax caches a failed backend in-process)."""
+    try:
+        jax.devices()
+        return
+    except RuntimeError as e:
+        tried = int(os.environ.get("RAFT_BENCH_INIT_TRY", "0"))
+        if tried + 1 >= attempts:
+            raise RuntimeError(
+                f"accelerator backend unavailable after {attempts} "
+                f"attempts: {e}") from e
+        print(f"backend init failed (attempt {tried + 1}/{attempts}): {e}; "
+              f"retrying in {delay_s}s", file=sys.stderr, flush=True)
+        os.environ["RAFT_BENCH_INIT_TRY"] = str(tried + 1)
+        time.sleep(delay_s)
+        os.execv(sys.executable, [sys.executable] + sys.argv)
 
 BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
 H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
@@ -34,6 +55,7 @@ REPS = 10
 
 
 def main():
+    _wait_for_backend()
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
 

@@ -85,7 +85,11 @@ def op_breakdown(logdir: str) -> List[Tuple[str, float, int]]:
     best_total = 0.0
     for plane in xs.planes:
         for line in plane.lines:
-            if "XLA" not in line.name:
+            # Exactly the op-level timelines: "XLA Ops" (TPU device plane)
+            # or the CPU executor thread ("tf_XLA..."). The TPU plane also
+            # has an "XLA Modules" line whose whole-executable spans would
+            # otherwise win the busiest-line vote.
+            if line.name != "XLA Ops" and not line.name.startswith("tf_XLA"):
                 continue
             tot: collections.Counter = collections.Counter()
             cnt: collections.Counter = collections.Counter()
