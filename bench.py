@@ -32,7 +32,15 @@ def _wait_for_backend(attempts: int = 4, delay_s: int = 120) -> None:
     """Survive transient accelerator-tunnel outages: backend init failures
     are retried by re-execing (jax caches a failed backend in-process)."""
     try:
-        jax.devices()
+        dev = jax.devices()[0]
+        requested = (os.environ.get("JAX_PLATFORMS")
+                     or str(jax.config.jax_platforms or ""))
+        if dev.platform == "cpu" and not requested.startswith("cpu"):
+            # Silent accelerator→CPU fallback would publish a wildly wrong
+            # vs_baseline; make it loud (explicit cpu runs stay quiet).
+            print("WARNING: no accelerator available — benchmarking on "
+                  "CPU; vs_baseline is not comparable",
+                  file=sys.stderr, flush=True)
         return
     except RuntimeError as e:
         tried = int(os.environ.get("RAFT_BENCH_INIT_TRY", "0"))

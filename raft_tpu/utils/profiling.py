@@ -77,8 +77,8 @@ def op_breakdown(logdir: str) -> List[Tuple[str, float, int]]:
 
     Returns ``[(op_name, total_ms, count), ...]`` sorted by time. On TPU
     the ops live in the device plane's "XLA Ops" timeline; CPU traces put
-    them on an executor thread line named ``tf_XLA...`` — any line whose
-    name mentions XLA is considered, and the busiest one wins.
+    them on an executor thread line named ``tf_XLA...``. Exactly those two
+    line kinds are considered, and the busiest one wins.
     """
     xs = _load_xspace(logdir)
     best: Dict[str, Tuple[float, int]] = {}

@@ -202,3 +202,26 @@ def test_sparse_test_mode_drives_shared_eval_harness(rng):
     # warm start is a canonical-RAFT capability; the sparse family refuses
     with pytest.raises(ValueError):
         model.apply(vs, img, img, flow_init=jnp.zeros((1, 4, 6, 2)))
+
+
+@pytest.mark.parametrize("channels", [5, 16])
+def test_msda_gradcheck_channels(rng, channels):
+    """Numerical gradient check across odd/even channel counts — the
+    reference exercises its CUDA kernel the same way
+    (``core/ops/test.py:63-78``, channels {30, 32, 71, ...})."""
+    from jax.test_util import check_grads
+
+    shapes = [(4, 5), (2, 3)]
+    N, M, Lq, P = 1, 2, 3, 2
+    L = len(shapes)
+    S = sum(h * w for h, w in shapes)
+    value = jnp.asarray(rng.standard_normal((N, S, M, channels)),
+                        jnp.float32)
+    locations = jnp.asarray(
+        rng.uniform(0.1, 0.9, (N, Lq, M, L, P, 2)), jnp.float32)
+    weights = jnp.asarray(rng.random((N, Lq, M, L, P)), jnp.float32)
+    weights = weights / weights.sum(axis=(-2, -1), keepdims=True)
+
+    check_grads(lambda v, w: ms_deform_attn(v, shapes, locations, w),
+                (value, weights), order=1, modes=["rev"],
+                atol=1e-2, rtol=1e-2)
