@@ -13,8 +13,19 @@ Throughput is measured at batch=24 (the sweep's knee on v5e-1; the f32
 all-pairs volume pyramid for 24 pairs is ~6 GB of the 16 GB HBM): per-chip
 eval throughput is the metric, and batching frame pairs is how the
 framework evaluates a 1000-frame Sintel pass on TPU; reps are dispatched
-back-to-back and synced once so the device pipeline rate is measured, not
-the host↔device round-trip latency of a lone request.
+back-to-back and synced once (via a scalar host readback — more reliable
+than ``block_until_ready`` through the accelerator tunnel) so the device
+pipeline rate is measured, not the host↔device round-trip latency of a
+lone request.
+
+Failure contract: this script ALWAYS prints exactly one JSON line.  If the
+accelerator tunnel is down, retries are bounded (``RAFT_BENCH_RETRY_S``,
+default 15s x 4 attempts) and absolute wall-clock deadlines
+(``RAFT_BENCH_DEADLINE_S`` for backend init, then
+``RAFT_BENCH_TOTAL_DEADLINE_S`` as a total cap, both measured from the
+FIRST exec across re-exec retries) are enforced by a watchdog thread —
+backend init can hang inside C code far past any Python-level timeout —
+so the driver artifact parses regardless of tunnel weather.
 """
 
 from __future__ import annotations
@@ -22,38 +33,11 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-
-
-def _wait_for_backend(attempts: int = 4, delay_s: int = 120) -> None:
-    """Survive transient accelerator-tunnel outages: backend init failures
-    are retried by re-execing (jax caches a failed backend in-process)."""
-    try:
-        dev = jax.devices()[0]
-        requested = (os.environ.get("JAX_PLATFORMS")
-                     or str(jax.config.jax_platforms or ""))
-        if dev.platform == "cpu" and not requested.startswith("cpu"):
-            # Silent accelerator→CPU fallback would publish a wildly wrong
-            # vs_baseline; make it loud (explicit cpu runs stay quiet).
-            print("WARNING: no accelerator available — benchmarking on "
-                  "CPU; vs_baseline is not comparable",
-                  file=sys.stderr, flush=True)
-        return
-    except RuntimeError as e:
-        tried = int(os.environ.get("RAFT_BENCH_INIT_TRY", "0"))
-        if tried + 1 >= attempts:
-            raise RuntimeError(
-                f"accelerator backend unavailable after {attempts} "
-                f"attempts: {e}") from e
-        print(f"backend init failed (attempt {tried + 1}/{attempts}): {e}; "
-              f"retrying in {delay_s}s", file=sys.stderr, flush=True)
-        os.environ["RAFT_BENCH_INIT_TRY"] = str(tried + 1)
-        time.sleep(delay_s)
-        os.execv(sys.executable, [sys.executable] + sys.argv)
-
+METRIC = "sintel_image_pairs_per_sec_per_chip_iters12"
+UNIT = "image-pairs/sec"
 BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
 H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
 ITERS = 12
@@ -62,8 +46,142 @@ WARMUP = 2
 REPS = 10
 
 
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit(payload: dict) -> bool:
+    """Print the one-and-only JSON artifact line (first caller wins —
+    the watchdog thread may race the success path).  The print happens
+    INSIDE the lock so a losing watchdog blocks here until the winning
+    line is fully flushed before it ``os._exit``s."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        print(json.dumps(payload), flush=True)
+    return True
+
+
+_PLATFORM: str | None = None   # set once the backend is up, for triage
+
+
+def _emit_failure(msg: str) -> None:
+    """Terminal failure still yields one parseable JSON artifact line.
+    Includes the platform when known so a CPU-fallback timeout is not
+    misread as a tunnel hang."""
+    payload = {
+        "metric": METRIC,
+        "value": None,
+        "unit": UNIT,
+        "vs_baseline": None,
+        "error": msg,
+    }
+    if _PLATFORM is not None:
+        payload["platform"] = _PLATFORM
+    _emit(payload)
+
+
+class _Watchdog:
+    """Hard wall-clock deadline surviving re-exec retries.
+
+    ``jax.devices()`` on a wedged tunnel can block inside
+    ``xla_client.make_c_api_client`` for 10+ minutes, beyond any Python
+    try/except — only a watchdog thread + ``os._exit`` reliably gets the
+    JSON line out before the driver's own timeout (rc=124, no artifact).
+
+    Two phases, BOTH anchored to the first-exec start time so the whole
+    process fits inside the driver's kill window (round-1 evidence puts
+    that window near 30 min): a tight init deadline
+    (``RAFT_BENCH_DEADLINE_S``) while the backend comes up, then — via
+    :meth:`rearm` once the backend is healthy — a total-wall cap
+    (``RAFT_BENCH_TOTAL_DEADLINE_S``, default 1500s from first exec) for
+    compile + measurement, so a tunnel death mid-run still emits the
+    artifact before the driver's rc=124.
+    """
+
+    def __init__(self) -> None:
+        deadline_s = float(os.environ.get("RAFT_BENCH_DEADLINE_S", "1200"))
+        self._start = float(os.environ.setdefault("RAFT_BENCH_START",
+                                                  str(time.time())))
+        self._expiry = self._start + deadline_s
+        self._reason = "backend-init"
+        if time.time() >= self._expiry:
+            _emit_failure(f"deadline {deadline_s:.0f}s exceeded "
+                          f"before start")
+            os._exit(0)
+        threading.Thread(target=self._watch, daemon=True).start()
+
+    def rearm(self, unbounded: bool = False) -> None:
+        if unbounded:
+            # Explicitly-requested CPU smoke runs are interactive, not
+            # driver artifacts; full-size CPU compute takes hours and
+            # must not be misreported as an accelerator hang.
+            self._expiry = float("inf")
+            return
+        total_s = float(
+            os.environ.get("RAFT_BENCH_TOTAL_DEADLINE_S", "1500"))
+        self._expiry = self._start + total_s
+        self._reason = "compute (total wall cap)"
+
+    def _watch(self) -> None:
+        while True:
+            remaining = self._expiry - time.time()
+            if remaining <= 0:
+                _emit_failure(
+                    f"{self._reason} deadline exceeded "
+                    f"(accelerator hang?)")
+                os._exit(0)
+            time.sleep(min(remaining, 5.0))
+
+
+def _wait_for_backend(attempts: int = 4) -> bool:
+    """Survive transient accelerator-tunnel outages: backend init failures
+    are retried by re-execing (jax caches a failed backend in-process).
+    The retry budget (attempts x RAFT_BENCH_RETRY_S) is kept far below the
+    driver's timeout; terminal failure exits via ``_emit_failure``.
+
+    Returns True iff the run is an *explicitly requested* CPU run (local
+    smoke) — the caller uses this to lift the watchdog's wall cap."""
+    global _PLATFORM
+    import jax
+
+    delay_s = float(os.environ.get("RAFT_BENCH_RETRY_S", "15"))
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:  # backend-init failures vary in exception type
+        tried = int(os.environ.get("RAFT_BENCH_INIT_TRY", "0"))
+        if tried + 1 >= attempts:
+            _emit_failure(
+                f"accelerator backend unavailable after {attempts} "
+                f"attempts: {e}")
+            sys.exit(0)
+        print(f"backend init failed (attempt {tried + 1}/{attempts}): {e}; "
+              f"retrying in {delay_s:.0f}s", file=sys.stderr, flush=True)
+        os.environ["RAFT_BENCH_INIT_TRY"] = str(tried + 1)
+        time.sleep(delay_s)
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+    _PLATFORM = dev.platform
+    requested = (os.environ.get("JAX_PLATFORMS")
+                 or str(getattr(jax.config, "jax_platforms", "") or ""))
+    cpu_explicit = requested.startswith("cpu")
+    if dev.platform == "cpu" and not cpu_explicit:
+        # Silent accelerator→CPU fallback would publish a wildly wrong
+        # vs_baseline; make it loud (explicit cpu runs stay quiet).
+        print("WARNING: no accelerator available — benchmarking on "
+              "CPU; vs_baseline is not comparable",
+              file=sys.stderr, flush=True)
+    os.environ.pop("RAFT_BENCH_INIT_TRY", None)
+    return dev.platform == "cpu" and cpu_explicit
+
+
 def main():
-    _wait_for_backend()
+    watchdog = _Watchdog()
+    cpu_smoke = _wait_for_backend()
+    watchdog.rearm(unbounded=cpu_smoke)
+    import jax
+    import jax.numpy as jnp
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
 
@@ -78,34 +196,48 @@ def main():
 
     @jax.jit
     def fwd(i1, i2):
-        return model.apply(variables, i1, i2, test_mode=True)[1]
+        # Scalar-reduce the flow so syncing is a 4-byte host readback:
+        # block_until_ready alone has returned early through the tunnel.
+        flow_up = model.apply(variables, i1, i2, test_mode=True)[1]
+        return flow_up, jnp.sum(flow_up)
 
     def throughput(batch: int) -> float:
         img = jnp.broadcast_to(img1, (batch, H, W, 3))
         for _ in range(WARMUP):
-            fwd(img, img).block_until_ready()
-        # Dispatch all reps, block once — measures device pipeline rate
+            float(fwd(img, img)[1])
+        # Dispatch all reps, sync once — measures device pipeline rate
         # (how eval/training actually stream batches), not the host↔device
         # round-trip latency of a lone request.
+        # Keep only the newest output reference: execution is async, so
+        # reps still pipeline back-to-back, but earlier ~86 MB flow
+        # buffers are freed as they complete instead of 10 being pinned.
         t0 = time.perf_counter()
-        outs = [fwd(img, img) for _ in range(REPS)]
-        outs[-1].block_until_ready()
+        for _ in range(REPS):
+            out = fwd(img, img)
+        float(out[1])
         return REPS * batch / (time.perf_counter() - t0)
 
     batch1 = throughput(1)
     pairs_per_sec = throughput(BATCH)
-    print(json.dumps({
-        "metric": "sintel_image_pairs_per_sec_per_chip_iters12",
+    _emit({
+        "metric": METRIC,
         "value": round(pairs_per_sec, 3),
-        "unit": "image-pairs/sec",
+        "unit": UNIT,
         "batch": BATCH,
+        "platform": platform,
         # single-pair throughput, apples-to-apples with the latency-bound
         # 10 pairs/sec V100 estimate the baseline is normalized to
         "value_batch1": round(batch1, 3),
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC, 3),
         "vs_baseline_batch1": round(batch1 / BASELINE_PAIRS_PER_SEC, 3),
-    }))
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — artifact must parse
+        _emit_failure(f"{type(e).__name__}: {e}")
+        sys.exit(0)
