@@ -18,4 +18,29 @@ Design principles (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
-from raft_tpu.config import RAFTConfig  # noqa: F401
+import os as _os
+
+
+def _sync_platform_from_env() -> None:
+    """Restore standard JAX semantics for ``JAX_PLATFORMS``.
+
+    Some accelerator plugins pin ``jax_platforms`` in ``jax.config`` at
+    interpreter start (via sitecustomize), after which the documented
+    ``JAX_PLATFORMS=cpu python ...`` override is silently ignored and a
+    CPU-intended run hangs on an unreachable accelerator tunnel.  If the
+    user set the env var, make the config agree — a no-op everywhere
+    else, and only possible before the backend initializes."""
+    want = _os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        if str(jax.config.jax_platforms or "") != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # never let platform sync break package import
+
+
+_sync_platform_from_env()
+
+from raft_tpu.config import RAFTConfig  # noqa: E402,F401

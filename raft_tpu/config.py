@@ -151,6 +151,10 @@ class TrainConfig:
     epsilon: float = 1e-8
     clip: float = 1.0
     gamma: float = 0.8              # loss decay weight (train.py gamma flag)
+    # "all" = reference loss semantics, .mean() over all pixels with
+    # invalid zeroed (train.py:70); "valid" = divide by valid-pixel count
+    # (density-independent opt-in; different dynamics on sparse KITTI/HD1K)
+    loss_normalization: str = "all"
     add_noise: bool = False
     iters: int = 12
     val_freq: int = 5000            # reference train.py VAL_FREQ

@@ -61,15 +61,20 @@ def main(argv=None):
     parser.add_argument("--model", required=True,
                         help="torch .pth, orbax checkpoint dir, or 'random' "
                              "(pipeline smoke test, random weights)")
-    parser.add_argument("--path", required=True,
-                        help="directory of ordered frames")
+    parser.add_argument("--path", default=None,
+                        help="directory of ordered frames (default: the "
+                             "repo-owned assets/demo-frames fixtures)")
     parser.add_argument("--out", default="demo_out")
     parser.add_argument("--small", action="store_true")
     parser.add_argument("--iters", type=int, default=20)
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--show", action="store_true")
-    demo(parser.parse_args(argv))
+    args = parser.parse_args(argv)
+    if args.path is None:
+        from raft_tpu.evaluate import ASSETS_DIR
+        args.path = osp.join(ASSETS_DIR, "demo-frames")
+    demo(args)
 
 
 if __name__ == "__main__":

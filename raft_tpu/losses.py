@@ -51,6 +51,7 @@ def epe_metrics(flow_pred: jnp.ndarray, flow_gt: jnp.ndarray,
 def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
                   valid: jnp.ndarray, gamma: float = 0.8,
                   max_flow: float = MAX_FLOW,
+                  normalization: str = "all",
                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Weighted multi-iteration L1 flow loss.
 
@@ -64,19 +65,34 @@ def sequence_loss(flow_preds: jnp.ndarray, flow_gt: jnp.ndarray,
         reference ``train.py:65-66``).
       max_flow: exclude pixels with GT magnitude above this
         (reference ``train.py:60-62``).
+      normalization: ``"all"`` (default) reproduces the reference exactly —
+        ``(valid * |pred - gt|).mean()`` over ALL pixels with invalid ones
+        zeroed (reference ``train.py:70``), so on sparse datasets
+        (KITTI/HD1K) the effective loss scales with the valid fraction.
+        ``"valid"`` divides by the valid-pixel count instead — a
+        density-independent variant (larger gradients on sparse stages;
+        changes training dynamics vs the reference, opt in deliberately).
 
     Returns:
       scalar loss, metrics dict (computed on the final iteration).
     """
+    if normalization not in ("all", "valid"):
+        raise ValueError(f"normalization must be 'all' or 'valid', "
+                         f"got {normalization!r}")
     n = flow_preds.shape[0]
     mag = jnp.sqrt(jnp.sum(flow_gt ** 2, axis=-1))
     v = (valid.astype(jnp.float32)
          * (mag < max_flow).astype(jnp.float32))          # (B,H,W)
-    denom = jnp.maximum(v.sum(), 1.0)
 
     weights = gamma ** jnp.arange(n - 1, -1, -1, dtype=jnp.float32)
     l1 = jnp.abs(flow_preds - flow_gt[None])              # (n,B,H,W,2)
-    per_iter = (l1.mean(axis=-1) * v[None]).sum(axis=(1, 2, 3)) / denom
+    masked = l1.mean(axis=-1) * v[None]                   # (n,B,H,W)
+    if normalization == "all":
+        # (valid[:, None] * i_loss).mean(): channel mean folded into
+        # l1.mean(-1) above, remaining denominator is B*H*W.
+        per_iter = masked.mean(axis=(1, 2, 3))
+    else:
+        per_iter = masked.sum(axis=(1, 2, 3)) / jnp.maximum(v.sum(), 1.0)
     loss = jnp.sum(weights * per_iter)
 
     metrics = epe_metrics(flow_preds[-1], flow_gt, v)
@@ -93,6 +109,15 @@ def sparse_keypoint_loss(sparse_preds, flow_gt: jnp.ndarray,
     Each outer iteration predicts reference points (normalized src coords)
     and per-keypoint flows; the loss is an L1 between each keypoint's flow
     and the ground-truth flow bilinearly read at its reference point.
+
+    DELIBERATE DEVIATION from the reference: the fork reads GT at rounded
+    keypoint coordinates through a flat gather whose index is computed as
+    ``y * x`` instead of ``y * W + x`` (reference ``train.py:75-77``) — a
+    real indexing bug that pairs keypoints with unrelated GT pixels.  No
+    fork weights are published, so bit-parity with the bug is moot; this
+    implementation samples the GT bilinearly at the exact (fractional)
+    reference point, which is what the rounded-gather was evidently
+    meant to do.
 
     Args:
       sparse_preds: sequence of ``(ref_points, key_flows)`` per iteration —

@@ -118,7 +118,8 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
                     mutable=["batch_stats"])
                 out = jnp.stack(list(flow_preds))
                 loss, metrics = sequence_loss(
-                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
+                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma,
+                    normalization=tcfg.loss_normalization)
                 if tcfg.sparse_lambda > 0:
                     from raft_tpu.losses import sparse_keypoint_loss
                     # key flows are normalized src-dst offsets; the loss
@@ -141,7 +142,8 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
                     rngs={"dropout": dropout_rng},
                     mutable=["batch_stats"])
                 loss, metrics = sequence_loss(
-                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma)
+                    out, batch["flow"], batch["valid"], gamma=tcfg.gamma,
+                    normalization=tcfg.loss_normalization)
             # Under freeze_bn (or a BN-free model) nothing is written to
             # the batch_stats collection; keep the existing stats then.
             new_bs = mutated.get("batch_stats")

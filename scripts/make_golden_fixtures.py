@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Generate the repo-owned golden fixtures under ``assets/``.
+
+Two things the reference ships (or implies) that this repo must own
+outright (VERDICT r1 items 3 & 7):
+
+1. ``assets/demo-frames/`` — license-safe *generated* frame pairs filling
+   the role of the reference's ``demo-frames/`` Sintel PNGs
+   (``/root/reference/README.md:25-28``): procedural band-limited textures
+   warped by known affine maps, so each pair also has exact ground-truth
+   flow (``.flo``) — frame2(A·p + b) == frame1(p), flow(p) = (A−I)p + b.
+
+2. ``assets/golden/`` — end-to-end golden outputs of the canonical torch
+   RAFT (reference ``core/raft.py`` semantics via ``tests/torch_oracle``)
+   with deterministic fp16-rounded random weights, stored as:
+   ``weights.npz`` (fp16, torch state-dict keys — loadable without torch),
+   ``flow_golden_NN.npy`` (f32 final-iteration flow per pair), and
+   ``manifest.json`` (iters, seed, per-pair EPE vs GT).  The published
+   checkpoints are unreachable here (zero egress —
+   ``scripts/download_models.sh`` DNS-fails), so golden parity is pinned
+   against this fixed-seed model instead: same converter, same graph as
+   the published weights would exercise.
+
+Run from the repo root with the reference mounted (generation only; the
+tests that CONSUME these fixtures never touch the reference):
+
+    JAX_PLATFORMS=cpu python scripts/make_golden_fixtures.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_CORE = "/root/reference/core"
+H, W = 192, 256
+ITERS = 12
+SEED = 0
+
+# (name, A (2x2 row-major), b (x, y)) — flow(p) = (A - I) p + b
+WARPS = [
+    ("translate", np.array([[1.0, 0.0], [0.0, 1.0]]), np.array([3.5, -2.25])),
+    ("rotate", None, np.array([-1.5, 2.0])),       # A filled in below (1.2°)
+    ("zoom", np.array([[1.03, 0.0], [0.0, 1.03]]), np.array([-2.0, -1.0])),
+]
+_th = np.deg2rad(1.2)
+WARPS[1] = ("rotate",
+            np.array([[np.cos(_th), -np.sin(_th)],
+                      [np.sin(_th), np.cos(_th)]]), WARPS[1][2])
+
+
+def make_texture(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    """Band-limited RGB texture: multi-octave smoothed noise, contrast
+    stretched to fill [0, 255]."""
+    from scipy.ndimage import gaussian_filter
+
+    tex = np.zeros((h, w, 3), np.float32)
+    for sigma, amp in ((12, 1.0), (5, 0.6), (2, 0.35)):
+        n = rng.standard_normal((h, w, 3)).astype(np.float32)
+        tex += amp * gaussian_filter(n, sigma=(sigma, sigma, 0))
+    lo, hi = np.percentile(tex, [1, 99])
+    return np.clip((tex - lo) / (hi - lo), 0, 1) * 255.0
+
+
+def render_pair(rng, A: np.ndarray, b: np.ndarray):
+    """frame1(p) = T(p); frame2(q) = T(A^-1 (q - b)); both uint8.
+
+    With q = A p + b, frame2(q) == frame1(p) exactly, so the forward flow
+    at p is (A - I) p + b (coordinates are (x, y), origin top-left)."""
+    from scipy.ndimage import map_coordinates
+
+    pad = 32   # covers |flow| + interpolation support
+    tex = make_texture(rng, H + 2 * pad, W + 2 * pad)
+
+    ys, xs = np.mgrid[0:H, 0:W].astype(np.float64)
+    frame1 = tex[pad:pad + H, pad:pad + W]
+
+    Ainv = np.linalg.inv(A)
+    # sample T at A^-1 (q - b) for every output pixel q
+    qx, qy = xs, ys
+    sx = Ainv[0, 0] * (qx - b[0]) + Ainv[0, 1] * (qy - b[1])
+    sy = Ainv[1, 0] * (qx - b[0]) + Ainv[1, 1] * (qy - b[1])
+    frame2 = np.stack([
+        map_coordinates(tex[..., c], [sy + pad, sx + pad], order=3,
+                        mode="reflect")
+        for c in range(3)], axis=-1)
+
+    flow = np.stack([(A[0, 0] - 1) * xs + A[0, 1] * ys + b[0],
+                     A[1, 0] * xs + (A[1, 1] - 1) * ys + b[1]],
+                    axis=-1).astype(np.float32)
+    return (np.clip(frame1, 0, 255).astype(np.uint8),
+            np.clip(frame2, 0, 255).astype(np.uint8), flow)
+
+
+def main():
+    from PIL import Image
+
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    sys.path.insert(0, REF_CORE)
+    from raft_tpu.data.frame_utils import write_flo
+
+    frames_dir = os.path.join(REPO, "assets", "demo-frames")
+    golden_dir = os.path.join(REPO, "assets", "golden")
+    os.makedirs(frames_dir, exist_ok=True)
+    os.makedirs(golden_dir, exist_ok=True)
+
+    rng = np.random.default_rng(7)
+    pairs = []
+    for i, (name, A, b) in enumerate(WARPS):
+        f1, f2, flow = render_pair(rng, A, b)
+        p1 = os.path.join(frames_dir, f"frame_{2 * i + 1:04d}.png")
+        p2 = os.path.join(frames_dir, f"frame_{2 * i + 2:04d}.png")
+        Image.fromarray(f1).save(p1)
+        Image.fromarray(f2).save(p2)
+        write_flo(os.path.join(golden_dir, f"flow_gt_{i:02d}.flo"), flow)
+        pairs.append((name, p1, p2, flow))
+        print(f"pair {i} ({name}): |flow| mean "
+              f"{np.linalg.norm(flow, axis=-1).mean():.2f}px")
+
+    # --- torch golden outputs with fp16-rounded deterministic weights ---
+    import torch
+    from torch_oracle import (build_reference_raft_large,
+                              torch_canonical_raft_forward)
+    import corr as ref_corr
+
+    fnet, cnet, ub = build_reference_raft_large(seed=SEED)
+
+    # fp16 round-trip BEFORE recording goldens, so the stored npz (fp16,
+    # half the size) reproduces them bit-for-bit through any loader.
+    state = {}
+    for prefix, mod in (("fnet", fnet), ("cnet", cnet),
+                        ("update_block", ub)):
+        sd = mod.state_dict()
+        for k, v in sd.items():
+            sd[k] = v.half().float()
+        mod.load_state_dict(sd)
+        for k, v in sd.items():
+            state[f"{prefix}.{k}"] = v.numpy().astype(np.float16)
+    np.savez_compressed(os.path.join(golden_dir, "weights.npz"), **state)
+
+    manifest = {"iters": ITERS, "seed": SEED, "H": H, "W": W, "pairs": []}
+    for i, (name, p1, p2, flow_gt) in enumerate(pairs):
+        img1 = np.asarray(Image.open(p1), np.float32)
+        img2 = np.asarray(Image.open(p2), np.float32)
+        t1 = torch.from_numpy(img1.transpose(2, 0, 1))[None]
+        t2 = torch.from_numpy(img2.transpose(2, 0, 1))[None]
+        with torch.no_grad():
+            flows = torch_canonical_raft_forward(
+                fnet, cnet, ub, t1, t2, iters=ITERS, corr_mod=ref_corr)
+        final = flows[-1][0].numpy().transpose(1, 2, 0).astype(np.float32)
+        np.save(os.path.join(golden_dir, f"flow_golden_{i:02d}.npy"), final)
+        epe = float(np.sqrt(((final - flow_gt) ** 2).sum(-1)).mean())
+        manifest["pairs"].append({"name": name,
+                                  "frame1": os.path.basename(p1),
+                                  "frame2": os.path.basename(p2),
+                                  "epe_vs_gt": round(epe, 4)})
+        print(f"golden {i} ({name}): torch EPE vs GT {epe:.3f}px "
+              f"(random weights — parity anchor, not a quality claim)")
+
+    with open(os.path.join(golden_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    print("fixtures written to", os.path.join(REPO, "assets"))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,71 +39,6 @@ def ref_modules():
     sys.path.remove(REF)
 
 
-def _torch_canonical_corr_lookup(pyramid, coords1, radius):
-    """Canonical pyramid lookup (pixel coords / 2**level per level; the
-    fork's CorrBlock dropped the rescale — reference core/corr.py:42 vs
-    original RAFT). ``coords1``: (N, 2, H, W)."""
-    import torch.nn.functional as F
-    N, _, H, W = coords1.shape
-    r = radius
-    off = torch.linspace(-r, r, 2 * r + 1)
-    # window position (i, j) offsets x by off[i], y by off[j]
-    ox, oy = torch.meshgrid(off, off, indexing="ij")
-    delta = torch.stack([ox, oy], dim=-1).view(1, 2 * r + 1, 2 * r + 1, 2)
-    out = []
-    for lvl, corr in enumerate(pyramid):
-        c = coords1.permute(0, 2, 3, 1).reshape(N * H * W, 1, 1, 2) / 2 ** lvl
-        grid = c + delta
-        h2, w2 = corr.shape[-2:]
-        gx = 2 * grid[..., 0] / (w2 - 1) - 1
-        gy = 2 * grid[..., 1] / (h2 - 1) - 1
-        g = torch.stack([gx, gy], dim=-1)
-        s = F.grid_sample(corr, g, align_corners=True)
-        out.append(s.view(N, H, W, -1))
-    return torch.cat(out, dim=-1).permute(0, 3, 1, 2)
-
-
-def _torch_canonical_raft_forward(fnet, cnet, update_block, img1, img2,
-                                  iters, corr_mod, radius=4, levels=4):
-    """Canonical RAFT forward semantics in torch (pixel coords,
-    4-level pyramid), used purely as the parity oracle."""
-    import torch.nn.functional as F
-
-    img1 = 2 * (img1 / 255.0) - 1.0
-    img2 = 2 * (img2 / 255.0) - 1.0
-    fmap1, fmap2 = fnet([img1, img2])
-    corr_fn = corr_mod.CorrBlock(fmap1, fmap2, num_levels=levels,
-                                 radius=radius)
-    cnet_out = cnet(img1)
-    net, inp = torch.split(cnet_out, [128, 128], dim=1)
-    net, inp = torch.tanh(net), torch.relu(inp)
-
-    N, _, H, W = fmap1.shape
-    ys, xs = torch.meshgrid(torch.arange(H).float(),
-                            torch.arange(W).float(), indexing="ij")
-    coords0 = torch.stack([xs, ys], dim=0)[None].repeat(N, 1, 1, 1)
-    coords1 = coords0.clone()
-
-    flows_up = []
-    for _ in range(iters):
-        coords1 = coords1.detach()
-        corr = _torch_canonical_corr_lookup(corr_fn.corr_pyramid, coords1,
-                                            radius)
-        flow = coords1 - coords0
-        net, up_mask, delta_flow = update_block(net, inp, corr, flow)
-        coords1 = coords1 + delta_flow
-        new_flow = coords1 - coords0
-        # convex upsampling (reference core/raft.py:74-85)
-        m = up_mask.view(N, 1, 9, 8, 8, H, W)
-        m = torch.softmax(m, dim=2)
-        up = F.unfold(8 * new_flow, [3, 3], padding=1)
-        up = up.view(N, 2, 9, 1, 1, H, W)
-        up = torch.sum(m * up, dim=2)
-        up = up.permute(0, 1, 4, 2, 5, 3).reshape(N, 2, 8 * H, 8 * W)
-        flows_up.append(up)
-    return flows_up
-
-
 def test_full_model_parity(ref_modules, rng):
     extractor_origin, ref_update, _ref_corr = ref_modules
     import corr as ref_corr  # from REF path
@@ -124,8 +59,10 @@ def test_full_model_parity(ref_modules, rng):
     t1 = torch.from_numpy(img1_np.transpose(0, 3, 1, 2))
     t2 = torch.from_numpy(img2_np.transpose(0, 3, 1, 2))
 
+    from torch_oracle import torch_canonical_raft_forward
+
     with torch.no_grad():
-        ref_flows = _torch_canonical_raft_forward(
+        ref_flows = torch_canonical_raft_forward(
             fnet, cnet, ub, t1, t2, iters=4, corr_mod=ref_corr)
 
     # Convert the torch weights into our single variable tree.

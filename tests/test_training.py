@@ -23,16 +23,67 @@ class TestSequenceLoss:
         gamma = 0.8
 
         loss, metrics = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
-                                      jnp.asarray(valid), gamma=gamma)
+                                      jnp.asarray(valid), gamma=gamma,
+                                      normalization="valid")
 
-        # Manual reference (the torch formula, reference train.py:51-100):
-        # per-iteration weight gamma**(n-i-1), L1 over channels, masked mean.
+        # Manual formula for the opt-in density-independent variant:
+        # weight gamma**(n-i-1), L1 over channels, valid-count-normalized.
         expect = 0.0
         for i in range(n):
             w = gamma ** (n - i - 1)
             l1 = np.abs(preds[i] - gt).mean(axis=-1)
             expect += w * (l1 * valid).sum() / valid.sum()
         np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+    @pytest.mark.parametrize("valid_frac", [1.0, 0.2])
+    def test_torch_reference_parity(self, rng, valid_frac):
+        """Default normalization reproduces the reference torch loss
+        (train.py:60-70) exactly, on a dense mask AND a KITTI-style
+        sparse one (~20% valid) where the two normalizations differ by
+        the valid fraction."""
+        import torch
+
+        n, B, H, W = 3, 2, 10, 12
+        gamma = 0.8
+        preds = rng.normal(size=(n, B, H, W, 2)).astype(np.float32)
+        gt = (rng.normal(size=(B, H, W, 2)) * 5).astype(np.float32)
+        valid = (rng.uniform(size=(B, H, W)) < valid_frac).astype(np.float32)
+        # a few GT pixels beyond MAX_FLOW to exercise the magnitude gate
+        gt[0, 0, 0] = 500.0
+
+        # Reference semantics, written in torch NCHW layout as the fork
+        # computes it: mask = (valid >= 0.5) & (|gt| < max_flow), then
+        # per-iteration  gamma**(n-i-1) * (mask[:, None] * |pred-gt|).mean()
+        t_gt = torch.from_numpy(gt).permute(0, 3, 1, 2)
+        t_valid = torch.from_numpy(valid)
+        mag = torch.sum(t_gt ** 2, dim=1).sqrt()
+        t_mask = ((t_valid >= 0.5) & (mag < 400.0)).float()
+        t_loss = torch.zeros(())
+        for i in range(n):
+            t_pred = torch.from_numpy(preds[i]).permute(0, 3, 1, 2)
+            i_loss = (t_pred - t_gt).abs()
+            t_loss = t_loss + gamma ** (n - i - 1) * (
+                t_mask[:, None] * i_loss).mean()
+
+        loss, _ = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                jnp.asarray(valid), gamma=gamma,
+                                normalization="all")
+        np.testing.assert_allclose(float(loss), float(t_loss), rtol=1e-5)
+
+        # the variants agree on a fully-valid mask and differ by exactly
+        # the valid fraction on a sparse one
+        loss_v, _ = sequence_loss(jnp.asarray(preds), jnp.asarray(gt),
+                                  jnp.asarray(valid), gamma=gamma,
+                                  normalization="valid")
+        frac = ((valid >= 0.5) & (np.sqrt((gt ** 2).sum(-1)) < 400.0))
+        np.testing.assert_allclose(float(loss),
+                                   float(loss_v) * frac.mean(), rtol=1e-5)
+
+    def test_bad_normalization_rejected(self):
+        with pytest.raises(ValueError, match="normalization"):
+            sequence_loss(jnp.zeros((1, 1, 2, 2, 2)),
+                          jnp.zeros((1, 2, 2, 2)), jnp.ones((1, 2, 2)),
+                          normalization="pixels")
 
     def test_max_flow_exclusion(self, rng):
         preds = jnp.zeros((1, 1, 4, 4, 2))

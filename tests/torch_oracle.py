@@ -1,0 +1,97 @@
+"""Canonical-RAFT forward written in torch, used as a parity oracle.
+
+This is OUR restatement of the canonical algorithm (reference
+``core/raft.py:87-145`` semantics: pixel coordinates, 4-level pyramid,
+convex upsampling) against torch modules loaded from the reference tree.
+It exists so that full-model parity (``test_torch_parity.py``) and the
+golden-fixture generator (``scripts/make_golden_fixtures.py``) share one
+oracle: same graph, same converter, same numbers.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+def torch_canonical_corr_lookup(pyramid, coords1, radius):
+    """Canonical pyramid lookup (pixel coords / 2**level per level; the
+    fork's CorrBlock dropped the rescale — reference core/corr.py:42 vs
+    original RAFT). ``coords1``: (N, 2, H, W)."""
+    import torch.nn.functional as F
+    N, _, H, W = coords1.shape
+    r = radius
+    off = torch.linspace(-r, r, 2 * r + 1)
+    # window position (i, j) offsets x by off[i], y by off[j]
+    ox, oy = torch.meshgrid(off, off, indexing="ij")
+    delta = torch.stack([ox, oy], dim=-1).view(1, 2 * r + 1, 2 * r + 1, 2)
+    out = []
+    for lvl, corr in enumerate(pyramid):
+        c = coords1.permute(0, 2, 3, 1).reshape(N * H * W, 1, 1, 2) / 2 ** lvl
+        grid = c + delta
+        h2, w2 = corr.shape[-2:]
+        gx = 2 * grid[..., 0] / (w2 - 1) - 1
+        gy = 2 * grid[..., 1] / (h2 - 1) - 1
+        g = torch.stack([gx, gy], dim=-1)
+        s = F.grid_sample(corr, g, align_corners=True)
+        out.append(s.view(N, H, W, -1))
+    return torch.cat(out, dim=-1).permute(0, 3, 1, 2)
+
+
+def torch_canonical_raft_forward(fnet, cnet, update_block, img1, img2,
+                                 iters, corr_mod, radius=4, levels=4):
+    """Canonical RAFT forward semantics in torch (pixel coords,
+    4-level pyramid), used purely as the parity oracle."""
+    import torch.nn.functional as F
+
+    img1 = 2 * (img1 / 255.0) - 1.0
+    img2 = 2 * (img2 / 255.0) - 1.0
+    fmap1, fmap2 = fnet([img1, img2])
+    corr_fn = corr_mod.CorrBlock(fmap1, fmap2, num_levels=levels,
+                                 radius=radius)
+    cnet_out = cnet(img1)
+    net, inp = torch.split(cnet_out, [128, 128], dim=1)
+    net, inp = torch.tanh(net), torch.relu(inp)
+
+    N, _, H, W = fmap1.shape
+    ys, xs = torch.meshgrid(torch.arange(H).float(),
+                            torch.arange(W).float(), indexing="ij")
+    coords0 = torch.stack([xs, ys], dim=0)[None].repeat(N, 1, 1, 1)
+    coords1 = coords0.clone()
+
+    flows_up = []
+    for _ in range(iters):
+        coords1 = coords1.detach()
+        corr = torch_canonical_corr_lookup(corr_fn.corr_pyramid, coords1,
+                                           radius)
+        flow = coords1 - coords0
+        net, up_mask, delta_flow = update_block(net, inp, corr, flow)
+        coords1 = coords1 + delta_flow
+        new_flow = coords1 - coords0
+        # convex upsampling (reference core/raft.py:74-85)
+        m = up_mask.view(N, 1, 9, 8, 8, H, W)
+        m = torch.softmax(m, dim=2)
+        up = F.unfold(8 * new_flow, [3, 3], padding=1)
+        up = up.view(N, 2, 9, 1, 1, H, W)
+        up = torch.sum(m * up, dim=2)
+        up = up.permute(0, 1, 4, 2, 5, 3).reshape(N, 2, 8 * H, 8 * W)
+        flows_up.append(up)
+    return flows_up
+
+
+def build_reference_raft_large(seed: int = 0):
+    """Instantiate the reference torch modules (fnet/cnet/update block)
+    for canonical RAFT-large with deterministic random init.  Requires
+    ``/root/reference/core`` importable on sys.path (caller's job)."""
+    from types import SimpleNamespace
+
+    import extractor_origin
+    import update as ref_update
+
+    torch.manual_seed(seed)
+    fnet = extractor_origin.BasicEncoder(output_dim=256, norm_fn="instance",
+                                         dropout=0).eval()
+    cnet = extractor_origin.BasicEncoder(output_dim=256, norm_fn="batch",
+                                         dropout=0).eval()
+    args = SimpleNamespace(corr_levels=4, corr_radius=4)
+    ub = ref_update.BasicUpdateBlock(args, hidden_dim=128).eval()
+    return fnet, cnet, ub
