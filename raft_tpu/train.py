@@ -95,6 +95,13 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
             logger = TrainLogger(os.path.join(log_dir, tcfg.name),
                                  sum_freq=tcfg.sum_freq)
 
+        # One extra jitted forward per val_freq to render the reference's
+        # training image panels (train.py:395-396 → :170-334) from the
+        # current batch with current params.
+        panel_fn = jax.jit(
+            lambda variables, i1, i2: model.apply(variables, i1, i2,
+                                                  iters=tcfg.iters))
+
         step_rng = jax.random.fold_in(rng, 1)
         total_steps = int(state.step)
         keep_training = total_steps < tcfg.num_steps
@@ -108,6 +115,24 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
 
                 if total_steps % tcfg.val_freq == 0:
                     ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+                    # Single-process only: sharded batch/pred arrays span
+                    # non-addressable devices on multi-host meshes and
+                    # device_get would raise there (panels are a debug
+                    # aid, not worth an allgather of full images).
+                    if jax.process_count() == 1:
+                        preds = jax.device_get(panel_fn(
+                            _eval_variables(state), batch["image1"],
+                            batch["image2"]))
+                        i1, i2, fl = jax.device_get(
+                            (batch["image1"], batch["image2"],
+                             batch["flow"]))
+                        if tcfg.model_family == "sparse":
+                            flow_preds, sparse_preds = preds
+                        else:
+                            flow_preds, sparse_preds = preds, None
+                        logger.write_images(i1, i2, fl, flow_preds,
+                                            sparse_preds,
+                                            step=total_steps)
                     if validation:
                         predictor = evaluate.FlowPredictor(
                             model, _eval_variables(state), iters=eval_iters)

@@ -1,0 +1,177 @@
+"""Training-time image panels (reference ``train.py:170-334``).
+
+The reference `Logger.write_image[s]` renders, every VAL_FREQ steps:
+
+* a **flow row** — ``[image1 | image2 | GT colorized | per-iteration
+  predictions colorized]`` (both families);
+* for the sparse family, each prediction tile is preceded by a
+  **keypoint overlay** — image1 with one circle per keypoint, red channel
+  scaled by that keypoint's confidence (``train.py:256-263``);
+* a second **mask row** — for the top-k keypoints by attention-mask mass
+  (k = number of outer iterations, ``train.py:271-287``): the keypoint's
+  circle overlay next to the final flow colorization weighted by its
+  upsampled attention mask.
+
+Rebuilt host-side in pure numpy (+ scipy zoom for mask upsampling): no
+cv2 dependency, NHWC layouts throughout, colorization via the in-repo
+Middlebury wheel (:mod:`raft_tpu.utils.flow_viz` — the reference shells
+out to the ``flow_vis`` pip package, same algorithm).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.utils.flow_viz import flow_to_image
+
+
+def draw_circle(image: np.ndarray, center_xy: Tuple[int, int],
+                radius: int = 10, color=(255, 0, 0),
+                thickness: int = 10) -> np.ndarray:
+    """Draw a circle outline on an HWC uint8 image (in place, returned).
+
+    Matches the role of ``cv2.circle(img, coord, 10, color, 10)`` in the
+    reference: with thickness ~ radius the ring fills into a disk of
+    radius ``radius + thickness/2``."""
+    h, w = image.shape[:2]
+    cx, cy = int(center_xy[0]), int(center_xy[1])
+    r_out = radius + thickness / 2.0
+    r_in = max(radius - thickness / 2.0, 0.0)
+    x0, x1 = max(cx - int(r_out) - 1, 0), min(cx + int(r_out) + 2, w)
+    y0, y1 = max(cy - int(r_out) - 1, 0), min(cy + int(r_out) + 2, h)
+    if x0 >= x1 or y0 >= y1:
+        return image
+    ys, xs = np.mgrid[y0:y1, x0:x1]
+    d2 = (xs - cx) ** 2 + (ys - cy) ** 2
+    ring = (d2 <= r_out ** 2) & (d2 >= r_in ** 2)
+    image[y0:y1, x0:x1][ring] = np.asarray(color, image.dtype)
+    return image
+
+
+def keypoint_overlay(image1: np.ndarray, coords_px: np.ndarray,
+                     confidence: np.ndarray, radius: int = 10,
+                     thickness: int = 10) -> np.ndarray:
+    """image1 (HWC, [0,255]) with one confidence-colored circle per
+    keypoint (reference ``train.py:256-263``: color
+    ``(255*confidence, 0, 0)``)."""
+    img = np.ascontiguousarray(image1.astype(np.uint8))
+    for k in range(len(coords_px)):
+        c = float(np.clip(confidence[k], 0.0, 1.0))
+        draw_circle(img, coords_px[k], radius=radius,
+                    color=(round(255 * c), 0, 0), thickness=thickness)
+    return img
+
+
+def _upsample_mask(mask: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear mask upsample to (h, w) — reference ``F.interpolate``."""
+    from scipy.ndimage import zoom
+    mh, mw = mask.shape
+    if (mh, mw) == (h, w):
+        return mask
+    return zoom(mask, (h / mh, w / mw), order=1, grid_mode=True,
+                mode="grid-constant")
+
+
+def flow_panel(image1: np.ndarray, image2: np.ndarray,
+               flow_gt: np.ndarray,
+               flow_preds: Sequence[np.ndarray]) -> np.ndarray:
+    """Canonical-family row: ``[img1 | img2 | GT | preds...]`` (HWC u8)."""
+    tiles = [image1.astype(np.uint8), image2.astype(np.uint8),
+             flow_to_image(flow_gt)]
+    tiles += [flow_to_image(p) for p in flow_preds]
+    return np.concatenate(tiles, axis=1)
+
+
+def sparse_panel(image1: np.ndarray, image2: np.ndarray,
+                 flow_gt: np.ndarray,
+                 flow_preds: Sequence[np.ndarray],
+                 sparse_preds: Sequence[Tuple]) -> np.ndarray:
+    """Two-row sparse-family panel (reference ``write_images`` layout).
+
+    ``sparse_preds[i] = (ref_points, key_flows, masks, scores)`` with
+    ``ref_points`` (K, 2) normalized (x, y), ``masks`` (K, mh, mw),
+    ``scores`` (K,) — one tuple per outer iteration, batch already
+    indexed out.
+    """
+    H, W = image1.shape[:2]
+    scale = np.asarray([W, H], np.float32)
+
+    pred_tiles: List[np.ndarray] = []
+    coords = confidence = None
+    for (ref, _kf, _m, scores), pred in zip(sparse_preds, flow_preds):
+        coords = np.round(np.asarray(ref) * scale).astype(np.int64)
+        confidence = np.squeeze(np.asarray(scores))
+        pred_tiles.append(keypoint_overlay(image1, coords, confidence))
+        pred_tiles.append(flow_to_image(np.asarray(pred)))
+    pred_img = np.concatenate(pred_tiles, axis=1)
+    last_pred_img = pred_tiles[-1].astype(np.float32)
+
+    # mask row: first iteration's masks AND scores (the circle must show
+    # the confidence of the iteration whose mask is visualized — the
+    # reference reuses the last loop's variable here, a stale-state bug
+    # we don't reproduce), top-k by mass, k = #iterations
+    # (reference train.py:271-287)
+    masks = np.asarray(sparse_preds[0][2], np.float32)
+    conf0 = np.squeeze(np.asarray(sparse_preds[0][3]))
+    top_k = len(flow_preds)
+    mass = masks.sum(axis=(1, 2))
+    mask_tiles: List[np.ndarray] = []
+    for m_i in np.argsort(-mass)[:top_k]:
+        mask_tiles.append(keypoint_overlay(
+            image1, coords[m_i:m_i + 1], conf0[m_i:m_i + 1]))
+        up = _upsample_mask(masks[m_i], H, W)
+        # normalize for visibility: attention mass per pixel is ~1/HW
+        up = up / max(float(up.max()), 1e-12)
+        mask_tiles.append((up[..., None] * last_pred_img).astype(np.uint8))
+    mask_img = np.concatenate(mask_tiles, axis=1)
+
+    base = [image1.astype(np.uint8), image2.astype(np.uint8),
+            flow_to_image(flow_gt)]
+    row1 = np.concatenate(base + [pred_img], axis=1)
+    row2 = np.concatenate(base + [mask_img], axis=1)
+    if row1.shape[1] != row2.shape[1]:   # pad narrower row (k < iters)
+        wide = max(row1.shape[1], row2.shape[1])
+        row1 = _pad_to_width(row1, wide)
+        row2 = _pad_to_width(row2, wide)
+    return np.concatenate([row1, row2], axis=0)
+
+
+def _pad_to_width(row: np.ndarray, width: int) -> np.ndarray:
+    if row.shape[1] >= width:
+        return row
+    pad = np.zeros((row.shape[0], width - row.shape[1], row.shape[2]),
+                   row.dtype)
+    return np.concatenate([row, pad], axis=1)
+
+
+def render_panels(image1: np.ndarray, image2: np.ndarray,
+                  flow_gt: np.ndarray,
+                  flow_preds, sparse_preds=None,
+                  max_samples: int = 10,
+                  seed: int = 0) -> List[np.ndarray]:
+    """Batch → list of per-sample panels.
+
+    ``flow_preds``: (iters, B, H, W, 2) array or per-iteration list;
+    ``sparse_preds``: per-iteration list of batched
+    ``(ref, key_flow, masks, scores)`` for the sparse family, else None.
+    Samples up to ``max_samples`` batch indices (reference
+    ``random.sample``, ``train.py:245``) deterministically from ``seed``.
+    """
+    flow_preds = np.asarray(flow_preds)
+    B = flow_gt.shape[0]
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(B)[:min(max_samples, B)]
+    panels = []
+    for n in idx:
+        if sparse_preds is None:
+            panels.append(flow_panel(image1[n], image2[n], flow_gt[n],
+                                     [p[n] for p in flow_preds]))
+        else:
+            per_sample = [tuple(np.asarray(t)[n] for t in it)
+                          for it in sparse_preds]
+            panels.append(sparse_panel(image1[n], image2[n], flow_gt[n],
+                                       [p[n] for p in flow_preds],
+                                       per_sample))
+    return panels

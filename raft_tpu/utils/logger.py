@@ -194,6 +194,40 @@ class TrainLogger:
             self.running = {}
             self._t0 = time.time()
 
+    def write_images(self, image1, image2, flow_gt, flow_preds,
+                     sparse_preds=None, phase: str = "T",
+                     step: Optional[int] = None, max_samples: int = 10):
+        """Render and sink training image panels (reference
+        ``train.py:170-334``): flow rows for both families, keypoint/
+        confidence circles and attention-mask overlays for the sparse
+        family.  Panels go to TensorBoard (when available) AND to PNGs
+        under ``log_dir/images/`` so headless runs keep the evidence.
+
+        All array args are host numpy, NHWC, images in [0, 255];
+        ``flow_preds`` is (iters, B, H, W, 2) or a per-iteration list;
+        ``sparse_preds`` the sparse family's per-iteration batched
+        ``(ref_points, key_flows, masks, scores)`` tuples, or None.
+        """
+        from raft_tpu.utils.image_panels import render_panels
+
+        step = step if step is not None else self.total_steps
+        panels = render_panels(image1, image2, flow_gt, flow_preds,
+                               sparse_preds, max_samples=max_samples,
+                               seed=step)
+        img_dir = os.path.join(self.log_dir, "images")
+        os.makedirs(img_dir, exist_ok=True)
+        for i, panel in enumerate(panels):
+            name = f"{phase}_Image_{i + 1:02d}"
+            if self._tb is not None:
+                self._tb.add_image(name, panel, step, dataformats="HWC")
+            try:
+                from PIL import Image
+                Image.fromarray(panel).save(
+                    os.path.join(img_dir, f"{step:08d}_{name}.png"))
+            except Exception as e:   # PNG sink is best-effort
+                print(f"WARNING: image panel PNG write failed: {e}")
+        return len(panels)
+
     def write_dict(self, results: Dict[str, float],
                    step: Optional[int] = None):
         step = step if step is not None else self.total_steps

@@ -1,0 +1,121 @@
+"""Training image panels (reference ``train.py:170-334`` equivalents)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils.image_panels import (draw_circle, flow_panel,
+                                         keypoint_overlay, render_panels,
+                                         sparse_panel)
+from raft_tpu.utils.logger import TrainLogger
+
+H, W = 48, 64
+
+
+def _img(seed=0):
+    return np.random.default_rng(seed).uniform(
+        0, 255, (H, W, 3)).astype(np.float32)
+
+
+def _flow(seed=1):
+    return np.random.default_rng(seed).normal(
+        0, 3, (H, W, 2)).astype(np.float32)
+
+
+class TestPrimitives:
+    def test_draw_circle_marks_ring_only(self):
+        img = np.zeros((H, W, 3), np.uint8)
+        draw_circle(img, (32, 24), radius=6, color=(255, 0, 0),
+                    thickness=2)
+        assert img[24, 32 + 6, 0] == 255          # on the ring
+        assert img[24, 32, 0] == 0                # center untouched
+        assert img[0, 0, 0] == 0                  # far field untouched
+
+    def test_draw_circle_clips_at_borders(self):
+        img = np.zeros((H, W, 3), np.uint8)
+        draw_circle(img, (0, 0), radius=5, thickness=4)     # corner
+        draw_circle(img, (W + 50, H + 50), radius=5)        # off-image
+        assert img.shape == (H, W, 3)
+
+    def test_keypoint_overlay_confidence_scales_red(self):
+        img = np.zeros((H, W, 3), np.float32)
+        out = keypoint_overlay(img, np.asarray([[10, 10], [40, 30]]),
+                               np.asarray([1.0, 0.5]), radius=3,
+                               thickness=2)
+        assert out.dtype == np.uint8
+        assert out[10, 13, 0] == 255
+        assert out[30, 43, 0] == round(255 * 0.5)
+
+
+class TestPanels:
+    def test_flow_panel_layout(self):
+        panel = flow_panel(_img(), _img(1), _flow(), [_flow(2), _flow(3)])
+        # img1 | img2 | GT | 2 preds = 5 tiles wide
+        assert panel.shape == (H, 5 * W, 3)
+        assert panel.dtype == np.uint8
+
+    def test_sparse_panel_layout(self):
+        iters, K, mh, mw = 2, 5, H // 8, W // 8
+        rng = np.random.default_rng(0)
+        sparse = []
+        for _ in range(iters):
+            ref = rng.uniform(0.1, 0.9, (K, 2)).astype(np.float32)
+            kf = rng.normal(size=(K, 2)).astype(np.float32)
+            masks = rng.uniform(size=(K, mh, mw)).astype(np.float32)
+            scores = rng.uniform(size=(K,)).astype(np.float32)
+            sparse.append((ref, kf, masks, scores))
+        panel = sparse_panel(_img(), _img(1), _flow(),
+                             [_flow(2), _flow(3)], sparse)
+        # two rows; each row 3 base tiles + 2 per iteration
+        assert panel.shape == (2 * H, (3 + 2 * iters) * W, 3)
+        assert panel.dtype == np.uint8
+
+    def test_render_panels_samples_batch(self):
+        B, iters = 4, 2
+        img1 = np.stack([_img(i) for i in range(B)])
+        img2 = np.stack([_img(i + 10) for i in range(B)])
+        gt = np.stack([_flow(i) for i in range(B)])
+        preds = np.stack([gt + i for i in range(iters)])   # (iters,B,H,W,2)
+        panels = render_panels(img1, img2, gt, preds, max_samples=3)
+        assert len(panels) == 3
+        assert all(p.shape == (H, 5 * W, 3) for p in panels)
+
+
+class TestLoggerImages:
+    def test_write_images_pngs(self, tmp_path):
+        logger = TrainLogger(str(tmp_path / "run"), tensorboard=False)
+        B, iters = 2, 2
+        img = np.stack([_img(i) for i in range(B)])
+        gt = np.stack([_flow(i) for i in range(B)])
+        preds = np.stack([gt] * iters)
+        n = logger.write_images(img, img, gt, preds, step=500)
+        files = os.listdir(tmp_path / "run" / "images")
+        assert n == B and len(files) == B
+        assert all(f.startswith("00000500_T_Image_") for f in sorted(files))
+        logger.close()
+
+
+def test_train_loop_writes_panels(tmp_path):
+    """A real (tiny) train run produces an image panel at val_freq —
+    the reference's write_images cadence (train.py:395-396)."""
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.train import train
+    from test_checkpoint_and_train import SyntheticLoader, H as TH, W as TW
+
+    tcfg = TrainConfig(name="imglog", num_steps=2, batch_size=8,
+                       image_size=(TH, TW), iters=2, val_freq=2,
+                       sum_freq=2)
+    mcfg = RAFTConfig(small=True, iters=2)
+    logger = TrainLogger(str(tmp_path / "logs" / "imglog"), sum_freq=2,
+                         tensorboard=False)
+    train(tcfg, mcfg, ckpt_dir=str(tmp_path / "ckpts"),
+          log_dir=str(tmp_path / "logs"), dataloader=SyntheticLoader(),
+          logger=logger)
+    img_dir = tmp_path / "logs" / "imglog" / "images"
+    files = list(img_dir.glob("*.png"))
+    assert files, "no panels written by the train loop"
+    from PIL import Image
+    panel = np.asarray(Image.open(files[0]))
+    # 8-sample batch → panel tiles: img1|img2|GT|2 iters = 5 tiles
+    assert panel.shape == (TH, 5 * TW, 3)
