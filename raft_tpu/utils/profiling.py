@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import glob
+import importlib
 import os
 import os.path as osp
 import time
@@ -55,12 +56,21 @@ def trace(logdir: Optional[str] = None):
 
 
 def _load_xspace(logdir: str):
-    try:
-        from tensorflow.tsl.profiler.protobuf.xplane_pb2 import XSpace
-    except ImportError as e:  # pragma: no cover - depends on image
+    # The xplane proto moved across TF releases; try the known homes.
+    XSpace, last_err = None, None
+    for mod in ("tensorflow.core.profiler.protobuf.xplane_pb2",
+                "tensorflow.tsl.profiler.protobuf.xplane_pb2"):
+        try:
+            XSpace = importlib.import_module(mod).XSpace
+            break
+        except ImportError as e:
+            last_err = e
+    if XSpace is None:  # pragma: no cover - depends on image
         raise ImportError(
-            "parsing traces requires tensorflow's xplane_pb2 proto; view "
-            f"the trace in TensorBoard instead (logdir={logdir})") from e
+            "parsing traces requires tensorflow's xplane_pb2 proto (tried "
+            "tensorflow.core.profiler and tensorflow.tsl.profiler "
+            f"locations); view the trace in TensorBoard instead "
+            f"(logdir={logdir})") from last_err
 
     paths = sorted(glob.glob(
         osp.join(logdir, "plugins", "profile", "*", "*.xplane.pb")))
@@ -76,39 +86,58 @@ def op_breakdown(logdir: str) -> List[Tuple[str, float, int]]:
     """Aggregate device-op self times from the latest trace in ``logdir``.
 
     Returns ``[(op_name, total_ms, count), ...]`` sorted by time. On TPU
-    the ops live in the device plane's "XLA Ops" timeline; CPU traces put
-    them on an executor thread line named ``tf_XLA...``. Exactly those two
-    line kinds are considered, and the busiest one wins.
+    the ops live in each device plane's "XLA Ops" timeline; CPU traces put
+    them on executor thread lines named ``tf_XLA...``. Exactly those two
+    line kinds are considered and summed across ALL matching lines, so a
+    multi-core/multi-device trace reports whole-trace op totals rather
+    than one core's (the per-line totals are printed by
+    :func:`print_breakdown` when more than one line contributed).
     """
+    return _collect_ops(logdir)[0]
+
+
+def _collect_ops(logdir: str):
+    """Shared collector: ``(rows, [(plane/line, total_ms), ...])``."""
     xs = _load_xspace(logdir)
-    best: Dict[str, Tuple[float, int]] = {}
-    best_total = 0.0
+    # Candidate op-level timelines: "XLA Ops" (TPU device planes) and CPU
+    # executor threads ("tf_XLA..."). The TPU plane also has an
+    # "XLA Modules" line whose whole-executable spans would double-count
+    # every op — excluded. When BOTH device and host lines exist (a TPU
+    # trace also records host executor activity for the same program),
+    # only the device lines are summed: mixing them would double-count.
+    device_lines, host_lines = [], []
     for plane in xs.planes:
         for line in plane.lines:
-            # Exactly the op-level timelines: "XLA Ops" (TPU device plane)
-            # or the CPU executor thread ("tf_XLA..."). The TPU plane also
-            # has an "XLA Modules" line whose whole-executable spans would
-            # otherwise win the busiest-line vote.
-            if line.name != "XLA Ops" and not line.name.startswith("tf_XLA"):
-                continue
-            tot: collections.Counter = collections.Counter()
-            cnt: collections.Counter = collections.Counter()
-            for ev in line.events:
-                name = plane.event_metadata[ev.metadata_id].name
-                tot[name] += ev.duration_ps
-                cnt[name] += 1
-            if sum(tot.values()) > best_total:
-                best_total = sum(tot.values())
-                best = {k: (ps / 1e9, cnt[k]) for k, ps in tot.items()}
-    return sorted(((k, ms, c) for k, (ms, c) in best.items()),
+            if line.name == "XLA Ops":
+                device_lines.append((plane, line))
+            elif line.name.startswith("tf_XLA"):
+                host_lines.append((plane, line))
+    tot: collections.Counter = collections.Counter()
+    cnt: collections.Counter = collections.Counter()
+    lines_used = []
+    for plane, line in device_lines or host_lines:
+        line_ps = 0
+        for ev in line.events:
+            name = plane.event_metadata[ev.metadata_id].name
+            tot[name] += ev.duration_ps
+            cnt[name] += 1
+            line_ps += ev.duration_ps
+        if line_ps:
+            lines_used.append((f"{plane.name}/{line.name}", line_ps / 1e9))
+    rows = sorted(((k, ps / 1e9, cnt[k]) for k, ps in tot.items()),
                   key=lambda x: -x[1])
+    return rows, lines_used
 
 
 def print_breakdown(logdir: str, steps: int = 1, top: int = 20) -> None:
     """Print the top-``top`` ops, times divided by ``steps``."""
-    rows = op_breakdown(logdir)
+    rows, lines_used = _collect_ops(logdir)
     total = sum(ms for _, ms, _ in rows)
     print(f"total device op time: {total / max(steps, 1):.2f} ms/step "
-          f"({len(rows)} distinct ops)")
+          f"({len(rows)} distinct ops, {len(lines_used)} op timelines)")
+    if len(lines_used) > 1:
+        for name, ms in lines_used:
+            print(f"  contributing line: {name} "
+                  f"({ms / max(steps, 1):.2f} ms/step)")
     for name, ms, c in rows[:top]:
         print(f"{ms / max(steps, 1):9.3f} ms/step  x{c:5d}  {name[:90]}")
