@@ -119,6 +119,11 @@ class OursConfig:
     corr_levels: int = 2            # fork default (reference core/corr.py:13)
     corr_radius: int = 4
     mixed_precision: bool = False
+    # >0 enables the ours_07 lineage: that many deformable-encoder layers
+    # refine the motion and context token sets (separate stacks) before
+    # the decoder loop (reference core/ours_07.py:97-109, :541-543).
+    # 0 = the live ours.py, which carries the stacks commented out.
+    encoder_iterations: int = 0
 
     @property
     def up_dim(self) -> int:

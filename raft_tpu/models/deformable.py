@@ -218,7 +218,13 @@ class MLP(nn.Module):
         for i, dim in enumerate(dims):
             x = nn.Dense(dim, dtype=self.dtype, name=f"layers_{i}")(x)
             if i < self.num_layers - 1 or self.last_activate:
-                x = nn.GroupNorm(num_groups=min(32, dim), epsilon=1e-5,
+                # gcd keeps 32 groups for every reference width while
+                # degrading gracefully for widths 32 doesn't divide.
+                # NOTE: for dim < 32 not dividing 32 (e.g. 24) this
+                # changed the grouping from per-channel (min) to gcd —
+                # param shapes are identical, numerics differ slightly;
+                # no published sparse-family weights exist to break.
+                x = nn.GroupNorm(num_groups=math.gcd(32, dim), epsilon=1e-5,
                                  dtype=self.dtype, name=f"norms_{i}")(x)
                 x = nn.gelu(x)
         return x

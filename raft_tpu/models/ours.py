@@ -43,7 +43,8 @@ import jax.numpy as jnp
 from raft_tpu.config import OursConfig
 from raft_tpu.models.corr import CorrBlock
 from raft_tpu.models.deformable import (MLP,
-                                        DeformableTransformerDecoderLayer)
+                                        DeformableTransformerDecoderLayer,
+                                        DeformableTransformerEncoderLayer)
 from raft_tpu.models.sparse_extractor import CNNDecoder, CNNEncoder
 from raft_tpu.ops.sampling import inverse_sigmoid
 
@@ -144,7 +145,6 @@ class SparseRAFT(nn.Module):
         motion_src = jnp.concatenate(motion_parts_1 + motion_parts_2, axis=1)
         context_src = jnp.concatenate(context_parts_1 + context_parts_2,
                                       axis=1)
-        src = jnp.concatenate([motion_src, context_src], axis=-1)
 
         # --- position embeddings (separable interpolation of the learned
         #     1000-entry tables; see module docstring)
@@ -168,6 +168,36 @@ class SparseRAFT(nn.Module):
         src_pos = jnp.concatenate([pos_cat + img_tab[0],
                                    pos_cat + img_tab[1]], axis=1)
         src_pos = src_pos.astype(dtype)
+
+        # --- ours_07 lineage: deformable-encoder refinement of the token
+        #     sets before fusion (reference core/ours_07.py:97-109 builds
+        #     `encoder` + `context_encoder` stacks; :541-543 applies them
+        #     to motion_src / context_src). ours_07 projects tokens at
+        #     full d_model; here each half keeps the live model's Dm//2
+        #     width with the position embedding projected to match.
+        if cfg.encoder_iterations > 0:
+            from raft_tpu.models.deformable import \
+                DeformableTransformerEncoder
+            enc_ref = DeformableTransformerEncoder.get_reference_points(
+                spatial_shapes)
+            half_pos = nn.Dense(half, dtype=dtype,
+                                name="encoder_pos_proj")(src_pos)
+            for e_i in range(cfg.encoder_iterations):
+                motion_src = DeformableTransformerEncoderLayer(
+                    d_model=half, d_ffn=half * 4, dropout=cfg.dropout,
+                    activation="gelu", n_levels=len(spatial_shapes),
+                    n_heads=cfg.n_heads, n_points=cfg.n_points,
+                    dtype=dtype, name=f"encoder_{e_i}")(
+                    motion_src, half_pos, enc_ref, spatial_shapes,
+                    deterministic)
+                context_src = DeformableTransformerEncoderLayer(
+                    d_model=half, d_ffn=half * 4, dropout=cfg.dropout,
+                    activation="gelu", n_levels=len(spatial_shapes),
+                    n_heads=cfg.n_heads, n_points=cfg.n_points,
+                    dtype=dtype, name=f"context_encoder_{e_i}")(
+                    context_src, half_pos, enc_ref, spatial_shapes,
+                    deterministic)
+        src = jnp.concatenate([motion_src, context_src], axis=-1)
 
         # context-map position embedding (stride-4 U1 grid, img slot 2)
         uh, uw = U1.shape[1:3]

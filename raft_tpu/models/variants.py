@@ -1,0 +1,504 @@
+"""Experiment-snapshot model variants of the sparse-keypoint family.
+
+The reference carries seven "ours" snapshots; the live one is rebuilt in
+:mod:`raft_tpu.models.ours`.  Three dead-but-distinct architectures are
+rebuilt here in working form (the reference copies crash on import or on
+an encoder API drift — see SURVEY.md §0):
+
+* :class:`KeypointTransformerRAFT` — the earliest snapshot
+  (``core/ours_02.py:131-181``): vanilla post-LN transformer decoder
+  layers over stride-8 features, 100 learned keypoint queries, dense
+  flow recovered as the outer product
+  ``tanh(flow_embed) · sigmoid(corr_embed · context_embedᵀ)``.
+
+* :class:`DualQueryRAFT` — the dual decoder-stack snapshot
+  (``core/ours_04.py:53-94``, ``:230-313``): every stride-8 token is a
+  query; two ``self_deformable`` decoder stacks refine a *context* and a
+  *correlation* token set in parallel, flow is read from the correlation
+  tokens and propagated through two softmax attention hops (context →
+  tokens, stride-4 map → context).  Returns ``(flow_predictions,
+  corr_predictions)`` — the two-list contract of the ``train_02.py``
+  trainer (``train_02.py:54-81``), supported by
+  :func:`raft_tpu.losses.sequence_corr_loss`.
+
+* :class:`TwoStageKeypointRAFT` — the second-decoder-stack snapshot
+  (``core/ours_06.py:52-65``, ``:193-285``): a deformable encoder stack
+  refines both images' stride-8 tokens, then three decoder stacks
+  (keypoint / correlation / context) drive iterative inverse-sigmoid
+  reference-point refinement; dense flow via
+  ``sigmoid(U1 · contextᵀ) · key_flow``.
+
+All three consume :class:`StageEncoder` — the ``core/extractor_02.py``
+encoder (stem + three GELU residual stages to stride 8, bilinear-upsample
+head to a stride-4 context map) whose single-tensor ``(D1, D2, U1)``
+interface is the one these snapshots were written against (the current
+``core/extractor.py`` returns pyramids, which is what killed them).
+
+Deliberate deviations from the snapshots, for working code:
+* learned row/col position tables are created at call time for the
+  actual feature size (the snapshots fix them to ``args.image_size`` and
+  bilinearly resize on mismatch — same capability, no config coupling);
+* the snapshots' conv1d MLPs with BatchNorm1d (ours_06) use the shared
+  GroupNorm :class:`raft_tpu.models.deformable.MLP` instead (batch-stat
+  plumbing for a dead snapshot's MLP norm buys nothing);
+* ours_04 wraps the SAME MLP modules in per-iteration ModuleLists
+  (shared weights, ``core/ours_04.py:91-94``) — reproduced by reusing
+  one module across iterations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.models.deformable import (MLP,
+                                        DeformableTransformerDecoderLayer,
+                                        DeformableTransformerEncoderLayer)
+from raft_tpu.models.extractor import BasicEncoder, Norm, ResidualBlock
+from raft_tpu.ops.sampling import inverse_sigmoid
+
+
+def _tokens(x):
+    """(B, H, W, C) → (B, HW, C)."""
+    B, H, W, C = x.shape
+    return x.reshape(B, H * W, C)
+
+
+def _learned_pos(self_mod, h: int, w: int, d_model: int, name: str):
+    """Learned separable row/col position embedding
+    (reference ``ours_02.py:46-47`` / ``ours_04.py:66-67``), created at
+    the actual feature size; returns (1, h*w, d_model)."""
+    col = self_mod.param(f"{name}_col", nn.initializers.uniform(1.0),
+                         (h, d_model // 2))
+    row = self_mod.param(f"{name}_row", nn.initializers.uniform(1.0),
+                         (w, d_model // 2))
+    grid = jnp.concatenate([
+        jnp.broadcast_to(col[:, None], (h, w, d_model // 2)),
+        jnp.broadcast_to(row[None, :], (h, w, d_model // 2))], axis=-1)
+    return grid.reshape(1, h * w, d_model)
+
+
+def _center_reference_points(h: int, w: int, n_levels: int = 1):
+    """Per-token normalized center grid, broadcast over levels —
+    the encoder/self-deformable reference points
+    (``ours_04.py:182-194``); (1, h*w, n_levels, 2).  Thin shim over the
+    shared convention in :func:`deformable.normalized_center_grid`."""
+    from raft_tpu.models.deformable import normalized_center_grid
+    ref = normalized_center_grid([(h, w)])                 # (1, h*w, 2)
+    return jnp.broadcast_to(ref[:, :, None], (1, h * w, n_levels, 2))
+
+
+def _scale_resize(flow_norm, I_H: int, I_W: int):
+    """Normalized (B, h, w, 2) flow → pixel flow at full resolution
+    (the snapshots' ``flow * (W, H)`` + bilinear resize)."""
+    B, h, w, _ = flow_norm.shape
+    flow = flow_norm * jnp.asarray([I_W, I_H], jnp.float32)
+    if (h, w) != (I_H, I_W):
+        flow = jax.image.resize(flow, (B, I_H, I_W, 2), method="linear")
+    return flow
+
+
+class StageEncoder(nn.Module):
+    """The ``core/extractor_02.py`` encoder: 7x7/2 GELU stem, three
+    double-ResidualBlock stages (``c``@s1, ``1.5c``@s2, ``2c``@s2 →
+    stride 8), and a bilinear-upsample 3x3 head to a stride-4 context map
+    (``extractor_02.py:119-221``; its ``down_layer4`` is built but never
+    reached by ``forward`` and is not reproduced).
+
+    Twin-image API: called on ``concat([img1, img2])`` along batch,
+    returns ``(D1, D2, U1)`` — per-image stride-8 features plus image-1's
+    stride-4 context (channels ``2c`` and ``1.5c``)."""
+
+    base_channel: int = 64
+    norm_fn: str = "batch"
+    dtype: Any = jnp.float32
+
+    @property
+    def down_dim(self) -> int:
+        return self.base_channel * 2
+
+    @property
+    def up_dim(self) -> int:
+        return round(self.base_channel * 1.5)
+
+    @nn.compact
+    def __call__(self, both, train: bool = False):
+        c, d = self.base_channel, self.dtype
+        x = nn.Conv(c, (7, 7), strides=2, padding=3, dtype=d,
+                    name="conv1")(both)
+        x = Norm(self.norm_fn, dtype=d, name="norm1")(x, train=train)
+        x = nn.gelu(x)
+
+        def stage(x, planes, stride, idx):
+            x = ResidualBlock(planes, self.norm_fn, stride, dtype=d,
+                              act="gelu",
+                              name=f"down_layer{idx}_0")(x, train=train)
+            return ResidualBlock(planes, self.norm_fn, 1, dtype=d,
+                                 act="gelu",
+                                 name=f"down_layer{idx}_1")(x, train=train)
+
+        x = stage(x, c, 1, 1)
+        x = stage(x, round(c * 1.5), 2, 2)
+        x = stage(x, c * 2, 2, 3)                      # stride 8
+
+        D1, D2 = jnp.split(x, 2, axis=0)
+        B, h, w, _ = D1.shape
+        up = jax.image.resize(D1, (B, h * 2, w * 2, D1.shape[-1]),
+                              method="linear")
+        up = nn.Conv(self.up_dim, (3, 3), padding=1, dtype=d,
+                     name="up_layer1_conv")(up)
+        up = Norm(self.norm_fn, dtype=d, name="up_layer1_norm")(
+            up, train=train)
+        U1 = nn.gelu(up)
+        return D1, D2, U1
+
+
+class _VanillaDecoderLayer(nn.Module):
+    """Post-LN transformer decoder layer — ``nn.TransformerDecoderLayer``
+    semantics (self-attn → cross-attn → ReLU FFN, residual + LayerNorm
+    after each), which ``ours_02`` stacks directly."""
+
+    d_model: int
+    n_heads: int = 8
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tgt, memory, deterministic: bool = True):
+        a = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads, qkv_features=self.d_model,
+            dropout_rate=self.dropout, deterministic=deterministic,
+            dtype=self.dtype, name="self_attn")(tgt, tgt, tgt)
+        tgt = nn.LayerNorm(dtype=self.dtype, name="norm1")(
+            tgt + nn.Dropout(self.dropout)(a, deterministic=deterministic))
+        a = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads, qkv_features=self.d_model,
+            dropout_rate=self.dropout, deterministic=deterministic,
+            dtype=self.dtype, name="cross_attn")(tgt, memory, memory)
+        tgt = nn.LayerNorm(dtype=self.dtype, name="norm2")(
+            tgt + nn.Dropout(self.dropout)(a, deterministic=deterministic))
+        y = nn.Dense(self.d_model * 4, dtype=self.dtype, name="linear1")(tgt)
+        y = nn.Dropout(self.dropout)(nn.relu(y),
+                                     deterministic=deterministic)
+        y = nn.Dense(self.d_model, dtype=self.dtype, name="linear2")(y)
+        return nn.LayerNorm(dtype=self.dtype, name="norm3")(
+            tgt + nn.Dropout(self.dropout)(y, deterministic=deterministic))
+
+
+class _ReluMLP(nn.Module):
+    """ours_02's plain MLP: pointwise layers with ReLU between
+    (``ours_02.py:184-200``) — no norms, linear last layer."""
+
+    hidden_dim: int
+    output_dim: int
+    num_layers: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dims = [self.hidden_dim] * (self.num_layers - 1) + [self.output_dim]
+        for i, dim in enumerate(dims):
+            x = nn.Dense(dim, dtype=self.dtype, name=f"layers_{i}")(x)
+            if i < self.num_layers - 1:
+                x = nn.relu(x)
+        return x
+
+
+class KeypointTransformerRAFT(nn.Module):
+    """The vanilla-transformer keypoint snapshot (``core/ours_02.py``).
+
+    Stride-8 BasicEncoder features for both images; one decoder layer
+    builds a per-pixel *context* embedding (features attending to
+    themselves), one builds the 100 keypoint queries (attending to
+    image 1), then six decoder layers attend the queries to image 2 and
+    read flow as ``tanh(reg)ᵀ · sigmoid(corr · contextᵀ)``
+    (``ours_02.py:160-177``)."""
+
+    d_model: int = 64
+    num_queries: int = 100
+    iterations: int = 6
+    dropout: float = 0.1
+    mixed_precision: bool = False
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: Optional[int] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False):
+        del iters   # the snapshot's flag; self.iterations rules
+        dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
+        deterministic = not train
+        B, I_H, I_W, _ = image1.shape
+        Dm = self.d_model
+
+        both = 2.0 * (jnp.concatenate([image1, image2]).astype(dtype)
+                      / 255.0) - 1.0
+        feats = BasicEncoder(128, "batch", 0.0, dtype=dtype, name="fnet")(
+            both, train=train and not freeze_bn)
+        f1, f2 = jnp.split(feats, 2, axis=0)
+        B_, h, w, _ = f1.shape
+
+        pos = _learned_pos(self, h, w, Dm, "pos_embed").astype(dtype)
+        proj = nn.Sequential([
+            nn.Dense(Dm, dtype=dtype),
+            nn.GroupNorm(num_groups=Dm // 8, epsilon=1e-5, dtype=dtype),
+            nn.relu], name="input_proj")
+        t1 = proj(_tokens(f1)) + pos
+        t2 = proj(_tokens(f2)) + pos
+
+        context_embed_tokens = _VanillaDecoderLayer(
+            Dm, dropout=self.dropout, dtype=dtype,
+            name="context_decoder")(t1, t1, deterministic)
+
+        queries = jnp.broadcast_to(
+            self.param("query_embed", nn.initializers.xavier_uniform(),
+                       (self.num_queries, Dm)).astype(dtype)[None],
+            (B, self.num_queries, Dm))
+        tgt = _VanillaDecoderLayer(
+            Dm, dropout=self.dropout, dtype=dtype,
+            name="query_decoder")(queries, t1, deterministic)
+
+        flow_embed = _ReluMLP(Dm, 2, 3, dtype=dtype, name="flow_embed")
+        corr_embed = _ReluMLP(Dm, Dm, 3, dtype=dtype, name="corr_embed")
+
+        flow_predictions = []
+        for i in range(self.iterations):
+            corr_hs = _VanillaDecoderLayer(
+                Dm, dropout=self.dropout, dtype=dtype,
+                name=f"corr_decoder_{i}")(tgt, t2, deterministic)
+            corr = nn.sigmoid(jnp.einsum(
+                "bnc,bpc->bnp", corr_embed(corr_hs).astype(jnp.float32),
+                context_embed_tokens.astype(jnp.float32)))   # (B, N, hw)
+            reg = jnp.tanh(flow_embed(corr_hs).astype(jnp.float32))
+            flow = jnp.einsum("bnp,bnk->bpk", corr, reg)     # (B, hw, 2)
+            flow_predictions.append(_scale_resize(
+                flow.reshape(B, h, w, 2), I_H, I_W))
+
+        if test_mode:
+            return flow_predictions[-1], flow_predictions[-1]
+        return flow_predictions
+
+
+class DualQueryRAFT(nn.Module):
+    """The dual decoder-stack snapshot (``core/ours_04.py``): every
+    stride-8 token is simultaneously a *context* and a *correlation*
+    query, refined by two independent ``self_deformable`` decoder stacks
+    (context over image 1, correlation over image 2); flow is read
+    per-token from the correlation stack and routed through two softmax
+    attention hops to the stride-4 grid (``ours_04.py:246-305``).
+
+    Returns ``(flow_predictions, corr_predictions)`` — the
+    ``train_02.py`` two-list loss contract."""
+
+    d_model: int = 64
+    iterations: int = 6
+    dropout: float = 0.1
+    n_heads: int = 8
+    n_points: int = 4
+    mixed_precision: bool = False
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: Optional[int] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False):
+        del iters
+        dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
+        deterministic = not train
+        B, I_H, I_W, _ = image1.shape
+        Dm = self.d_model
+
+        both = 2.0 * (jnp.concatenate([image1, image2]).astype(dtype)
+                      / 255.0) - 1.0
+        enc = StageEncoder(Dm, "batch", dtype=dtype, name="extractor")
+        D1, D2, U1 = enc(both, train=train and not freeze_bn)
+        B_, h, w, _ = D1.shape
+        uh, uw = U1.shape[1:3]
+
+        proj = nn.Sequential([
+            nn.Dense(Dm, dtype=dtype),
+            nn.GroupNorm(num_groups=Dm // 8, epsilon=1e-5, dtype=dtype),
+        ], name="extractor_projection")
+        d1 = proj(_tokens(D1))
+        d2 = proj(_tokens(D2))
+        u1 = _tokens(U1)
+
+        pos = _learned_pos(self, h, w, Dm, "pos_embed").astype(dtype)
+        ref = _center_reference_points(h, w)
+        shapes = [(h, w)]
+
+        context = nn.Dense(Dm, dtype=dtype, name="context_query_embed")(d1)
+        correlation = nn.Dense(Dm, dtype=dtype,
+                               name="correlation_query_embed")(d1)
+
+        # per-iteration ModuleLists share ONE module in the snapshot
+        # (ours_04.py:91-94) — one instance reused here
+        ctx_corr_embed = MLP(Dm, Dm, 3, dtype=dtype,
+                             name="context_correlation_embed")
+        ctx_extr_embed = MLP(Dm, enc.up_dim, 3, dtype=dtype,
+                             name="context_extractor_embed")
+        corr_flow_embed = MLP(Dm, 2, 3, dtype=dtype,
+                              name="correlation_flow_embed")
+
+        flow_predictions, corr_predictions = [], []
+        for i in range(self.iterations):
+            context = DeformableTransformerDecoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="relu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, self_deformable=True, dtype=dtype,
+                name=f"context_decoder_{i}")(
+                context, pos, ref, d1, pos, shapes, deterministic)
+            correlation = DeformableTransformerDecoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="relu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, self_deformable=True, dtype=dtype,
+                name=f"correlation_decoder_{i}")(
+                correlation, pos, ref, d2, pos, shapes, deterministic)
+
+            ctx_corr = ctx_corr_embed(context).astype(jnp.float32)
+            ctx_extr = ctx_extr_embed(context).astype(jnp.float32)
+            corr_flow = corr_flow_embed(correlation).astype(jnp.float32)
+
+            # context tokens gather flow from the correlation tokens...
+            attn1 = jax.nn.softmax(jnp.einsum(
+                "bnc,bpc->bnp", ctx_corr, d1.astype(jnp.float32)), axis=-1)
+            context_flow = jnp.einsum(
+                "bnp,bpk->bnk", attn1, jax.lax.stop_gradient(corr_flow))
+            # ...and the stride-4 grid gathers from the context tokens
+            attn2 = jax.nn.softmax(jnp.einsum(
+                "bqc,bnc->bqn", u1.astype(jnp.float32), ctx_extr), axis=-1)
+            extractor_flow = jnp.einsum("bqn,bnk->bqk", attn2, context_flow)
+
+            flow = jnp.tanh(extractor_flow).reshape(B, uh, uw, 2)
+            flow_predictions.append(_scale_resize(flow, I_H, I_W))
+            cflow = jnp.tanh(corr_flow).reshape(B, h, w, 2)
+            corr_predictions.append(_scale_resize(cflow, I_H, I_W))
+
+        if test_mode:
+            return flow_predictions[-1], flow_predictions[-1]
+        return flow_predictions, corr_predictions
+
+
+class TwoStageKeypointRAFT(nn.Module):
+    """The second-decoder-stack snapshot (``core/ours_06.py``): a shared
+    deformable encoder stack refines both images' stride-8 tokens, then
+    per outer iteration a *keypoint* decoder attends to image 1, updates
+    the reference points in inverse-sigmoid space, and *correlation* /
+    *context* decoders read flow and context embeddings at the refined
+    points; dense flow is ``sigmoid(U1 · contextᵀ) · key_flow``
+    (``ours_06.py:225-281``)."""
+
+    d_model: int = 128        # = StageEncoder.down_dim for base 64
+    base_channel: int = 64
+    num_queries: int = 100
+    iterations: int = 6
+    dropout: float = 0.1
+    n_heads: int = 8
+    n_points: int = 4
+    mixed_precision: bool = False
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: Optional[int] = None,
+                 test_mode: bool = False, train: bool = False,
+                 freeze_bn: bool = False):
+        del iters
+        dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
+        deterministic = not train
+        B, I_H, I_W, _ = image1.shape
+        Dm = self.d_model
+
+        both = 2.0 * (jnp.concatenate([image1, image2]).astype(dtype)
+                      / 255.0) - 1.0
+        enc = StageEncoder(self.base_channel, "batch", dtype=dtype,
+                           name="extractor")
+        assert enc.down_dim == Dm, (
+            f"d_model ({Dm}) must equal the encoder's stride-8 width "
+            f"({enc.down_dim}) — the snapshot ties them "
+            "(ours_06.py:40-41)")
+        D1, D2, U1 = enc(both, train=train and not freeze_bn)
+        B_, h, w, _ = D1.shape
+        uh, uw = U1.shape[1:3]
+
+        d1, d2 = _tokens(D1), _tokens(D2)
+        u1 = _tokens(U1)
+        src_pos = _learned_pos(self, h, w, Dm, "src_pos").astype(dtype)
+        src_ref = _center_reference_points(h, w)
+        shapes = [(h, w)]
+
+        # shared encoder stack over both images (ours_06.py:225-227)
+        for i in range(self.iterations):
+            layer = DeformableTransformerEncoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="gelu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, dtype=dtype, name=f"encoder_{i}")
+            d1 = layer(d1, src_pos, src_ref, shapes, deterministic)
+            d2 = layer(d2, src_pos, src_ref, shapes, deterministic)
+
+        N = self.num_queries
+        query = jnp.broadcast_to(
+            self.param("query_embed", nn.initializers.xavier_uniform(),
+                       (N, Dm)).astype(dtype)[None], (B, N, Dm))
+        query_pos = jnp.broadcast_to(
+            self.param("query_pos_embed", nn.initializers.uniform(1.0),
+                       (N, Dm)).astype(dtype)[None], (B, N, Dm))
+
+        # 10x10 center grid (ours_06.py:219: get_reference_points((10,10)))
+        root = round(N ** 0.5)
+        assert root * root == N, f"num_queries must be square (got {N})"
+        reference_points = jnp.broadcast_to(
+            _center_reference_points(root, root)[:, :, 0], (B, N, 2))
+
+        flow_predictions, sparse_predictions = [], []
+        keypoint = query
+        for i in range(self.iterations):
+            if i > 0:
+                query = keypoint
+            keypoint = DeformableTransformerDecoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="gelu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, dtype=dtype,
+                name=f"keypoint_decoder_{i}")(
+                query, query_pos, reference_points[:, :, None],
+                d1, src_pos, shapes, deterministic)
+
+            ref_delta = MLP(Dm, 2, 3, dtype=dtype,
+                            name=f"reference_embed_{i}")(keypoint)
+            reference_points = nn.sigmoid(
+                inverse_sigmoid(jax.lax.stop_gradient(reference_points))
+                + ref_delta.astype(jnp.float32))
+
+            correlation = DeformableTransformerDecoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="gelu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, dtype=dtype,
+                name=f"correlation_decoder_{i}")(
+                keypoint, query_pos, reference_points[:, :, None],
+                d2, src_pos, shapes, deterministic)
+            context = DeformableTransformerDecoderLayer(
+                d_model=Dm, d_ffn=Dm * 4, dropout=self.dropout,
+                activation="gelu", n_levels=1, n_heads=self.n_heads,
+                n_points=self.n_points, dtype=dtype,
+                name=f"context_decoder_{i}")(
+                keypoint, query_pos, reference_points[:, :, None],
+                d1, src_pos, shapes, deterministic)
+
+            fe = MLP(Dm, 2, 3, dtype=dtype,
+                     name=f"flow_embed_{i}")(correlation)
+            ref_sg = jax.lax.stop_gradient(reference_points)
+            flow = ref_sg - nn.sigmoid(
+                inverse_sigmoid(ref_sg) + fe.astype(jnp.float32))
+            sparse_predictions.append((reference_points, flow))
+
+            ctx = MLP(enc.up_dim, enc.up_dim, 3, last_activate=True,
+                      dtype=dtype, name=f"context_embed_{i}")(context)
+            attn = nn.sigmoid(jnp.einsum(
+                "bqc,bnc->bqn", u1.astype(jnp.float32),
+                ctx.astype(jnp.float32)))                    # (B, HW, N)
+            context_flow = jnp.einsum("bqn,bnk->bqk", attn, flow)
+            flow_predictions.append(_scale_resize(
+                context_flow.reshape(B, uh, uw, 2), I_H, I_W))
+
+        if test_mode:
+            return flow_predictions[-1], flow_predictions[-1]
+        return flow_predictions, sparse_predictions

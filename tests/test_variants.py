@@ -1,0 +1,144 @@
+"""Experiment-snapshot model variants (reference ``core/ours_02/04/06.py``,
+``core/ours_07.py``, ``core/extractor_02.py`` — rebuilt in working form in
+:mod:`raft_tpu.models.variants` and via ``OursConfig.encoder_iterations``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.config import OursConfig
+from raft_tpu.losses import sequence_corr_loss
+from raft_tpu.models import (DualQueryRAFT, KeypointTransformerRAFT,
+                             SparseRAFT, StageEncoder, TwoStageKeypointRAFT)
+
+B, H, W = 1, 64, 96
+
+
+@pytest.fixture(scope="module")
+def images():
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (B, H, W, 3)) * 255.0
+    img2 = jnp.roll(img1, 2, axis=2)
+    return img1, img2
+
+
+def _init_and_apply(model, img1, img2, **apply_kw):
+    rng = jax.random.PRNGKey(1)
+    variables = model.init({"params": rng, "dropout": rng}, img1, img2)
+    return variables, model.apply(variables, img1, img2, **apply_kw)
+
+
+class TestStageEncoder:
+    def test_shapes_and_dims(self, images):
+        img1, img2 = images
+        enc = StageEncoder(base_channel=32)
+        assert enc.down_dim == 64 and enc.up_dim == 48
+        rng = jax.random.PRNGKey(0)
+        both = jnp.concatenate([img1, img2], axis=0)
+        v = enc.init({"params": rng}, both)
+        D1, D2, U1 = enc.apply(v, both)
+        assert D1.shape == (B, H // 8, W // 8, 64)       # stride 8
+        assert D2.shape == D1.shape
+        assert U1.shape == (B, H // 4, W // 4, 48)       # stride-4 context
+
+
+class TestKeypointTransformerRAFT:
+    def test_forward_and_test_mode(self, images):
+        img1, img2 = images
+        m = KeypointTransformerRAFT(num_queries=9, iterations=2,
+                                    dropout=0.0)
+        v, preds = _init_and_apply(m, img1, img2)
+        assert len(preds) == 2
+        assert preds[-1].shape == (B, H, W, 2)
+        assert bool(jnp.isfinite(preds[-1]).all())
+        lo, up = m.apply(v, img1, img2, test_mode=True)
+        np.testing.assert_array_equal(np.asarray(lo), np.asarray(up))
+
+
+class TestDualQueryRAFT:
+    def test_two_list_contract_and_corr_loss(self, images):
+        img1, img2 = images
+        m = DualQueryRAFT(iterations=2, dropout=0.0)
+        v, (flow_preds, corr_preds) = _init_and_apply(m, img1, img2)
+        assert len(flow_preds) == len(corr_preds) == 2
+        assert flow_preds[-1].shape == corr_preds[-1].shape == (B, H, W, 2)
+
+        gt = jnp.zeros((B, H, W, 2))
+        valid = jnp.ones((B, H, W))
+        loss, metrics = sequence_corr_loss(jnp.stack(flow_preds),
+                                           jnp.stack(corr_preds), gt, valid)
+        assert bool(jnp.isfinite(loss))
+        np.testing.assert_allclose(
+            float(metrics["flow_loss"] + metrics["corr_loss"]),
+            float(loss), rtol=1e-6)
+
+    def test_gradients_reach_both_stacks(self, images):
+        img1, img2 = images
+        m = DualQueryRAFT(iterations=1, dropout=0.0)
+        rng = jax.random.PRNGKey(2)
+        v = m.init({"params": rng, "dropout": rng}, img1, img2)
+
+        def loss_fn(params):
+            fp, cp = m.apply({"params": params,
+                              "batch_stats": v.get("batch_stats", {})},
+                             img1, img2)
+            gt = jnp.ones((B, H, W, 2))
+            return (jnp.abs(fp[-1] - gt).mean()
+                    + jnp.abs(cp[-1] - gt).mean())
+
+        grads = jax.grad(loss_fn)(v["params"])
+        for stack in ("context_decoder_0", "correlation_decoder_0",
+                      "correlation_flow_embed"):
+            g = jax.tree.leaves(grads[stack])
+            assert any(float(jnp.abs(x).max()) > 0 for x in g), stack
+
+
+class TestTwoStageKeypointRAFT:
+    def test_forward_sparse_contract(self, images):
+        img1, img2 = images
+        m = TwoStageKeypointRAFT(base_channel=32, d_model=64,
+                                 num_queries=9, iterations=2, dropout=0.0)
+        v, (flow_preds, sparse_preds) = _init_and_apply(m, img1, img2)
+        assert len(flow_preds) == len(sparse_preds) == 2
+        assert flow_preds[-1].shape == (B, H, W, 2)
+        ref, kf = sparse_preds[-1]
+        assert ref.shape == (B, 9, 2) and kf.shape == (B, 9, 2)
+        # refined reference points stay normalized
+        assert float(ref.min()) >= 0.0 and float(ref.max()) <= 1.0
+        assert bool(jnp.isfinite(flow_preds[-1]).all())
+
+    def test_d_model_tied_to_encoder(self, images):
+        img1, img2 = images
+        m = TwoStageKeypointRAFT(base_channel=32, d_model=128,
+                                 num_queries=9, iterations=1)
+        with pytest.raises(AssertionError, match="stride-8 width"):
+            m.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(0)}, img1, img2)
+
+
+class TestOurs07EncoderMode:
+    def test_encoder_stacks_active(self, images):
+        img1, img2 = images
+        cfg = OursConfig(base_channel=16, d_model=32, outer_iterations=2,
+                         num_keypoints=9, n_heads=4, dropout=0.0,
+                         encoder_iterations=2)
+        m = SparseRAFT(cfg)
+        rng = jax.random.PRNGKey(3)
+        v = m.init({"params": rng, "dropout": rng}, img1, img2)
+        names = set(v["params"].keys())
+        assert {"encoder_0", "encoder_1", "context_encoder_0",
+                "context_encoder_1", "encoder_pos_proj"} <= names
+        fp, sp = m.apply(v, img1, img2)
+        assert len(fp) == 2 and fp[-1].shape == (B, H, W, 2)
+        assert bool(jnp.isfinite(fp[-1]).all())
+
+    def test_default_has_no_encoder_params(self, images):
+        img1, img2 = images
+        cfg = OursConfig(base_channel=16, d_model=32, outer_iterations=1,
+                         num_keypoints=9, n_heads=4, dropout=0.0)
+        m = SparseRAFT(cfg)
+        rng = jax.random.PRNGKey(3)
+        v = m.init({"params": rng, "dropout": rng}, img1, img2)
+        assert not any(n.startswith("encoder_")
+                       for n in v["params"].keys())
