@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement sweep for the non-headline benchmarks.
+
+Covers, in independent sections (each guarded so one failure doesn't sink
+the rest; results appended per-section to ``TPU_EXTRAS.json``):
+
+* ``sparse_train``  — SparseRAFT train-step timing at the fork's active
+  resolution (352x480, ``train_standard.sh:6``), batch swept.
+* ``kitti_eval``    — canonical RAFT eval forward at KITTI resolution
+  (1242x375 → padded 1248x384, ``BASELINE.json`` configs[4]) in mixed
+  precision, all-pairs vs ``alternate_corr``, with peak-HBM telemetry.
+* ``batch1``        — single-pair latency breakdown (the bench's
+  batch-1 gap): plain batch 1 vs a double-buffered batch 2.
+* ``msda_dense``    — one ``DeformableTransformerEncoderLayer`` at dense
+  HW-token scale (the gather-bound path flagged in VERDICT r1 #10).
+
+Run alone on the TPU host (the tunnel serializes processes):
+
+    python scripts/tpu_extras_bench.py [section ...]
+
+Timing uses a scalar host readback after every measured region —
+``block_until_ready`` alone has returned early through the tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+OUT_PATH = "TPU_EXTRAS.json"
+WARMUP, REPS = 2, 10
+
+
+def _sync(x) -> float:
+    return float(jnp.sum(x) if x.ndim else x)
+
+
+def _time(fn, *args, reps: int = REPS) -> float:
+    """Mean seconds per call; dispatch back-to-back, readback once."""
+    for _ in range(WARMUP):
+        _sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def _peak_hbm_gb() -> float:
+    stats = jax.devices()[0].memory_stats() or {}
+    return round(stats.get("peak_bytes_in_use", 0) / 2 ** 30, 3)
+
+
+def sparse_train() -> dict:
+    """SparseRAFT forward AND train-step rates at 352x480."""
+    from raft_tpu.config import OursConfig, TrainConfig
+    from raft_tpu.models import SparseRAFT
+    from raft_tpu.parallel import create_train_state, make_train_step
+
+    H, W = 352, 480
+    out = {"resolution": [H, W]}
+    for batch in (2, 4, 8):
+        tcfg = TrainConfig(model_family="sparse", batch_size=batch,
+                           image_size=(H, W), iters=6, sparse_lambda=0.1)
+        model = SparseRAFT(OursConfig(mixed_precision=True))
+        rng = jax.random.PRNGKey(0)
+        state = create_train_state(rng, model, tcfg, (H, W))
+        step_fn = make_train_step(tcfg, donate=False)
+        b = {"image1": jnp.ones((batch, H, W, 3)) * 127.0,
+             "image2": jnp.ones((batch, H, W, 3)) * 127.0,
+             "flow": jnp.zeros((batch, H, W, 2)),
+             "valid": jnp.ones((batch, H, W))}
+
+        def step(state_in):
+            s2, metrics = step_fn(state_in, b, rng)
+            return metrics["loss"]
+
+        dt = _time(step, state, reps=5)
+        out[f"train_step_ms_b{batch}"] = round(dt * 1e3, 2)
+        out[f"train_samples_per_sec_b{batch}"] = round(batch / dt, 2)
+        out[f"peak_hbm_gb_b{batch}"] = _peak_hbm_gb()
+    return out
+
+
+def kitti_eval() -> dict:
+    """Canonical RAFT at KITTI 1242x375 (padded 1248x384), iters=24,
+    mixed precision: all-pairs vs the on-demand Pallas path."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 384, 1248            # InputPadder kitti mode output
+    out = {"resolution": [H, W], "iters": 24}
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    for name, alt in (("all_pairs", False), ("alternate_corr", True)):
+        cfg = RAFTConfig(iters=24, mixed_precision=True,
+                         alternate_corr=alt)
+        model = RAFT(cfg)
+        variables = model.init({"params": rng, "dropout": rng}, img, img,
+                               iters=1)
+
+        @jax.jit
+        def fwd(i1, i2):
+            return jnp.sum(model.apply(variables, i1, i2,
+                                       test_mode=True)[1])
+
+        dt = _time(fwd, img, img)
+        out[f"{name}_ms"] = round(dt * 1e3, 2)
+        out[f"{name}_pairs_per_sec"] = round(1.0 / dt, 2)
+        out[f"{name}_peak_hbm_gb"] = _peak_hbm_gb()
+    return out
+
+
+def batch1() -> dict:
+    """The batch-1 latency question (VERDICT r1 #9): is a doubled batch
+    free (pipeline slack) or proportional (compute-bound)?"""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 440, 1024
+    out = {"resolution": [H, W], "iters": 12}
+    rng = jax.random.PRNGKey(0)
+    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    model = RAFT(cfg)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+
+    @jax.jit
+    def fwd(i1, i2):
+        return jnp.sum(model.apply(variables, i1, i2, test_mode=True)[1])
+
+    for batch in (1, 2, 3, 4):
+        img = jnp.broadcast_to(img1, (batch, H, W, 3))
+        dt = _time(fwd, img, img)
+        out[f"ms_b{batch}"] = round(dt * 1e3, 2)
+        out[f"pairs_per_sec_b{batch}"] = round(batch / dt, 2)
+    # sequential-pair rate a latency-bound client actually sees at b=1,
+    # vs streaming two pairs as one batch=2 (the double-buffer lever)
+    return out
+
+
+def msda_dense() -> dict:
+    """DeformableTransformerEncoderLayer at dense HW-token scale
+    (sparse-family stride-8 grid of the fork's training res)."""
+    from raft_tpu.models.deformable import \
+        DeformableTransformerEncoderLayer, DeformableTransformerEncoder
+
+    out = {}
+    for (h, w) in ((44, 60), (88, 120)):
+        d_model = 128
+        tokens = h * w
+        layer = DeformableTransformerEncoderLayer(
+            d_model=d_model, d_ffn=d_model * 4, dropout=0.0,
+            activation="gelu", n_levels=1, n_heads=8, n_points=4)
+        rng = jax.random.PRNGKey(0)
+        src = jax.random.normal(rng, (1, tokens, d_model))
+        ref = DeformableTransformerEncoder.get_reference_points([(h, w)])
+        ref = jnp.broadcast_to(ref, (1, tokens, 1, 2))
+        variables = layer.init({"params": rng}, src, None, ref, [(h, w)])
+
+        @jax.jit
+        def fwd(s):
+            return jnp.sum(layer.apply(variables, s, None, ref, [(h, w)]))
+
+        dt = _time(fwd, src)
+        out[f"tokens_{tokens}_ms"] = round(dt * 1e3, 3)
+    return out
+
+
+SECTIONS = {"sparse_train": sparse_train, "kitti_eval": kitti_eval,
+            "batch1": batch1, "msda_dense": msda_dense}
+
+
+def main(argv):
+    names = argv or list(SECTIONS)
+    print("devices:", jax.devices(), flush=True)
+    results = {}
+    try:
+        with open(OUT_PATH) as f:
+            results = json.load(f)
+    except Exception:
+        pass
+    for name in names:
+        t0 = time.time()
+        try:
+            results[name] = SECTIONS[name]()
+            results[name]["wall_s"] = round(time.time() - t0, 1)
+            print(f"{name}: {json.dumps(results[name])}", flush=True)
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"{name}: FAILED {e}", flush=True)
+        with open(OUT_PATH, "w") as f:
+            json.dump(results, f, indent=1)
+    print("wrote", OUT_PATH)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
