@@ -438,15 +438,15 @@ def _raft_only_selections(small, alternate_corr, corr_dtype):
 def reject_raft_only_flags(parser, args) -> None:
     """Upfront CLI validation shared by train.py and evaluate.py: flags
     that only configure the canonical RAFT family must not be silently
-    dropped when ``--model_family sparse`` builds from ``OursConfig``."""
-    if args.model_family != "sparse":
+    dropped when another family builds from its own config."""
+    if args.model_family == "raft":
         return
     for name, on in _raft_only_selections(args.small, args.alternate_corr,
                                           args.corr_dtype):
         if on:
             parser.error(f"--{name} applies to the canonical RAFT family "
-                         "only (the sparse family has no small variant "
-                         "and fixed fork-corr semantics)")
+                         f"only (the {args.model_family} family has no "
+                         "small variant and fixed corr semantics)")
 
 
 def main(argv=None):

@@ -225,8 +225,11 @@ class KeypointTransformerRAFT(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
-                 test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False):
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False, freeze_bn: bool = False):
+        if flow_init is not None:
+            raise ValueError("snapshot variants do not support warm "
+                             "starting (flow_init)")
         del iters   # the snapshot's flag; self.iterations rules
         dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
         deterministic = not train
@@ -301,8 +304,11 @@ class DualQueryRAFT(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
-                 test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False):
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False, freeze_bn: bool = False):
+        if flow_init is not None:
+            raise ValueError("snapshot variants do not support warm "
+                             "starting (flow_init)")
         del iters
         dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
         deterministic = not train
@@ -400,8 +406,11 @@ class TwoStageKeypointRAFT(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
-                 test_mode: bool = False, train: bool = False,
-                 freeze_bn: bool = False):
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False, freeze_bn: bool = False):
+        if flow_init is not None:
+            raise ValueError("snapshot variants do not support warm "
+                             "starting (flow_init)")
         del iters
         dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
         deterministic = not train

@@ -105,17 +105,39 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
         def loss_fn(params):
             variables = {"params": params,
                          "batch_stats": state.batch_stats}
-            if tcfg.model_family == "sparse":
-                # The fork's active trainer (reference train.py:19 →
-                # core/ours.py): list of per-outer-iteration dense flows
-                # plus sparse keypoint predictions, with the auxiliary
-                # sparse loss gated to the first sparse_lambda_steps
-                # (reference train.py:379-383).
-                (flow_preds, sparse_preds), mutated = state.apply_fn(
-                    variables, image1, image2, iters=tcfg.iters,
+
+            def apply(v):
+                return state.apply_fn(
+                    v, image1, image2, iters=tcfg.iters,
                     train=True, freeze_bn=freeze_bn,
                     rngs={"dropout": dropout_rng},
                     mutable=["batch_stats"])
+
+            if tcfg.model_family == "dual_query":
+                # The two-list snapshot trainer (reference
+                # train_02.py:54-81): flow + corr predictions, each under
+                # a uniformly-weighted masked L1.
+                from raft_tpu.losses import sequence_corr_loss
+                (flow_preds, corr_preds), mutated = apply(variables)
+                loss, metrics = sequence_corr_loss(
+                    jnp.stack(list(flow_preds)),
+                    jnp.stack(list(corr_preds)),
+                    batch["flow"], batch["valid"])
+            elif tcfg.model_family == "keypoint_transformer":
+                # ours_02 snapshot: a plain list of dense flows.
+                flow_preds, mutated = apply(variables)
+                loss, metrics = sequence_loss(
+                    jnp.stack(list(flow_preds)), batch["flow"],
+                    batch["valid"], gamma=tcfg.gamma,
+                    normalization=tcfg.loss_normalization)
+            elif tcfg.model_family in ("sparse", "two_stage"):
+                # The fork's active trainer (reference train.py:19 →
+                # core/ours.py): list of per-outer-iteration dense flows
+                # plus sparse keypoint predictions ((ref, key_flow, ...)
+                # tuples — TwoStageKeypointRAFT emits the same contract),
+                # with the auxiliary sparse loss gated to the first
+                # sparse_lambda_steps (reference train.py:379-383).
+                (flow_preds, sparse_preds), mutated = apply(variables)
                 out = jnp.stack(list(flow_preds))
                 loss, metrics = sequence_loss(
                     out, batch["flow"], batch["valid"], gamma=tcfg.gamma,
@@ -136,11 +158,7 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
                     metrics["sparse_loss"] = sparse
                     metrics["loss"] = loss
             else:
-                out, mutated = state.apply_fn(
-                    variables, image1, image2, iters=tcfg.iters,
-                    train=True, freeze_bn=freeze_bn,
-                    rngs={"dropout": dropout_rng},
-                    mutable=["batch_stats"])
+                out, mutated = apply(variables)
                 loss, metrics = sequence_loss(
                     out, batch["flow"], batch["valid"], gamma=tcfg.gamma,
                     normalization=tcfg.loss_normalization)

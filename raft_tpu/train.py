@@ -39,6 +39,34 @@ def _eval_variables(state):
     return {"params": state.params, "batch_stats": state.batch_stats}
 
 
+# Trainable model families: the two live ones plus the rebuilt
+# experiment snapshots (reference core/ours_02/04/06.py lineages, see
+# raft_tpu/models/variants.py).
+MODEL_FAMILIES = ("raft", "sparse", "keypoint_transformer", "dual_query",
+                  "two_stage")
+
+
+def build_model(model_family: str, mcfg: RAFTConfig):
+    if model_family == "sparse":
+        from raft_tpu.config import OursConfig
+        from raft_tpu.models import SparseRAFT
+        return SparseRAFT(OursConfig(mixed_precision=mcfg.mixed_precision))
+    if model_family == "keypoint_transformer":
+        from raft_tpu.models import KeypointTransformerRAFT
+        return KeypointTransformerRAFT(
+            mixed_precision=mcfg.mixed_precision)
+    if model_family == "dual_query":
+        from raft_tpu.models import DualQueryRAFT
+        return DualQueryRAFT(mixed_precision=mcfg.mixed_precision)
+    if model_family == "two_stage":
+        from raft_tpu.models import TwoStageKeypointRAFT
+        return TwoStageKeypointRAFT(mixed_precision=mcfg.mixed_precision)
+    if model_family == "raft":
+        return RAFT(mcfg)
+    raise ValueError(f"unknown model_family {model_family!r}; "
+                     f"choose from {MODEL_FAMILIES}")
+
+
 def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
           data_root: Optional[str] = None,
           ckpt_dir: str = "checkpoints",
@@ -58,13 +86,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     np.random.seed(tcfg.seed)                 # host-side aug reproducibility
 
     mesh = make_mesh()
-    if tcfg.model_family == "sparse":
-        from raft_tpu.config import OursConfig
-        from raft_tpu.models import SparseRAFT
-        model = SparseRAFT(OursConfig(
-            mixed_precision=mcfg.mixed_precision))
-    else:
-        model = RAFT(mcfg)
+    model = build_model(tcfg.model_family, mcfg)
     run_ckpt_dir = os.path.join(ckpt_dir, tcfg.name)
 
     with mesh:
@@ -128,6 +150,11 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                              batch["flow"]))
                         if tcfg.model_family == "sparse":
                             flow_preds, sparse_preds = preds
+                        elif tcfg.model_family in ("dual_query",
+                                                   "two_stage"):
+                            # two-list outputs; only the sparse family's
+                            # 4-tuples feed the keypoint/mask panels
+                            flow_preds, sparse_preds = preds[0], None
                         else:
                             flow_preds, sparse_preds = preds, None
                         logger.write_images(i1, i2, fl, flow_preds,
@@ -156,9 +183,11 @@ def main(argv=None):
     parser.add_argument("--stage", default="chairs",
                         choices=["chairs", "things", "sintel", "kitti"])
     parser.add_argument("--model_family", default="raft",
-                        choices=["raft", "sparse"],
-                        help="canonical RAFT or the fork's sparse-keypoint "
-                             "(ours) family")
+                        choices=list(MODEL_FAMILIES),
+                        help="canonical RAFT, the fork's sparse-keypoint "
+                             "(ours) family, or a rebuilt experiment "
+                             "snapshot (keypoint_transformer=ours_02, "
+                             "dual_query=ours_04, two_stage=ours_06)")
     parser.add_argument("--sparse_lambda", type=float, default=0.0,
                         help="auxiliary sparse loss weight (first 20k "
                              "steps; reference train.py:379-383)")
@@ -180,7 +209,10 @@ def main(argv=None):
     parser.add_argument("--dropout", type=float, default=0.0)
     parser.add_argument("--gamma", type=float, default=0.8,
                         help="exponential loss weighting")
-    parser.add_argument("--iters", type=int, default=12)
+    parser.add_argument("--iters", type=int, default=None,
+                        help="refinement iterations (canonical RAFT "
+                             "only; default 12 — the other families' "
+                             "iteration counts are architectural)")
     parser.add_argument("--add_noise", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--alternate_corr", action="store_true")
@@ -199,6 +231,18 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     evaluate.reject_raft_only_flags(parser, args)
+    # No silently-dropped flags: every non-raft family fixes its
+    # iteration count architecturally (the snapshots' `del iters`), and
+    # only the keypoint families consume the auxiliary sparse loss.
+    if args.iters is not None and args.model_family != "raft":
+        parser.error(f"--iters applies to the canonical RAFT family only "
+                     f"(the {args.model_family} family's iteration count "
+                     "is fixed by its architecture)")
+    if args.sparse_lambda > 0 and args.model_family not in ("sparse",
+                                                            "two_stage"):
+        parser.error("--sparse_lambda requires a keypoint family "
+                     "(sparse or two_stage)")
+    iters = args.iters if args.iters is not None else 12
 
     tcfg = TrainConfig(
         name=args.name, stage=args.stage,
@@ -207,10 +251,10 @@ def main(argv=None):
         num_steps=args.num_steps, batch_size=args.batch_size,
         image_size=tuple(args.image_size), wdecay=args.wdecay,
         epsilon=args.epsilon, clip=args.clip, gamma=args.gamma,
-        add_noise=args.add_noise, iters=args.iters,
+        add_noise=args.add_noise, iters=iters,
         val_freq=args.val_freq, scheduler=args.scheduler, seed=args.seed)
     mcfg = RAFTConfig(
-        small=args.small, dropout=args.dropout, iters=args.iters,
+        small=args.small, dropout=args.dropout, iters=iters,
         alternate_corr=args.alternate_corr,
         mixed_precision=args.mixed_precision,
         corr_dtype=args.corr_dtype)

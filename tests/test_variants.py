@@ -117,6 +117,44 @@ class TestTwoStageKeypointRAFT:
                     "dropout": jax.random.PRNGKey(0)}, img1, img2)
 
 
+class TestVariantTrainSteps:
+    """Each rebuilt snapshot trains end-to-end through the shared jitted
+    step (its loss contract dispatched by ``TrainConfig.model_family``)."""
+
+    @pytest.mark.parametrize("family,model_kw,expect_metric", [
+        ("keypoint_transformer",
+         dict(num_queries=9, iterations=2, dropout=0.0), "epe"),
+        ("dual_query", dict(iterations=2, dropout=0.0), "corr_loss"),
+        ("two_stage", dict(base_channel=32, d_model=64, num_queries=9,
+                           iterations=2, dropout=0.0), "sparse_loss"),
+    ])
+    def test_train_step(self, images, family, model_kw, expect_metric):
+        from raft_tpu.config import TrainConfig
+        from raft_tpu.parallel import create_train_state, make_train_step
+        from raft_tpu.train import build_model
+        from raft_tpu.config import RAFTConfig
+
+        model = build_model(family, RAFTConfig())
+        # swap in the tiny test-sized model of the same family
+        model = type(model)(**model_kw)
+
+        tcfg = TrainConfig(model_family=family, batch_size=B,
+                           image_size=(H, W), num_steps=10, iters=2,
+                           sparse_lambda=0.1)
+        rng = jax.random.PRNGKey(0)
+        state = create_train_state(rng, model, tcfg, (H, W))
+        step_fn = make_train_step(tcfg, donate=False)
+        img1, img2 = images
+        batch = {"image1": img1, "image2": img2,
+                 "flow": jnp.zeros((B, H, W, 2)),
+                 "valid": jnp.ones((B, H, W))}
+        state2, metrics = step_fn(state, batch, rng)
+        assert int(state2.step) == 1
+        assert bool(jnp.isfinite(metrics["loss"]))
+        assert expect_metric in metrics
+        assert float(metrics["grad_norm"]) > 0.0
+
+
 class TestOurs07EncoderMode:
     def test_encoder_stacks_active(self, images):
         img1, img2 = images
