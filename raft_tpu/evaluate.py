@@ -382,24 +382,24 @@ def load_predictor(model_path: str, small: bool = False,
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
 
-    if model_family == "sparse":
-        from raft_tpu.config import OursConfig
-        from raft_tpu.models import SparseRAFT
+    if model_family != "raft":
         dropped = [name for name, on in _raft_only_selections(
             small, alternate_corr, corr_dtype) if on]
         if dropped:
             raise ValueError(
                 f"{', '.join(dropped)} appl"
                 f"{'ies' if len(dropped) == 1 else 'y'} to the canonical "
-                "RAFT family only; the sparse family is built from "
-                "OursConfig and would silently ignore "
+                f"RAFT family only; the {model_family} family is built "
+                "from its own config and would silently ignore "
                 f"{'it' if len(dropped) == 1 else 'them'}")
         if model_path.endswith((".pth", ".pt", ".npz")):
             raise ValueError(
                 "torch-checkpoint conversion covers the canonical RAFT "
-                "family only (no published sparse/ours weights exist); "
-                "load the sparse family from an orbax run directory")
-        model = SparseRAFT(OursConfig(mixed_precision=mixed_precision))
+                f"family only (no published {model_family} weights "
+                "exist); load this family from an orbax run directory")
+        from raft_tpu.train import build_model
+        model = build_model(model_family,
+                            RAFTConfig(mixed_precision=mixed_precision))
     else:
         cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
                          mixed_precision=mixed_precision,
@@ -463,7 +463,8 @@ def main(argv=None):
                                                      "kitti_submission"])
     parser.add_argument("--small", action="store_true")
     parser.add_argument("--model_family", default="raft",
-                        choices=["raft", "sparse"])
+                        choices=["raft", "sparse", "keypoint_transformer",
+                                 "dual_query", "two_stage"])
     parser.add_argument("--iters", type=int, default=None)
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
@@ -483,9 +484,15 @@ def main(argv=None):
                      # fixture goldens are recorded at iters=12
                      # (assets/golden/manifest.json)
                      "golden": 12}
-    if args.model_family == "sparse" and args.warm_start:
+    if args.model_family != "raft" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
-                     "(the sparse family does not support flow_init)")
+                     f"(the {args.model_family} family does not support "
+                     "flow_init)")
+    if args.model_family != "raft" and args.iters is not None:
+        # every non-raft family fixes its iteration count architecturally
+        parser.error("--iters applies to the canonical RAFT family only "
+                     f"(the {args.model_family} family's iteration count "
+                     "is fixed by its architecture)")
     reject_raft_only_flags(parser, args)
     iters = args.iters or default_iters[args.dataset]
     predictor = load_predictor(args.model, small=args.small,

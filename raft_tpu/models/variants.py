@@ -35,9 +35,10 @@ interface is the one these snapshots were written against (the current
 ``core/extractor.py`` returns pyramids, which is what killed them).
 
 Deliberate deviations from the snapshots, for working code:
-* learned row/col position tables are created at call time for the
-  actual feature size (the snapshots fix them to ``args.image_size`` and
-  bilinearly resize on mismatch — same capability, no config coupling);
+* learned row/col position tables are fixed-length and interpolated to
+  the actual feature size (the snapshots fix them to ``args.image_size``
+  and bilinearly resize on mismatch — same capability, no config
+  coupling, any resolution after init);
 * the snapshots' conv1d MLPs with BatchNorm1d (ours_06) use the shared
   GroupNorm :class:`raft_tpu.models.deformable.MLP` instead (batch-stat
   plumbing for a dead snapshot's MLP norm buys nothing);
@@ -67,14 +68,26 @@ def _tokens(x):
     return x.reshape(B, H * W, C)
 
 
+_POS_TABLE = 128   # learned-table length per axis (interpolated to fit)
+
+
 def _learned_pos(self_mod, h: int, w: int, d_model: int, name: str):
     """Learned separable row/col position embedding
-    (reference ``ours_02.py:46-47`` / ``ours_04.py:66-67``), created at
-    the actual feature size; returns (1, h*w, d_model)."""
-    col = self_mod.param(f"{name}_col", nn.initializers.uniform(1.0),
-                         (h, d_model // 2))
-    row = self_mod.param(f"{name}_row", nn.initializers.uniform(1.0),
-                         (w, d_model // 2))
+    (reference ``ours_02.py:46-47`` / ``ours_04.py:66-67``).  The
+    snapshots size their tables to ``args.image_size // 8`` and
+    bilinearly resize on mismatch (``get_embedding``); here fixed
+    ``_POS_TABLE``-entry tables are interpolated per axis to the actual
+    feature size (the live model's convention, ``ours.py`` 1000-entry
+    tables), so one set of params serves every resolution.
+    Returns (1, h*w, d_model)."""
+    from raft_tpu.models.ours import _interp1d
+
+    col_tab = self_mod.param(f"{name}_col", nn.initializers.uniform(1.0),
+                             (_POS_TABLE, d_model // 2))
+    row_tab = self_mod.param(f"{name}_row", nn.initializers.uniform(1.0),
+                             (_POS_TABLE, d_model // 2))
+    col = _interp1d(col_tab, h)                      # (h, d/2)
+    row = _interp1d(row_tab, w)                      # (w, d/2)
     grid = jnp.concatenate([
         jnp.broadcast_to(col[:, None], (h, w, d_model // 2)),
         jnp.broadcast_to(row[None, :], (h, w, d_model // 2))], axis=-1)

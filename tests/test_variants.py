@@ -155,6 +155,48 @@ class TestVariantTrainSteps:
         assert float(metrics["grad_norm"]) > 0.0
 
 
+class TestVariantEvalPath:
+    def test_checkpoint_roundtrip_through_load_predictor(self, images,
+                                                         tmp_path):
+        """train-state checkpoint → evaluate.load_predictor → forward:
+        the full CLI eval path for a snapshot family."""
+        from raft_tpu import checkpoint as ckpt_lib
+        from raft_tpu.config import RAFTConfig, TrainConfig
+        from raft_tpu.evaluate import load_predictor
+        from raft_tpu.parallel import create_train_state
+        from raft_tpu.train import build_model
+
+        model = build_model("keypoint_transformer", RAFTConfig())
+        tcfg = TrainConfig(model_family="keypoint_transformer",
+                           batch_size=1, image_size=(H, W), num_steps=10)
+        state = create_train_state(jax.random.PRNGKey(0), model, tcfg,
+                                   (H, W))
+        ckpt_dir = str(tmp_path / "kp")
+        ckpt_lib.save_checkpoint(ckpt_dir, state)
+
+        predictor = load_predictor(ckpt_dir,
+                                   model_family="keypoint_transformer",
+                                   iters=6)
+        img1, img2 = images
+        lo, up = predictor(np.asarray(img1[0]), np.asarray(img2[0]))
+        assert up.shape == (H, W, 2)
+        assert np.isfinite(up).all()
+
+    def test_random_smoke_mode(self, images):
+        from raft_tpu.evaluate import load_predictor
+        predictor = load_predictor("random", model_family="dual_query",
+                                   iters=6)
+        img1, img2 = images
+        _, up = predictor(np.asarray(img1[0]), np.asarray(img2[0]))
+        assert up.shape == (H, W, 2)
+
+    def test_npz_rejected_for_variants(self):
+        from raft_tpu.evaluate import load_predictor
+        with pytest.raises(ValueError, match="orbax"):
+            load_predictor("assets/golden/weights.npz",
+                           model_family="two_stage")
+
+
 class TestOurs07EncoderMode:
     def test_encoder_stacks_active(self, images):
         img1, img2 = images
