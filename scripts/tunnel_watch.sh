@@ -1,0 +1,31 @@
+#!/bin/bash
+# Poll the accelerator tunnel; when it answers, run the benchmark suite
+# once and leave the artifacts in the repo root. Safe to leave running —
+# it exits after one SUCCESSFUL capture (a bench failure-JSON doesn't
+# count: the probe loop continues) or after MAX_TRIES probes.
+cd "$(dirname "$0")/.."
+MAX_TRIES=${MAX_TRIES:-60}
+SLEEP_S=${SLEEP_S:-600}
+for i in $(seq 1 "$MAX_TRIES"); do
+  # 420s probe: SIGTERM mid-backend-init can wedge the tunnel, and slow
+  # recoveries legitimately take >5 min to answer
+  if timeout 420 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+    echo "tunnel up on probe $i ($(date -u +%H:%M:%SZ)); capturing" | tee -a tunnel_watch.log
+    RAFT_BENCH_DEADLINE_S=600 RAFT_BENCH_TOTAL_DEADLINE_S=1500 \
+      timeout 1800 python bench.py > BENCH_CAPTURE.json 2> bench_capture.log
+    if grep -q '"error"' BENCH_CAPTURE.json || ! grep -q '"value": [0-9]' BENCH_CAPTURE.json; then
+      echo "probe $i: bench capture failed (tunnel flap?); retrying" | tee -a tunnel_watch.log
+      sleep "$SLEEP_S"
+      continue
+    fi
+    if ! timeout 3600 python scripts/tpu_extras_bench.py >> tunnel_watch.log 2>&1; then
+      echo "probe $i: extras sweep failed; bench capture kept" | tee -a tunnel_watch.log
+    fi
+    echo "capture done ($(date -u +%H:%M:%SZ))" | tee -a tunnel_watch.log
+    exit 0
+  fi
+  echo "probe $i: tunnel down ($(date -u +%H:%M:%SZ))" >> tunnel_watch.log
+  sleep "$SLEEP_S"
+done
+echo "gave up after $MAX_TRIES probes" | tee -a tunnel_watch.log
+exit 1

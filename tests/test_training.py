@@ -272,6 +272,37 @@ class TestShardedTrainStep:
                                    float(m_shard["loss"]), rtol=2e-4)
 
 
+def test_sparse_family_sharded_matches_single_device(rng):
+    """The second model family is data-parallel-correct too: one sharded
+    step over the 8-device mesh equals the single-device step."""
+    from raft_tpu.config import OursConfig
+    from raft_tpu.models import SparseRAFT
+
+    H, W = 32, 48
+    tcfg = TrainConfig(batch_size=8, image_size=(H, W), num_steps=10,
+                       iters=2, model_family="sparse", sparse_lambda=0.1)
+    cfg = OursConfig(base_channel=16, d_model=32, num_feature_levels=2,
+                     outer_iterations=2, num_keypoints=4, n_heads=4,
+                     n_points=2, dropout=0.0)
+    model = SparseRAFT(cfg)
+    batch = _tiny_batch(rng, B=8, H=H, W=W)
+    key = jax.random.PRNGKey(1)
+
+    state1 = create_train_state(jax.random.PRNGKey(0), model, tcfg, (H, W))
+    _, m_single = make_train_step(tcfg, donate=False)(state1, batch, key)
+
+    mesh = make_mesh()
+    with mesh:
+        state2 = create_train_state(jax.random.PRNGKey(0), model, tcfg,
+                                    (H, W), mesh=mesh)
+        _, m_shard = make_train_step(tcfg, mesh=mesh, donate=False)(
+            state2, shard_batch(batch, mesh), key)
+    np.testing.assert_allclose(float(m_single["loss"]),
+                               float(m_shard["loss"]), rtol=2e-4)
+    np.testing.assert_allclose(float(m_single["sparse_loss"]),
+                               float(m_shard["sparse_loss"]), rtol=2e-4)
+
+
 def test_sparse_family_train_step(rng):
     """One train step of the sparse ("ours") family — the fork's active
     trainer (reference train.py:19 → core/ours.py) — with the auxiliary
