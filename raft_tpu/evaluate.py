@@ -298,20 +298,27 @@ class _GoldenFixture:
     """Dataset-protocol view of the repo-owned golden fixtures
     (``assets/``, built by ``scripts/make_golden_fixtures.py``): each item
     is ``(image1, image2, flow_gt, flow_golden)`` where ``flow_golden`` is
-    the stored canonical-torch output with the fixture weights."""
+    the stored canonical-torch output with the fixture weights.
+    ``variant``: "large" (default) or "small" — separate weights and
+    golden outputs per model size (BASELINE configs[0] vs [1])."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, variant: str = "large"):
         import json
         self.frames = osp.join(root, "demo-frames")
         self.golden = osp.join(root, "golden")
         with open(osp.join(self.golden, "manifest.json")) as f:
             self.manifest = json.load(f)
+        if variant == "large":
+            self.prefix, self.pairs = "flow_golden", self.manifest["pairs"]
+        else:
+            sub = self.manifest[variant]
+            self.prefix, self.pairs = sub["prefix"], sub["pairs"]
 
     def __len__(self):
-        return len(self.manifest["pairs"])
+        return len(self.pairs)
 
     def __getitem__(self, idx):
-        pair = self.manifest["pairs"][idx]
+        pair = self.pairs[idx]
         img1 = np.asarray(frame_utils.read_gen(
             osp.join(self.frames, pair["frame1"])), np.float32)
         img2 = np.asarray(frame_utils.read_gen(
@@ -319,12 +326,12 @@ class _GoldenFixture:
         gt = frame_utils.read_flo(
             osp.join(self.golden, f"flow_gt_{idx:02d}.flo"))
         golden = np.load(osp.join(self.golden,
-                                  f"flow_golden_{idx:02d}.npy"))
+                                  f"{self.prefix}_{idx:02d}.npy"))
         return img1, img2, gt, golden
 
 
-def validate_golden(predictor: FlowPredictor,
-                    root=None) -> Dict[str, float]:
+def validate_golden(predictor: FlowPredictor, root=None,
+                    variant: str = "large") -> Dict[str, float]:
     """End-to-end golden check against the repo-owned fixtures — no
     external dataset or reference tree required.
 
@@ -336,7 +343,7 @@ def validate_golden(predictor: FlowPredictor,
     machinery; with the fixture's random weights this is large and only
     meaningful as a regression pin)."""
     root = root or ASSETS_DIR
-    fixture = _GoldenFixture(root)
+    fixture = _GoldenFixture(root, variant=variant)
     want = fixture.manifest["iters"]
     if predictor.iters != want:
         print(f"WARNING: golden outputs recorded at iters={want}, "
@@ -346,14 +353,24 @@ def validate_golden(predictor: FlowPredictor,
     for _, sample, flow in _predict_dataset(predictor, fixture):
         parity.append(float(_epe_map(flow, sample[3]).mean()))
         gt_epes.append(float(_epe_map(flow, sample[2]).mean()))
-    results = {"golden_parity_epe": float(np.mean(parity)),
-               "golden_gt_epe": float(np.mean(gt_epes))}
-    print(f"Validation Golden: parity EPE {results['golden_parity_epe']:.6f}"
-          f", GT EPE {results['golden_gt_epe']:.4f}")
+    key = "golden" if variant == "large" else f"golden_{variant}"
+    results = {f"{key}_parity_epe": float(np.mean(parity)),
+               f"{key}_gt_epe": float(np.mean(gt_epes))}
+    print(f"Validation Golden[{variant}]: parity EPE "
+          f"{results[f'{key}_parity_epe']:.6f}, "
+          f"GT EPE {results[f'{key}_gt_epe']:.4f}")
     return results
 
 
+def validate_golden_small(predictor: FlowPredictor,
+                          root=None) -> Dict[str, float]:
+    """RAFT-small golden check (BASELINE configs[0]); the predictor must
+    be built with ``small=True`` and ``assets/golden/weights_small.npz``."""
+    return validate_golden(predictor, root=root, variant="small")
+
+
 _VALIDATORS["golden"] = validate_golden
+_VALIDATORS["golden_small"] = validate_golden_small
 
 
 def run_validation(predictor: FlowPredictor, names) -> Dict[str, float]:
@@ -483,7 +500,13 @@ def main(argv=None):
                      "kitti_submission": 24,
                      # fixture goldens are recorded at iters=12
                      # (assets/golden/manifest.json)
-                     "golden": 12}
+                     "golden": 12, "golden_small": 12}
+    if args.dataset == "golden_small" and not args.small:
+        parser.error("--dataset golden_small compares against RAFT-small "
+                     "goldens; pass --small (and the small weights)")
+    if args.dataset == "golden" and args.small:
+        parser.error("--dataset golden compares against RAFT-large "
+                     "goldens; use --dataset golden_small for --small")
     if args.model_family != "raft" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
                      f"(the {args.model_family} family does not support "

@@ -125,42 +125,64 @@ def main():
     # --- torch golden outputs with fp16-rounded deterministic weights ---
     import torch
     from torch_oracle import (build_reference_raft_large,
+                              build_reference_raft_small,
                               torch_canonical_raft_forward)
     import corr as ref_corr
 
-    fnet, cnet, ub = build_reference_raft_large(seed=SEED)
-
-    # fp16 round-trip BEFORE recording goldens, so the stored npz (fp16,
-    # half the size) reproduces them bit-for-bit through any loader.
-    state = {}
-    for prefix, mod in (("fnet", fnet), ("cnet", cnet),
-                        ("update_block", ub)):
-        sd = mod.state_dict()
-        for k, v in sd.items():
-            sd[k] = v.half().float()
-        mod.load_state_dict(sd)
-        for k, v in sd.items():
-            state[f"{prefix}.{k}"] = v.numpy().astype(np.float16)
-    np.savez_compressed(os.path.join(golden_dir, "weights.npz"), **state)
-
     manifest = {"iters": ITERS, "seed": SEED, "H": H, "W": W, "pairs": []}
-    for i, (name, p1, p2, flow_gt) in enumerate(pairs):
-        img1 = np.asarray(Image.open(p1), np.float32)
-        img2 = np.asarray(Image.open(p2), np.float32)
-        t1 = torch.from_numpy(img1.transpose(2, 0, 1))[None]
-        t2 = torch.from_numpy(img2.transpose(2, 0, 1))[None]
-        with torch.no_grad():
-            flows = torch_canonical_raft_forward(
-                fnet, cnet, ub, t1, t2, iters=ITERS, corr_mod=ref_corr)
-        final = flows[-1][0].numpy().transpose(1, 2, 0).astype(np.float32)
-        np.save(os.path.join(golden_dir, f"flow_golden_{i:02d}.npy"), final)
-        epe = float(np.sqrt(((final - flow_gt) ** 2).sum(-1)).mean())
-        manifest["pairs"].append({"name": name,
-                                  "frame1": os.path.basename(p1),
-                                  "frame2": os.path.basename(p2),
-                                  "epe_vs_gt": round(epe, 4)})
-        print(f"golden {i} ({name}): torch EPE vs GT {epe:.3f}px "
-              f"(random weights — parity anchor, not a quality claim)")
+    configs = {
+        # (builder, forward kwargs, weights file, flow-file prefix)
+        "large": (build_reference_raft_large,
+                  dict(radius=4, hdim=128, cdim=128),
+                  "weights.npz", "flow_golden"),
+        "small": (build_reference_raft_small,
+                  dict(radius=3, hdim=96, cdim=64),
+                  "weights_small.npz", "flow_golden_small"),
+    }
+    for size, (builder, fwd_kw, wfile, fprefix) in configs.items():
+        fnet, cnet, ub = builder(seed=SEED)
+
+        # fp16 round-trip BEFORE recording goldens, so the stored npz
+        # (fp16, half the size) reproduces them bit-for-bit through any
+        # loader.
+        state = {}
+        for prefix, mod in (("fnet", fnet), ("cnet", cnet),
+                            ("update_block", ub)):
+            sd = mod.state_dict()
+            for k, v in sd.items():
+                sd[k] = v.half().float()
+            mod.load_state_dict(sd)
+            for k, v in sd.items():
+                state[f"{prefix}.{k}"] = v.numpy().astype(np.float16)
+        np.savez_compressed(os.path.join(golden_dir, wfile), **state)
+
+        entries = []
+        for i, (name, p1, p2, flow_gt) in enumerate(pairs):
+            img1 = np.asarray(Image.open(p1), np.float32)
+            img2 = np.asarray(Image.open(p2), np.float32)
+            t1 = torch.from_numpy(img1.transpose(2, 0, 1))[None]
+            t2 = torch.from_numpy(img2.transpose(2, 0, 1))[None]
+            with torch.no_grad():
+                flows = torch_canonical_raft_forward(
+                    fnet, cnet, ub, t1, t2, iters=ITERS,
+                    corr_mod=ref_corr, **fwd_kw)
+            final = flows[-1][0].numpy().transpose(1, 2, 0).astype(
+                np.float32)
+            np.save(os.path.join(golden_dir, f"{fprefix}_{i:02d}.npy"),
+                    final)
+            epe = float(np.sqrt(((final - flow_gt) ** 2).sum(-1)).mean())
+            entries.append({"name": name,
+                            "frame1": os.path.basename(p1),
+                            "frame2": os.path.basename(p2),
+                            "epe_vs_gt": round(epe, 4)})
+            print(f"golden {size} {i} ({name}): torch EPE vs GT "
+                  f"{epe:.3f}px (random weights — parity anchor, not a "
+                  "quality claim)")
+        if size == "large":
+            manifest["pairs"] = entries        # original layout, kept
+        else:
+            manifest[size] = {"weights": wfile, "prefix": fprefix,
+                              "pairs": entries}
 
     with open(os.path.join(golden_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)

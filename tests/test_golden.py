@@ -60,7 +60,28 @@ def test_golden_via_cli(capsys):
     main(["--model", os.path.join(ASSETS, "golden", "weights.npz"),
           "--dataset", "golden"])
     out = capsys.readouterr().out
-    assert "Validation Golden: parity EPE" in out
+    assert "Validation Golden[large]: parity EPE" in out
+
+
+def test_golden_small(capsys):
+    """RAFT-small end-to-end golden (BASELINE configs[0]): upflow8
+    upsampling path, radius-3 lookups, SmallUpdateBlock — all pinned
+    against the stored canonical-torch outputs."""
+    import json
+
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights_small.npz"),
+        small=True, iters=12)
+    results = validate_golden(predictor, variant="small")
+    assert results["golden_small_parity_epe"] < 2e-3, results
+
+    with open(os.path.join(ASSETS, "golden", "manifest.json")) as f:
+        manifest = json.load(f)
+    torch_gt = np.mean([p["epe_vs_gt"]
+                        for p in manifest["small"]["pairs"]])
+    assert abs(results["golden_small_gt_epe"] - torch_gt) < 1e-2, results
 
 
 def test_fixture_frames_are_valid_pairs():

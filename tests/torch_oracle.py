@@ -38,9 +38,13 @@ def torch_canonical_corr_lookup(pyramid, coords1, radius):
 
 
 def torch_canonical_raft_forward(fnet, cnet, update_block, img1, img2,
-                                 iters, corr_mod, radius=4, levels=4):
+                                 iters, corr_mod, radius=4, levels=4,
+                                 hdim=128, cdim=128):
     """Canonical RAFT forward semantics in torch (pixel coords,
-    4-level pyramid), used purely as the parity oracle."""
+    4-level pyramid), used purely as the parity oracle.  The small
+    variant (hdim=96, cdim=64, radius=3) has no mask head — its
+    update block returns ``up_mask=None`` and flows upsample via
+    ``upflow8`` (reference ``core/raft.py:135-138``)."""
     import torch.nn.functional as F
 
     img1 = 2 * (img1 / 255.0) - 1.0
@@ -49,7 +53,7 @@ def torch_canonical_raft_forward(fnet, cnet, update_block, img1, img2,
     corr_fn = corr_mod.CorrBlock(fmap1, fmap2, num_levels=levels,
                                  radius=radius)
     cnet_out = cnet(img1)
-    net, inp = torch.split(cnet_out, [128, 128], dim=1)
+    net, inp = torch.split(cnet_out, [hdim, cdim], dim=1)
     net, inp = torch.tanh(net), torch.relu(inp)
 
     N, _, H, W = fmap1.shape
@@ -67,13 +71,18 @@ def torch_canonical_raft_forward(fnet, cnet, update_block, img1, img2,
         net, up_mask, delta_flow = update_block(net, inp, corr, flow)
         coords1 = coords1 + delta_flow
         new_flow = coords1 - coords0
-        # convex upsampling (reference core/raft.py:74-85)
-        m = up_mask.view(N, 1, 9, 8, 8, H, W)
-        m = torch.softmax(m, dim=2)
-        up = F.unfold(8 * new_flow, [3, 3], padding=1)
-        up = up.view(N, 2, 9, 1, 1, H, W)
-        up = torch.sum(m * up, dim=2)
-        up = up.permute(0, 1, 4, 2, 5, 3).reshape(N, 2, 8 * H, 8 * W)
+        if up_mask is None:
+            # upflow8 (reference core/utils/utils.py:80-82)
+            up = 8 * F.interpolate(new_flow, size=(8 * H, 8 * W),
+                                   mode="bilinear", align_corners=True)
+        else:
+            # convex upsampling (reference core/raft.py:74-85)
+            m = up_mask.view(N, 1, 9, 8, 8, H, W)
+            m = torch.softmax(m, dim=2)
+            up = F.unfold(8 * new_flow, [3, 3], padding=1)
+            up = up.view(N, 2, 9, 1, 1, H, W)
+            up = torch.sum(m * up, dim=2)
+            up = up.permute(0, 1, 4, 2, 5, 3).reshape(N, 2, 8 * H, 8 * W)
         flows_up.append(up)
     return flows_up
 
@@ -94,4 +103,24 @@ def build_reference_raft_large(seed: int = 0):
                                          dropout=0).eval()
     args = SimpleNamespace(corr_levels=4, corr_radius=4)
     ub = ref_update.BasicUpdateBlock(args, hidden_dim=128).eval()
+    return fnet, cnet, ub
+
+
+def build_reference_raft_small(seed: int = 0):
+    """RAFT-small reference modules (reference ``core/raft.py:31-35,
+    :50-53``: hdim 96, cdim 64, SmallEncoder instance/none norms,
+    SmallUpdateBlock, corr radius 3)."""
+    from types import SimpleNamespace
+
+    import extractor_origin
+    import update as ref_update
+
+    torch.manual_seed(seed)
+    fnet = extractor_origin.SmallEncoder(output_dim=128,
+                                         norm_fn="instance",
+                                         dropout=0).eval()
+    cnet = extractor_origin.SmallEncoder(output_dim=96 + 64,
+                                         norm_fn="none", dropout=0).eval()
+    args = SimpleNamespace(corr_levels=4, corr_radius=3)
+    ub = ref_update.SmallUpdateBlock(args, hidden_dim=96).eval()
     return fnet, cnet, ub
