@@ -342,6 +342,16 @@ def validate_golden(predictor: FlowPredictor, root=None,
     ``golden_gt_epe`` (vs the exact synthetic GT — exercises the EPE
     machinery; with the fixture's random weights this is large and only
     meaningful as a regression pin)."""
+    # Guard every entry point (CLI, train --validation): a size-variant
+    # mismatch doesn't crash (flows are full-res either way), it just
+    # logs garbage parity numbers.
+    model_cfg = getattr(predictor.model, "config", None)
+    if model_cfg is not None and hasattr(model_cfg, "small"):
+        if bool(model_cfg.small) != (variant == "small"):
+            raise ValueError(
+                f"golden variant {variant!r} vs model small="
+                f"{model_cfg.small}: the goldens are recorded per model "
+                "size (use golden_small with the small model)")
     root = root or ASSETS_DIR
     fixture = _GoldenFixture(root, variant=variant)
     want = fixture.manifest["iters"]

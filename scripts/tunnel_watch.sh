@@ -1,8 +1,9 @@
 #!/bin/bash
 # Poll the accelerator tunnel; when it answers, run the benchmark suite
 # once and leave the artifacts in the repo root. Safe to leave running —
-# it exits after one SUCCESSFUL capture (a bench failure-JSON doesn't
-# count: the probe loop continues) or after MAX_TRIES probes.
+# it exits after one capture with a numeric headline value (an "error"
+# from a secondary metric doesn't invalidate preserved headline numbers;
+# a capture with "value": null retries) or after MAX_TRIES probes.
 cd "$(dirname "$0")/.."
 MAX_TRIES=${MAX_TRIES:-60}
 SLEEP_S=${SLEEP_S:-600}

@@ -63,7 +63,7 @@ def test_golden_via_cli(capsys):
     assert "Validation Golden[large]: parity EPE" in out
 
 
-def test_golden_small(capsys):
+def test_golden_small():
     """RAFT-small end-to-end golden (BASELINE configs[0]): upflow8
     upsampling path, radius-3 lookups, SmallUpdateBlock — all pinned
     against the stored canonical-torch outputs."""
@@ -82,6 +82,32 @@ def test_golden_small(capsys):
     torch_gt = np.mean([p["epe_vs_gt"]
                         for p in manifest["small"]["pairs"]])
     assert abs(results["golden_small_gt_epe"] - torch_gt) < 1e-2, results
+
+
+def test_golden_alternate_corr():
+    """The memory-efficient on-demand correlation path (BASELINE
+    configs[2], the alt_cuda_corr equivalent) reproduces the same torch
+    goldens as the all-pairs path — same weights, same frames."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"),
+        alternate_corr=True, iters=12)
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 2e-3, results
+
+
+def test_golden_bf16_corr_storage():
+    """--corr_dtype bfloat16 (the HBM-halving lever) stays within a
+    documented accuracy budget of the f32 goldens: the bf16 volume
+    perturbs lookups, so the bound is loose but pinned."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"),
+        corr_dtype="bfloat16", iters=12)
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 0.5, results
 
 
 def test_fixture_frames_are_valid_pairs():
