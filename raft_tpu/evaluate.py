@@ -491,7 +491,8 @@ def main(argv=None):
     parser.add_argument("--small", action="store_true")
     parser.add_argument("--model_family", default="raft",
                         choices=["raft", "sparse", "keypoint_transformer",
-                                 "dual_query", "two_stage"])
+                                 "dual_query", "two_stage",
+                                 "full_transformer"])
     parser.add_argument("--iters", type=int, default=None)
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")

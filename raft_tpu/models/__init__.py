@@ -11,5 +11,5 @@ from raft_tpu.models.relative import (  # noqa: F401
     MultiHeadAttentionLayer, RelativePosition,
     RelativeTransformerDecoderLayer)
 from raft_tpu.models.variants import (  # noqa: F401
-    DualQueryRAFT, KeypointTransformerRAFT, StageEncoder,
-    TwoStageKeypointRAFT)
+    DualQueryRAFT, FullTransformerRAFT, KeypointTransformerRAFT,
+    StageEncoder, TwoStageKeypointRAFT)

@@ -220,6 +220,141 @@ class _ReluMLP(nn.Module):
         return x
 
 
+class FullTransformerRAFT(nn.Module):
+    """The full-``DeformableTransformer`` snapshot (``core/ours_03.py``):
+    three-level CNN pyramids of both images projected to ``d_model``,
+    run through the complete transformer (shared encoder over both
+    pyramids, dense decoder whose queries come from image 1's memory,
+    single-layer "prop" decoder with 50 extra learned queries), then per
+    decoder layer the flow is read in inverse-sigmoid space and a
+    keypoint-propagated variant is formed by two attention hops through
+    the prop-decoder outputs (``ours_03.py:170-228``).  Note the
+    reference's prop output — consumed wholesale here as there — is the
+    DENSE tokens plus the 50 learned queries (``core/deformable.py:180``),
+    so the hop matrix is (S+50, HW) per level: quadratic in tokens, fine
+    at the snapshot's experiment scale, not meant for Sintel-resolution
+    inputs (the live ``SparseRAFT`` is the production shape of this
+    idea).  Per-level maps are upsampled and averaged.
+
+    Returns ``(flow_predictions, corr_predictions)`` — the snapshot
+    stacks the two on a trailing axis (``ours_03.py:211``); two lists
+    carry the same information through the ``train_02``-style loss.
+
+    Deliberate fix: the snapshot scales normalized flows by ``(H, W)``
+    (``ours_03.py:203,:208`` — height applied to x), an axis swap this
+    rebuild corrects via the shared ``_scale_resize``.
+    """
+
+    d_model: int = 64
+    num_feature_levels: int = 3
+    num_encoder_layers: int = 3
+    num_decoder_layers: int = 6
+    dropout: float = 0.1
+    n_heads: int = 8
+    n_points: int = 4
+    mixed_precision: bool = False
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: Optional[int] = None,
+                 flow_init=None, test_mode: bool = False,
+                 train: bool = False, freeze_bn: bool = False):
+        from raft_tpu.models.deformable import DeformableTransformer
+        from raft_tpu.models.sparse_extractor import CNNEncoder
+
+        if flow_init is not None:
+            raise ValueError("snapshot variants do not support warm "
+                             "starting (flow_init)")
+        del iters
+        dtype = jnp.bfloat16 if self.mixed_precision else jnp.float32
+        deterministic = not train
+        B, I_H, I_W, _ = image1.shape
+        Dm, L = self.d_model, self.num_feature_levels
+
+        both = 2.0 * (jnp.concatenate([image1, image2]).astype(dtype)
+                      / 255.0) - 1.0
+        E1, E2 = CNNEncoder(64, "batch", dtype=dtype, name="fnet")(
+            both, train=train and not freeze_bn)
+        E1, E2 = E1[4 - L:], E2[4 - L:]      # channels (128, 192, 256)
+
+        srcs_01, srcs_02, pos_embeds = [], [], []
+        for lvl in range(L):
+            proj = nn.Sequential([
+                nn.Dense(Dm, dtype=dtype),
+                nn.GroupNorm(num_groups=Dm // 2, epsilon=1e-5,
+                             dtype=dtype),
+            ], name=f"input_proj_{lvl}")
+            srcs_01.append(proj(E1[lvl]))
+            srcs_02.append(proj(E2[lvl]))
+            h, w = E1[lvl].shape[1:3]
+            pos_embeds.append(_learned_pos(
+                self, h, w, Dm, f"pos_embed_{lvl}").astype(dtype))
+
+        hs, init_reference, inter_references, prop_hs = \
+            DeformableTransformer(
+                d_model=Dm, n_heads=self.n_heads,
+                num_encoder_layers=self.num_encoder_layers,
+                num_decoder_layers=self.num_decoder_layers,
+                d_ffn=Dm * 4, dropout=self.dropout, activation="relu",
+                return_intermediate_dec=True, num_feature_levels=L,
+                dec_n_points=self.n_points, enc_n_points=self.n_points,
+                dtype=dtype, name="transformer")(
+                srcs_01, srcs_02, pos_embeds,
+                deterministic=deterministic)
+
+        flow_embed = MLP(Dm, 2, 3, dtype=dtype, name="flow_embed")
+        prop_hs_embed = MLP(Dm, Dm, 3, dtype=dtype, name="prop_hs_embed")
+        prop_n_embed = MLP(Dm, Dm, 3, dtype=dtype, name="prop_n_embed")
+
+        # shared across decoder layers, computed once from layer 0
+        # (ours_03.py:175-176); the per-level hop matrices are likewise
+        # layer-invariant — built once, reused by every decoder layer
+        hs_embed = prop_hs_embed(hs[0]).astype(jnp.float32)   # (B, S, c)
+        n_embed = prop_n_embed(prop_hs[0]).astype(jnp.float32)  # (B,S+n,c)
+
+        shapes = [f.shape[1:3] for f in srcs_01]
+        corr_by_level, prev = [], 0
+        for (h, w) in shapes:
+            corr_by_level.append(jnp.einsum(
+                "bnc,bpc->bnp", n_embed,
+                hs_embed[:, prev:prev + h * w]))     # (B, S+n, hw)
+            prev += h * w
+
+        flow_predictions, corr_predictions = [], []
+        for lid in range(hs.shape[0]):
+            tmp = flow_embed(hs[lid]).astype(jnp.float32)
+            reference = (init_reference if lid == 0
+                         else inter_references[lid - 1])
+            reference = reference[..., :2].astype(jnp.float32)
+            flows, corr_flows, prev = [], [], 0
+            for lvl, (h, w) in enumerate(shapes):
+                sl = slice(prev, prev + h * w)
+                ref_sl = reference[:, sl]
+                flow = tmp[:, sl] + inverse_sigmoid(ref_sl)
+                # two attention hops through the prop-decoder outputs
+                corr = corr_by_level[lvl]
+                corr_flow = jnp.einsum(
+                    "bnp,bpk->bnk", corr, jax.lax.stop_gradient(flow))
+                corr_flow = jnp.einsum("bnp,bnk->bpk", corr, corr_flow)
+                init_sl = init_reference[:, sl, :2].astype(jnp.float32)
+                corr_flow = init_sl - nn.sigmoid(corr_flow)
+                corr_flows.append(_scale_resize(
+                    corr_flow.reshape(B, h, w, 2), I_H, I_W))
+                flow = init_sl - nn.sigmoid(flow)
+                flows.append(_scale_resize(
+                    flow.reshape(B, h, w, 2), I_H, I_W))
+                prev += h * w
+            flow_predictions.append(
+                jnp.mean(jnp.stack(flows), axis=0))
+            corr_predictions.append(
+                jnp.mean(jnp.stack(corr_flows), axis=0))
+
+        if test_mode:
+            # the snapshot returns the keypoint-propagated map
+            # (ours_03.py:230: flow_predictions[-1][..., -1])
+            return corr_predictions[-1], corr_predictions[-1]
+        return flow_predictions, corr_predictions
+
+
 class KeypointTransformerRAFT(nn.Module):
     """The vanilla-transformer keypoint snapshot (``core/ours_02.py``).
 

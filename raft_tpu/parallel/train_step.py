@@ -113,7 +113,7 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
                     rngs={"dropout": dropout_rng},
                     mutable=["batch_stats"])
 
-            if tcfg.model_family == "dual_query":
+            if tcfg.model_family in ("dual_query", "full_transformer"):
                 # The two-list snapshot trainer (reference
                 # train_02.py:54-81): flow + corr predictions, each under
                 # a uniformly-weighted masked L1.

@@ -43,7 +43,7 @@ def _eval_variables(state):
 # experiment snapshots (reference core/ours_02/04/06.py lineages, see
 # raft_tpu/models/variants.py).
 MODEL_FAMILIES = ("raft", "sparse", "keypoint_transformer", "dual_query",
-                  "two_stage")
+                  "two_stage", "full_transformer")
 
 
 def build_model(model_family: str, mcfg: RAFTConfig):
@@ -61,6 +61,9 @@ def build_model(model_family: str, mcfg: RAFTConfig):
     if model_family == "two_stage":
         from raft_tpu.models import TwoStageKeypointRAFT
         return TwoStageKeypointRAFT(mixed_precision=mcfg.mixed_precision)
+    if model_family == "full_transformer":
+        from raft_tpu.models import FullTransformerRAFT
+        return FullTransformerRAFT(mixed_precision=mcfg.mixed_precision)
     if model_family == "raft":
         return RAFT(mcfg)
     raise ValueError(f"unknown model_family {model_family!r}; "
@@ -151,7 +154,8 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         if tcfg.model_family == "sparse":
                             flow_preds, sparse_preds = preds
                         elif tcfg.model_family in ("dual_query",
-                                                   "two_stage"):
+                                                   "two_stage",
+                                                   "full_transformer"):
                             # two-list outputs; only the sparse family's
                             # 4-tuples feed the keypoint/mask panels
                             flow_preds, sparse_preds = preds[0], None

@@ -9,8 +9,9 @@ import pytest
 
 from raft_tpu.config import OursConfig
 from raft_tpu.losses import sequence_corr_loss
-from raft_tpu.models import (DualQueryRAFT, KeypointTransformerRAFT,
-                             SparseRAFT, StageEncoder, TwoStageKeypointRAFT)
+from raft_tpu.models import (DualQueryRAFT, FullTransformerRAFT,
+                             KeypointTransformerRAFT, SparseRAFT,
+                             StageEncoder, TwoStageKeypointRAFT)
 
 B, H, W = 1, 64, 96
 
@@ -94,6 +95,23 @@ class TestDualQueryRAFT:
             assert any(float(jnp.abs(x).max()) > 0 for x in g), stack
 
 
+class TestFullTransformerRAFT:
+    def test_two_list_contract_and_test_mode(self, images):
+        img1, img2 = images
+        m = FullTransformerRAFT(d_model=32, num_encoder_layers=1,
+                                num_decoder_layers=2, n_heads=4,
+                                dropout=0.0)
+        v, (flow_preds, corr_preds) = _init_and_apply(m, img1, img2)
+        assert len(flow_preds) == len(corr_preds) == 2  # decoder layers
+        assert flow_preds[-1].shape == (B, H, W, 2)
+        assert bool(jnp.isfinite(flow_preds[-1]).all())
+        assert bool(jnp.isfinite(corr_preds[-1]).all())
+        lo, up = m.apply(v, img1, img2, test_mode=True)
+        # test_mode returns the keypoint-propagated map (ours_03.py:230)
+        np.testing.assert_array_equal(np.asarray(lo),
+                                      np.asarray(corr_preds[-1]))
+
+
 class TestTwoStageKeypointRAFT:
     def test_forward_sparse_contract(self, images):
         img1, img2 = images
@@ -127,6 +145,9 @@ class TestVariantTrainSteps:
         ("dual_query", dict(iterations=2, dropout=0.0), "corr_loss"),
         ("two_stage", dict(base_channel=32, d_model=64, num_queries=9,
                            iterations=2, dropout=0.0), "sparse_loss"),
+        ("full_transformer", dict(d_model=32, num_encoder_layers=1,
+                                  num_decoder_layers=2, n_heads=4,
+                                  dropout=0.0), "corr_loss"),
     ])
     def test_train_step(self, images, family, model_kw, expect_metric):
         from raft_tpu.config import TrainConfig
