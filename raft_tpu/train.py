@@ -25,6 +25,9 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+import signal
+import threading
+
 from raft_tpu import checkpoint as ckpt_lib
 from raft_tpu import evaluate
 from raft_tpu.config import RAFTConfig, TrainConfig
@@ -33,6 +36,60 @@ from raft_tpu.optim import make_schedule
 from raft_tpu.parallel import (create_train_state, make_mesh,
                                make_train_step, shard_batch)
 from raft_tpu.utils.logger import TrainLogger
+
+
+class _PreemptionGuard:
+    """Graceful-preemption handling (TPU pods get SIGTERM'd; the
+    reference's loop has no failure handling at all, SURVEY.md §5).
+
+    While installed, SIGTERM/SIGINT set a flag instead of killing the
+    process; the train loop checks it each step, checkpoints the full
+    state, and returns cleanly — ``--resume`` then continues from the
+    exact step.  A second signal restores default handling (force quit).
+    Only installs from the main thread (signal API requirement); no-ops
+    elsewhere (e.g. pytest workers running train() off-main)."""
+
+    def __init__(self):
+        self.requested = False
+        self._installed = False
+        self._previous = {}
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._previous[sig] = signal.signal(sig, self._handle)
+            self._installed = True
+        return self
+
+    def _handle(self, signum, frame):
+        if self.requested:         # second signal: give up gracefully
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+        print(f"received signal {signum}: finishing step, "
+              "checkpointing, exiting (send again to force quit)",
+              flush=True)
+        self.requested = True
+
+    def __exit__(self, *exc):
+        if self._installed:
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+        return False
+
+
+def _preemption_agreed(requested: bool) -> bool:
+    """Cross-host agreement on the preemption flag.
+
+    On a multi-host pod SIGTERM delivery is per-host and racy: one host
+    diverging into the (collective) checkpoint save while another enters
+    the step's collectives would deadlock the pod.  All hosts therefore
+    vote at the SAME deterministic points (the caller schedules this by
+    step count) and stop iff ANY host saw the signal."""
+    if jax.process_count() == 1:
+        return requested
+    from jax.experimental import multihost_utils
+    return bool(multihost_utils.process_allgather(
+        np.asarray([requested])).any())
 
 
 def _eval_variables(state):
@@ -130,50 +187,65 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
         step_rng = jax.random.fold_in(rng, 1)
         total_steps = int(state.step)
         keep_training = total_steps < tcfg.num_steps
-        while keep_training:
-            for batch in dataloader:
-                batch = shard_batch(batch, mesh)
-                state, metrics = step_fn(state, batch, step_rng)
-                total_steps += 1
-                logger.push(jax.device_get(metrics),
-                            lr=float(schedule(total_steps - 1)))
+        guard = _PreemptionGuard()
+        # Multi-host runs vote on the flag only at deterministic step
+        # counts (a conditional collective would deadlock); single
+        # process checks every step with no collective.
+        check_every = 1 if jax.process_count() == 1 else 10
+        with guard:
+            # the while-condition check also escapes a pathological spin
+            # over an exhausted one-shot dataloader (local flag only; no
+            # collectives run in an empty pass)
+            while keep_training and not guard.requested:
+                for batch in dataloader:
+                    if total_steps % check_every == 0 and \
+                            _preemption_agreed(guard.requested):
+                        ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+                        print(f"preemption checkpoint at step "
+                              f"{total_steps}; resume with --resume")
+                        return state
+                    batch = shard_batch(batch, mesh)
+                    state, metrics = step_fn(state, batch, step_rng)
+                    total_steps += 1
+                    logger.push(jax.device_get(metrics),
+                                lr=float(schedule(total_steps - 1)))
 
-                if total_steps % tcfg.val_freq == 0:
-                    ckpt_lib.save_checkpoint(run_ckpt_dir, state)
-                    # Single-process only: sharded batch/pred arrays span
-                    # non-addressable devices on multi-host meshes and
-                    # device_get would raise there (panels are a debug
-                    # aid, not worth an allgather of full images).
-                    if jax.process_count() == 1:
-                        preds = jax.device_get(panel_fn(
-                            _eval_variables(state), batch["image1"],
-                            batch["image2"]))
-                        i1, i2, fl = jax.device_get(
-                            (batch["image1"], batch["image2"],
-                             batch["flow"]))
-                        if tcfg.model_family == "sparse":
-                            flow_preds, sparse_preds = preds
-                        elif tcfg.model_family in ("dual_query",
-                                                   "two_stage",
-                                                   "full_transformer"):
-                            # two-list outputs; only the sparse family's
-                            # 4-tuples feed the keypoint/mask panels
-                            flow_preds, sparse_preds = preds[0], None
-                        else:
-                            flow_preds, sparse_preds = preds, None
-                        logger.write_images(i1, i2, fl, flow_preds,
-                                            sparse_preds,
-                                            step=total_steps)
-                    if validation:
-                        predictor = evaluate.FlowPredictor(
-                            model, _eval_variables(state), iters=eval_iters)
-                        results = evaluate.run_validation(
-                            predictor, validation)
-                        logger.write_dict(results, step=total_steps)
+                    if total_steps % tcfg.val_freq == 0:
+                        ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+                        # Single-process only: sharded batch/pred arrays span
+                        # non-addressable devices on multi-host meshes and
+                        # device_get would raise there (panels are a debug
+                        # aid, not worth an allgather of full images).
+                        if jax.process_count() == 1:
+                            preds = jax.device_get(panel_fn(
+                                _eval_variables(state), batch["image1"],
+                                batch["image2"]))
+                            i1, i2, fl = jax.device_get(
+                                (batch["image1"], batch["image2"],
+                                 batch["flow"]))
+                            if tcfg.model_family == "sparse":
+                                flow_preds, sparse_preds = preds
+                            elif tcfg.model_family in ("dual_query",
+                                                       "two_stage",
+                                                       "full_transformer"):
+                                # two-list outputs; only the sparse family's
+                                # 4-tuples feed the keypoint/mask panels
+                                flow_preds, sparse_preds = preds[0], None
+                            else:
+                                flow_preds, sparse_preds = preds, None
+                            logger.write_images(i1, i2, fl, flow_preds,
+                                                sparse_preds,
+                                                step=total_steps)
+                        if validation:
+                            predictor = evaluate.FlowPredictor(
+                                model, _eval_variables(state), iters=eval_iters)
+                            results = evaluate.run_validation(
+                                predictor, validation)
+                            logger.write_dict(results, step=total_steps)
 
-                if total_steps >= tcfg.num_steps:
-                    keep_training = False
-                    break
+                    if total_steps >= tcfg.num_steps:
+                        keep_training = False
+                        break
 
         ckpt_lib.save_checkpoint(run_ckpt_dir, state)
     return state

@@ -115,6 +115,79 @@ def test_train_logger_writes_jsonl(tmp_path):
     assert lines[1]["val_epe"] == 5.0
 
 
+def test_preemption_checkpoints_and_resumes(tmp_path):
+    """A preemption signal mid-run checkpoints the exact step and exits
+    cleanly; --resume continues from there (the reference's loop dies
+    with nothing saved, SURVEY.md §5)."""
+    from raft_tpu.train import _PreemptionGuard, train
+
+    tcfg, mcfg = _tiny_setup(tmp_path, num_steps=50)
+
+    class PreemptingLoader(SyntheticLoader):
+        """Requests preemption after the second batch, the way a SIGTERM
+        arriving mid-step would (the guard flag is checked per step;
+        setting it directly keeps the test signal-free and thread-safe).
+        """
+
+        def __init__(self, guard_box, **kw):
+            super().__init__(**kw)
+            self.guard_box = guard_box
+            self.count = 0
+
+        def __iter__(self):
+            for batch in super().__iter__():
+                self.count += 1
+                if self.count == 3:
+                    self.guard_box[0].requested = True
+                yield batch
+
+    # intercept the guard the loop creates
+    import dataclasses
+
+    import raft_tpu.train as train_mod
+    box = [None]
+
+    class SpyGuard(train_mod._PreemptionGuard):
+        def __init__(self):
+            super().__init__()
+            box[0] = self
+
+    monkeypatch = pytest.MonkeyPatch()
+    with monkeypatch.context() as mp:
+        mp.setattr(train_mod, "_PreemptionGuard", SpyGuard)
+        state = train(tcfg, mcfg, ckpt_dir=str(tmp_path / "ckpts"),
+                      log_dir=str(tmp_path / "logs"),
+                      dataloader=PreemptingLoader(box, n=50),
+                      logger=TrainLogger(str(tmp_path / "logs" / "t"),
+                                         sum_freq=2, tensorboard=False))
+    assert int(state.step) == 2          # preempted before batch 3 ran
+    assert ckpt_lib.latest_step(str(tmp_path / "ckpts" / "t")) == 2
+
+    # resume completes to num_steps without re-running saved steps
+    tcfg2 = dataclasses.replace(tcfg, num_steps=4)
+    state2 = train(tcfg2, mcfg, ckpt_dir=str(tmp_path / "ckpts"),
+                   log_dir=str(tmp_path / "logs"),
+                   dataloader=SyntheticLoader(), resume=True,
+                   logger=TrainLogger(str(tmp_path / "logs" / "t"),
+                                      sum_freq=2, tensorboard=False))
+    assert int(state2.step) == 4
+
+
+def test_preemption_guard_signal_handling():
+    """The guard flips its flag on SIGTERM from the main thread and
+    restores previous handlers on exit."""
+    import signal
+
+    from raft_tpu.train import _PreemptionGuard
+
+    before = signal.getsignal(signal.SIGTERM)
+    with _PreemptionGuard() as guard:
+        assert not guard.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.requested
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
 def test_train_loop_end_to_end(tmp_path):
     from raft_tpu.train import train
 
