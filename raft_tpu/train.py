@@ -10,6 +10,8 @@ loader. Flags mirror reference ``train.py:431-452``; stage schedules mirror
 Improvements over the reference, kept explicit:
   * true resume (``--resume``): step/optimizer/BN state round-trip through
     orbax (the reference restarts the schedule every stage);
+  * graceful preemption: SIGTERM/SIGINT checkpoint the exact step and
+    exit cleanly, multi-host-safe (:class:`_PreemptionGuard`);
   * validation runs through the shape-bucketed jitted
     :class:`raft_tpu.evaluate.FlowPredictor`;
   * scalars stream to JSONL (+ TensorBoard when available).
