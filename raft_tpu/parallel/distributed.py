@@ -28,8 +28,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
     explicit args cover the env-var path (``COORDINATOR_ADDRESS`` etc.) the
     way the reference read ``RANK``/``WORLD_SIZE``. Safe to call on a
     single host (no-op).
+
+    The already-initialized check must NOT touch ``jax.process_count()``
+    (or any device API): that would initialize the XLA backend first and
+    make ``jax.distributed.initialize`` unconditionally fail — the
+    coordinator client state is inspected instead.
     """
-    if jax.process_count() > 1:
+    if jax.distributed.is_initialized():
         return  # already initialized
     env = os.environ
     if coordinator_address is None:

@@ -1,0 +1,94 @@
+"""REAL multi-process distributed tests: two coordinated interpreters.
+
+The sharding suite runs SPMD semantics on one process with 8 virtual
+devices; these tests additionally prove the *multi-host* machinery —
+``jax.distributed`` bootstrap, rank gating, cross-process metric
+reduction, and the train loop's preemption vote — against two actual
+processes wired through a coordinator, the way a TPU pod runs
+(reference's dormant NCCL/DDP scaffolding, ``core/utils/misc.py:366-460``,
+never had any test at all, SURVEY.md §4.5).
+
+Each child pins the CPU backend with ONE device per process (clearing
+any inherited XLA_FLAGS/topology from the outer pytest) and reports
+results as a JSON line; the parent asserts on both.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ""          # drop inherited topology flags
+    os.environ["COORDINATOR_ADDRESS"] = "localhost:%(port)d"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1])
+
+    from raft_tpu.parallel.distributed import (init_distributed,
+                                               is_main_process,
+                                               reduce_metrics)
+    init_distributed(num_processes=2, process_id=pid)
+    from raft_tpu.train import _preemption_agreed
+
+    out = {
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "is_main": is_main_process(),
+        # each process contributes a different loss; mean must be 2.0
+        "reduced": reduce_metrics({"loss": 1.0 + 2.0 * pid}),
+        # only process 1 saw the (simulated) SIGTERM; BOTH must agree
+        "agreed": _preemption_agreed(pid == 1),
+        "agreed_none": _preemption_agreed(False),
+    }
+    print("RESULT " + json.dumps(out), flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_helpers():
+    child_env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    code = CHILD % {"port": _free_port()}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=child_env)
+        for i in range(2)]
+    results = {}
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed child timed out (coordinator hang?)")
+        assert p.returncode == 0, out[-2000:]
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        r = json.loads(line[len("RESULT "):])
+        results[r["pid"]] = r
+
+    assert set(results) == {0, 1}
+    for pid, r in results.items():
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 1
+        assert r["is_main"] == (pid == 0)
+        # cross-process mean of (1.0, 3.0)
+        assert abs(r["reduced"]["loss"] - 2.0) < 1e-6
+        # preemption vote: one host's signal stops both; quiet == go on
+        assert r["agreed"] is True
+        assert r["agreed_none"] is False
