@@ -3,14 +3,17 @@
 The sharding suite runs SPMD semantics on one process with 8 virtual
 devices; these tests additionally prove the *multi-host* machinery —
 ``jax.distributed`` bootstrap, rank gating, cross-process metric
-reduction, and the train loop's preemption vote — against two actual
-processes wired through a coordinator, the way a TPU pod runs
-(reference's dormant NCCL/DDP scaffolding, ``core/utils/misc.py:366-460``,
-never had any test at all, SURVEY.md §4.5).
+reduction, the train loop's preemption vote, and a full sharded train
+step with the batch split across hosts — against two actual processes
+wired through a coordinator, the way a TPU pod runs (the reference's
+dormant NCCL/DDP scaffolding, ``core/utils/misc.py:366-460``, never had
+any test at all, SURVEY.md §4.5).
 
 Each child pins the CPU backend with ONE device per process (clearing
 any inherited XLA_FLAGS/topology from the outer pytest) and reports
-results as a JSON line; the parent asserts on both.
+results as a JSON line; the parent asserts on both.  The train-step
+fixture (:func:`make_train_fixture`) is imported by the parent AND the
+child code strings so their configs cannot drift.
 """
 
 import json
@@ -23,8 +26,9 @@ import textwrap
 import pytest
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
-CHILD = textwrap.dedent("""
+_PRELUDE = textwrap.dedent("""
     import json, os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = ""          # drop inherited topology flags
@@ -32,7 +36,9 @@ CHILD = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")
     pid = int(sys.argv[1])
+""")
 
+CHILD_HELPERS = _PRELUDE + textwrap.dedent("""
     from raft_tpu.parallel.distributed import (init_distributed,
                                                is_main_process,
                                                reduce_metrics)
@@ -53,6 +59,66 @@ CHILD = textwrap.dedent("""
     print("RESULT " + json.dumps(out), flush=True)
 """)
 
+CHILD_TRAIN = _PRELUDE + textwrap.dedent("""
+    from raft_tpu.parallel.distributed import init_distributed
+    init_distributed(num_processes=2, process_id=pid)
+
+    from raft_tpu.parallel import make_mesh
+    from test_multiprocess import run_one_step
+
+    mesh = make_mesh()                      # 2 global devices, 1/process
+    assert mesh.devices.size == 2, mesh.devices
+    with mesh:
+        state2, metrics = run_one_step(mesh=mesh)
+    out = {"pid": pid, "loss": float(metrics["loss"]),
+           "grad_norm": float(metrics["grad_norm"]),
+           "step": int(state2.step)}
+    print("RESULT " + json.dumps(out), flush=True)
+""")
+
+
+def make_train_fixture():
+    """Shared tiny train setup: identical for the single-process ground
+    truth and every distributed child (same seeds, same batch)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 64, 96
+    tcfg = TrainConfig(batch_size=2, image_size=(H, W), num_steps=10,
+                       iters=2)
+    model = RAFT(RAFTConfig(small=True, iters=2))
+    g = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(g.uniform(0, 255, (2, H, W, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(g.uniform(0, 255, (2, H, W, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(g.normal(size=(2, H, W, 2)) * 2, jnp.float32),
+        "valid": jnp.ones((2, H, W), jnp.float32),
+    }
+    return tcfg, model, batch, (H, W)
+
+
+def run_one_step(mesh=None):
+    """One jitted train step of the shared fixture, optionally sharded."""
+    import jax
+
+    from raft_tpu.parallel import (create_train_state, make_train_step,
+                                   shard_batch)
+
+    tcfg, model, batch, shape = make_train_fixture()
+    state = create_train_state(jax.random.PRNGKey(0), model, tcfg, shape,
+                               mesh=mesh)
+    step_fn = make_train_step(tcfg, mesh=mesh, donate=False)
+    if mesh is not None:
+        batch = shard_batch(batch, mesh)
+    state2, metrics = step_fn(state, batch, jax.random.PRNGKey(1))
+    jax.block_until_ready(metrics)
+    return state2, metrics
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -60,9 +126,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_helpers():
-    child_env = {**os.environ, "PYTHONPATH": REPO_ROOT}
-    code = CHILD % {"port": _free_port()}
+def _run_children(template: str, timeout: int):
+    """Spawn two coordinated children from ``template``, return their
+    RESULT dicts keyed by pid."""
+    child_env = {**os.environ,
+                 "PYTHONPATH": os.pathsep.join([REPO_ROOT, TESTS_DIR])}
+    code = template % {"port": _free_port()}
     procs = [subprocess.Popen(
         [sys.executable, "-c", code, str(i)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -71,18 +140,23 @@ def test_two_process_distributed_helpers():
     results = {}
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail("distributed child timed out (coordinator hang?)")
         assert p.returncode == 0, out[-2000:]
-        line = [ln for ln in out.splitlines()
-                if ln.startswith("RESULT ")][-1]
-        r = json.loads(line[len("RESULT "):])
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("RESULT ")]
+        assert lines, f"no RESULT line in child output:\n{out[-2000:]}"
+        r = json.loads(lines[-1][len("RESULT "):])
         results[r["pid"]] = r
-
     assert set(results) == {0, 1}
+    return results
+
+
+def test_two_process_distributed_helpers():
+    results = _run_children(CHILD_HELPERS, timeout=300)
     for pid, r in results.items():
         assert r["process_count"] == 2
         assert r["local_devices"] == 1
@@ -92,3 +166,20 @@ def test_two_process_distributed_helpers():
         # preemption vote: one host's signal stops both; quiet == go on
         assert r["agreed"] is True
         assert r["agreed_none"] is False
+
+
+def test_two_process_sharded_train_step():
+    """One jitted train step over a 2-process global mesh (1 device per
+    process, batch sharded across hosts) — THE multi-host scaling path.
+    Both hosts must agree on the loss, and it must match a single-process
+    run of the same step to float tolerance."""
+    import numpy as np
+
+    results = _run_children(CHILD_TRAIN, timeout=420)
+    assert results[0]["step"] == results[1]["step"] == 1
+    # replicated metrics: both hosts computed the same global loss
+    assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
+
+    _, m_single = run_one_step(mesh=None)
+    np.testing.assert_allclose(results[0]["loss"],
+                               float(m_single["loss"]), rtol=2e-4)
