@@ -16,7 +16,8 @@ from glob import glob
 import numpy as np
 from PIL import Image
 
-from raft_tpu.evaluate import load_predictor
+from raft_tpu.evaluate import (ASSETS_DIR, load_predictor,
+                               reject_raft_only_flags)
 from raft_tpu.utils.flow_viz import flow_to_image
 from raft_tpu.utils.padder import InputPadder
 
@@ -25,7 +26,9 @@ def demo(args) -> None:
     predictor = load_predictor(args.model, small=args.small,
                                alternate_corr=args.alternate_corr,
                                mixed_precision=args.mixed_precision,
-                               iters=args.iters)
+                               iters=args.iters,
+                               model_family=args.model_family,
+                               corr_dtype=args.corr_dtype)
     os.makedirs(args.out, exist_ok=True)
 
     images = sorted(glob(osp.join(args.path, "*.png"))
@@ -65,14 +68,24 @@ def main(argv=None):
                         help="directory of ordered frames (default: the "
                              "repo-owned assets/demo-frames fixtures)")
     parser.add_argument("--out", default="demo_out")
+    parser.add_argument("--model_family", default="raft",
+                        choices=["raft", "sparse", "keypoint_transformer",
+                                 "dual_query", "two_stage",
+                                 "full_transformer"])
     parser.add_argument("--small", action="store_true")
-    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--iters", type=int, default=None,
+                        help="refinement iterations (canonical RAFT "
+                             "only; default 20, reference demo.py:62)")
     parser.add_argument("--alternate_corr", action="store_true")
+    parser.add_argument("--corr_dtype", default="float32",
+                        choices=["float32", "bfloat16", "auto"])
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--show", action="store_true")
     args = parser.parse_args(argv)
+    reject_raft_only_flags(parser, args)
+    if args.iters is None:
+        args.iters = 20          # reference demo.py:62
     if args.path is None:
-        from raft_tpu.evaluate import ASSETS_DIR
         args.path = osp.join(ASSETS_DIR, "demo-frames")
     demo(args)
 

@@ -463,9 +463,11 @@ def _raft_only_selections(small, alternate_corr, corr_dtype):
 
 
 def reject_raft_only_flags(parser, args) -> None:
-    """Upfront CLI validation shared by train.py and evaluate.py: flags
-    that only configure the canonical RAFT family must not be silently
-    dropped when another family builds from its own config."""
+    """Upfront CLI validation shared by train.py, evaluate.py and
+    demo.py: flags that only configure the canonical RAFT family must
+    not be silently dropped when another family builds from its own
+    config.  ``--iters`` (``default=None`` in every CLI) is included —
+    every non-raft family fixes its iteration count architecturally."""
     if args.model_family == "raft":
         return
     for name, on in _raft_only_selections(args.small, args.alternate_corr,
@@ -474,6 +476,10 @@ def reject_raft_only_flags(parser, args) -> None:
             parser.error(f"--{name} applies to the canonical RAFT family "
                          f"only (the {args.model_family} family has no "
                          "small variant and fixed corr semantics)")
+    if getattr(args, "iters", None) is not None:
+        parser.error("--iters applies to the canonical RAFT family only "
+                     f"(the {args.model_family} family's iteration count "
+                     "is fixed by its architecture)")
 
 
 def main(argv=None):
@@ -522,12 +528,7 @@ def main(argv=None):
         parser.error("--warm_start requires the canonical RAFT family "
                      f"(the {args.model_family} family does not support "
                      "flow_init)")
-    if args.model_family != "raft" and args.iters is not None:
-        # every non-raft family fixes its iteration count architecturally
-        parser.error("--iters applies to the canonical RAFT family only "
-                     f"(the {args.model_family} family's iteration count "
-                     "is fixed by its architecture)")
-    reject_raft_only_flags(parser, args)
+    reject_raft_only_flags(parser, args)   # incl. --iters
     iters = args.iters or default_iters[args.dataset]
     predictor = load_predictor(args.model, small=args.small,
                                alternate_corr=args.alternate_corr,

@@ -308,14 +308,8 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=2022)
     args = parser.parse_args(argv)
 
-    evaluate.reject_raft_only_flags(parser, args)
-    # No silently-dropped flags: every non-raft family fixes its
-    # iteration count architecturally (the snapshots' `del iters`), and
-    # only the keypoint families consume the auxiliary sparse loss.
-    if args.iters is not None and args.model_family != "raft":
-        parser.error(f"--iters applies to the canonical RAFT family only "
-                     f"(the {args.model_family} family's iteration count "
-                     "is fixed by its architecture)")
+    evaluate.reject_raft_only_flags(parser, args)   # incl. --iters
+    # only the keypoint families consume the auxiliary sparse loss
     if args.sparse_lambda > 0 and args.model_family not in ("sparse",
                                                             "two_stage"):
         parser.error("--sparse_lambda requires a keypoint family "
