@@ -44,10 +44,16 @@ class FlowPredictor:
     """
 
     def __init__(self, model, variables, iters: int = 32,
-                 batch_size: Optional[int] = None):
+                 batch_size: Optional[int] = None, mesh=None):
         self.model = model
         self.variables = variables
         self.iters = iters
+        # Optional sequence(spatial)-parallel execution: with a mesh the
+        # forward runs through parallel.spatial.spatial_jit — image rows
+        # sharded over the mesh's spatial axis, each device holding 1/d
+        # of every activation and of the (HW)^2 correlation volume (the
+        # multi-chip high-resolution eval path, BASELINE configs[4]).
+        self.mesh = mesh
         # Batched eval is the TPU operating point (amortizes per-dispatch
         # overhead and fills the MXU); single-sample on CPU where compile
         # time dominates.
@@ -61,12 +67,38 @@ class FlowPredictor:
     def _fn(self, shape, warm: bool) -> Callable:
         key = (shape, warm, self.iters)
         if key not in self._cache:
-            def run(variables, image1, image2, flow_init=None):
-                return self.model.apply(
-                    variables, image1, image2, iters=self.iters,
-                    flow_init=flow_init, test_mode=True)
+            if self.mesh is not None:
+                if warm:
+                    raise ValueError(
+                        "warm start (flow_init) is not supported with "
+                        "spatially-sharded eval — the init flow would "
+                        "need its own sharding spec")
+                from raft_tpu.parallel.mesh import SPATIAL_AXIS
+                n_sp = self.mesh.shape[SPATIAL_AXIS]
+                rows = shape[1]
+                if rows % n_sp:
+                    raise ValueError(
+                        f"spatially-sharded eval needs the padded image "
+                        f"height ({rows}) divisible by spatial_shards "
+                        f"({n_sp}); pick a divisor of the padded height "
+                        "(InputPadder pads to /8)")
+                from raft_tpu.parallel.spatial import spatial_jit
 
-            self._cache[key] = jax.jit(run)
+                def run(variables, image1, image2):
+                    return self.model.apply(
+                        variables, image1, image2, iters=self.iters,
+                        test_mode=True)
+
+                sharded = spatial_jit(run, self.mesh)
+                self._cache[key] = (
+                    lambda v, i1, i2, init=None: sharded(v, i1, i2))
+            else:
+                def run(variables, image1, image2, flow_init=None):
+                    return self.model.apply(
+                        variables, image1, image2, iters=self.iters,
+                        flow_init=flow_init, test_mode=True)
+
+                self._cache[key] = jax.jit(run)
         return self._cache[key]
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
@@ -397,7 +429,8 @@ def load_predictor(model_path: str, small: bool = False,
                    mixed_precision: bool = False,
                    iters: int = 32,
                    model_family: str = "raft",
-                   corr_dtype: str = "float32") -> FlowPredictor:
+                   corr_dtype: str = "float32",
+                   spatial_shards: int = 1) -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
     (the reference ``evaluate.py:312-313`` model-loading path).
@@ -432,12 +465,30 @@ def load_predictor(model_path: str, small: bool = False,
                          mixed_precision=mixed_precision,
                          corr_dtype=corr_dtype)
         model = RAFT(cfg)
+
+    mesh = None
+    if spatial_shards > 1:
+        # sequence(spatial)-parallel eval: image rows over this many
+        # chips (canonical family only — token-flattened families
+        # partition pathologically over the spatial axis)
+        if model_family != "raft":
+            raise ValueError(
+                "spatial sharding supports the canonical RAFT family "
+                f"only (got model_family={model_family!r})")
+        if len(jax.devices()) < spatial_shards:
+            raise ValueError(
+                f"spatial_shards={spatial_shards} needs that many "
+                f"devices, have {len(jax.devices())}")
+        from raft_tpu.parallel import make_mesh
+        mesh = make_mesh(n_data=1, n_spatial=spatial_shards,
+                         devices=jax.devices()[:spatial_shards])
+
     if model_path == "random":
         rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
         variables = model.init({"params": rng, "dropout": rng},
                                dummy, dummy, iters=1)
-        return FlowPredictor(model, variables, iters=iters)
+        return FlowPredictor(model, variables, iters=iters, mesh=mesh)
     if model_path.endswith(".npz"):
         # torch-keyed npz archive (e.g. assets/golden/weights.npz) —
         # conversion without needing torch installed
@@ -446,12 +497,12 @@ def load_predictor(model_path: str, small: bool = False,
         state = {k: np.asarray(v, np.float32)
                  for k, v in np.load(model_path).items()}
         variables = convert_state_dict(state)
-        return FlowPredictor(model, variables, iters=iters)
+        return FlowPredictor(model, variables, iters=iters, mesh=mesh)
     params, batch_stats = ckpt_lib.load_params(model_path)
     variables = {"params": params}
     if batch_stats:
         variables["batch_stats"] = batch_stats
-    return FlowPredictor(model, variables, iters=iters)
+    return FlowPredictor(model, variables, iters=iters, mesh=mesh)
 
 
 def _raft_only_selections(small, alternate_corr, corr_dtype):
@@ -508,6 +559,13 @@ def main(argv=None):
                         help="storage dtype of the correlation pyramid "
                              "(float32 = reference autocast semantics; "
                              "bfloat16 halves its HBM footprint)")
+    parser.add_argument("--spatial_shards", type=int, default=1,
+                        help="shard image rows over this many chips "
+                             "(sequence-parallel eval for resolutions "
+                             "whose correlation volume exceeds one "
+                             "chip's HBM; canonical family only; must "
+                             "divide the padded image height, and is "
+                             "incompatible with --warm_start)")
     parser.add_argument("--data_root", default=None)
     parser.add_argument("--output_path", default=None)
     args = parser.parse_args(argv)
@@ -524,6 +582,9 @@ def main(argv=None):
     if args.dataset == "golden" and args.small:
         parser.error("--dataset golden compares against RAFT-large "
                      "goldens; use --dataset golden_small for --small")
+    if args.warm_start and args.spatial_shards > 1:
+        parser.error("--warm_start is incompatible with --spatial_shards "
+                     "(the init flow would need its own sharding spec)")
     if args.model_family != "raft" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
                      f"(the {args.model_family} family does not support "
@@ -535,7 +596,8 @@ def main(argv=None):
                                mixed_precision=args.mixed_precision,
                                iters=iters,
                                model_family=args.model_family,
-                               corr_dtype=args.corr_dtype)
+                               corr_dtype=args.corr_dtype,
+                               spatial_shards=args.spatial_shards)
     if args.dataset == "sintel_submission":
         create_sintel_submission(
             predictor, warm_start=args.warm_start,

@@ -110,6 +110,26 @@ def test_golden_bf16_corr_storage():
     assert results["golden_parity_epe"] < 0.5, results
 
 
+def test_golden_spatial_sharded():
+    """Sequence-parallel eval (--spatial_shards: image rows over the
+    8-device mesh, XLA-inserted halo exchanges and collectives through
+    the WHOLE model) reproduces the same torch goldens."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"),
+        iters=12, spatial_shards=8)
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 2e-3, results
+
+
+def test_spatial_shards_rejects_other_families():
+    from raft_tpu.evaluate import load_predictor
+
+    with pytest.raises(ValueError, match="canonical RAFT family"):
+        load_predictor("random", model_family="sparse", spatial_shards=8)
+
+
 def test_fixture_frames_are_valid_pairs():
     """Frames exist, are /8-sized, and GT flow matches the warp spec
     (finite, small-magnitude, exactly affine ⇒ flow field's second
