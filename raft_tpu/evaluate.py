@@ -470,16 +470,12 @@ def load_predictor(model_path: str, small: bool = False,
     if spatial_shards > 1:
         # sequence(spatial)-parallel eval: image rows over this many
         # chips (canonical family only — token-flattened families
-        # partition pathologically over the spatial axis)
-        if model_family != "raft":
-            raise ValueError(
-                "spatial sharding supports the canonical RAFT family "
-                f"only (got model_family={model_family!r})")
-        if len(jax.devices()) < spatial_shards:
-            raise ValueError(
-                f"spatial_shards={spatial_shards} needs that many "
-                f"devices, have {len(jax.devices())}")
+        # partition pathologically over the spatial axis); the padded
+        # height isn't known until the first frame, so divisibility is
+        # checked per-shape in FlowPredictor._fn
         from raft_tpu.parallel import make_mesh
+        from raft_tpu.parallel.mesh import validate_spatial_shards
+        validate_spatial_shards(spatial_shards, model_family)
         mesh = make_mesh(n_data=1, n_spatial=spatial_shards,
                          devices=jax.devices()[:spatial_shards])
 

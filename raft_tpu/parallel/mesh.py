@@ -41,6 +41,37 @@ def make_mesh(n_data: Optional[int] = None, n_spatial: int = 1,
     return Mesh(arr, (DATA_AXIS, SPATIAL_AXIS))
 
 
+def validate_spatial_shards(spatial_shards: int, model_family: str,
+                            image_height: Optional[int] = None) -> None:
+    """Shared upfront validation for the ``spatial_shards`` options of
+    train/evaluate: one place for the contract so wording and rules
+    cannot drift.
+
+    ``image_height`` (when known upfront, e.g. the training crop) must
+    divide by the shard count — otherwise ``shard_batch`` silently falls
+    back to data-only sharding and every mesh column redundantly
+    computes full rows."""
+    if spatial_shards < 1:
+        raise ValueError(
+            f"spatial_shards must be >= 1 (got {spatial_shards})")
+    if spatial_shards == 1:
+        return
+    if model_family != "raft":
+        raise ValueError(
+            "spatial sharding supports the canonical RAFT family only "
+            f"(got model_family={model_family!r})")
+    n_dev = len(jax.devices())
+    if n_dev < spatial_shards or n_dev % spatial_shards:
+        raise ValueError(
+            f"spatial_shards={spatial_shards} must divide the device "
+            f"count ({n_dev})")
+    if image_height is not None and image_height % spatial_shards:
+        raise ValueError(
+            f"image height {image_height} is not divisible by "
+            f"spatial_shards={spatial_shards}; rows could not be "
+            "sharded (pick a divisor of the padded height)")
+
+
 def batch_spec() -> P:
     """PartitionSpec for batch-leading arrays: shard dim 0 over data."""
     return P(DATA_AXIS)

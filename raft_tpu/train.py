@@ -138,16 +138,23 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
           validation: Sequence[str] = (),
           dataloader=None,
           logger: Optional[TrainLogger] = None,
-          eval_iters: int = 32):
+          eval_iters: int = 32,
+          spatial_shards: int = 1):
     """Run one training stage; returns the final train state.
 
     ``dataloader`` may be injected (tests); by default it is built from
     ``tcfg.stage`` (reference ``datasets.fetch_dataloader``).
+    ``spatial_shards`` > 1 splits image rows over that many mesh columns
+    (sequence parallelism; canonical family only — the 2-D data x
+    spatial step is what ``dryrun_multichip`` validates).
     """
     rng = jax.random.PRNGKey(tcfg.seed)
     np.random.seed(tcfg.seed)                 # host-side aug reproducibility
 
-    mesh = make_mesh()
+    from raft_tpu.parallel.mesh import validate_spatial_shards
+    validate_spatial_shards(spatial_shards, tcfg.model_family,
+                            image_height=tcfg.image_size[0])
+    mesh = make_mesh(n_spatial=spatial_shards)
     model = build_model(tcfg.model_family, mcfg)
     run_ckpt_dir = os.path.join(ckpt_dir, tcfg.name)
 
@@ -301,6 +308,11 @@ def main(argv=None):
                              "bfloat16 halves its HBM footprint)")
     parser.add_argument("--scheduler", default="onecycle",
                         choices=["onecycle", "step", "cosine_warmup"])
+    parser.add_argument("--spatial_shards", type=int, default=1,
+                        help="split image rows over this many mesh "
+                             "columns (sequence-parallel training; "
+                             "canonical family only, must divide the "
+                             "device count and the image height)")
     parser.add_argument("--val_freq", type=int, default=5000)
     parser.add_argument("--data_root", default=None)
     parser.add_argument("--ckpt_dir", default="checkpoints")
@@ -334,7 +346,8 @@ def main(argv=None):
     t0 = time.time()
     train(tcfg, mcfg, data_root=args.data_root, ckpt_dir=args.ckpt_dir,
           log_dir=args.log_dir, restore_ckpt=args.restore_ckpt,
-          resume=args.resume, validation=args.validation)
+          resume=args.resume, validation=args.validation,
+          spatial_shards=args.spatial_shards)
     print(f"done in {time.time() - t0:.1f}s")
 
 
