@@ -138,6 +138,13 @@ class OursConfig:
             4 - self.num_feature_levels:]
 
 
+# Trainable/evaluable model families: the two live ones plus the rebuilt
+# experiment snapshots (reference core/ours_02/03/04/06.py lineages —
+# raft_tpu/models/variants.py). Single source for every CLI's choices.
+MODEL_FAMILIES = ("raft", "sparse", "keypoint_transformer", "dual_query",
+                  "two_stage", "full_transformer")
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training hyperparameters (reference ``train.py:431-452`` flags and

@@ -32,7 +32,7 @@ import threading
 
 from raft_tpu import checkpoint as ckpt_lib
 from raft_tpu import evaluate
-from raft_tpu.config import RAFTConfig, TrainConfig
+from raft_tpu.config import MODEL_FAMILIES, RAFTConfig, TrainConfig
 from raft_tpu.models.raft import RAFT
 from raft_tpu.optim import make_schedule
 from raft_tpu.parallel import (create_train_state, make_mesh,
@@ -96,13 +96,6 @@ def _preemption_agreed(requested: bool) -> bool:
 
 def _eval_variables(state):
     return {"params": state.params, "batch_stats": state.batch_stats}
-
-
-# Trainable model families: the two live ones plus the rebuilt
-# experiment snapshots (reference core/ours_02/04/06.py lineages, see
-# raft_tpu/models/variants.py).
-MODEL_FAMILIES = ("raft", "sparse", "keypoint_transformer", "dual_query",
-                  "two_stage", "full_transformer")
 
 
 def build_model(model_family: str, mcfg: RAFTConfig):

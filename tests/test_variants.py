@@ -139,25 +139,28 @@ class TestVariantTrainSteps:
     """Each rebuilt snapshot trains end-to-end through the shared jitted
     step (its loss contract dispatched by ``TrainConfig.model_family``)."""
 
-    @pytest.mark.parametrize("family,model_kw,expect_metric", [
-        ("keypoint_transformer",
+    @pytest.mark.parametrize("family,model_cls,model_kw,expect_metric", [
+        ("keypoint_transformer", KeypointTransformerRAFT,
          dict(num_queries=9, iterations=2, dropout=0.0), "epe"),
-        ("dual_query", dict(iterations=2, dropout=0.0), "corr_loss"),
-        ("two_stage", dict(base_channel=32, d_model=64, num_queries=9,
-                           iterations=2, dropout=0.0), "sparse_loss"),
-        ("full_transformer", dict(d_model=32, num_encoder_layers=1,
-                                  num_decoder_layers=2, n_heads=4,
-                                  dropout=0.0), "corr_loss"),
+        ("dual_query", DualQueryRAFT,
+         dict(iterations=2, dropout=0.0), "corr_loss"),
+        ("two_stage", TwoStageKeypointRAFT,
+         dict(base_channel=32, d_model=64, num_queries=9,
+              iterations=2, dropout=0.0), "sparse_loss"),
+        ("full_transformer", FullTransformerRAFT,
+         dict(d_model=32, num_encoder_layers=1, num_decoder_layers=2,
+              n_heads=4, dropout=0.0), "corr_loss"),
     ])
-    def test_train_step(self, images, family, model_kw, expect_metric):
-        from raft_tpu.config import TrainConfig
+    def test_train_step(self, images, family, model_cls, model_kw,
+                        expect_metric):
+        from raft_tpu.config import RAFTConfig, TrainConfig
         from raft_tpu.parallel import create_train_state, make_train_step
         from raft_tpu.train import build_model
-        from raft_tpu.config import RAFTConfig
 
-        model = build_model(family, RAFTConfig())
-        # swap in the tiny test-sized model of the same family
-        model = type(model)(**model_kw)
+        # pin the family-string → class dispatch...
+        assert type(build_model(family, RAFTConfig())) is model_cls
+        # ...then train a tiny test-sized instance of that class
+        model = model_cls(**model_kw)
 
         tcfg = TrainConfig(model_family=family, batch_size=B,
                            image_size=(H, W), num_steps=10, iters=2,
