@@ -208,10 +208,11 @@ def main():
         flow_up = model.apply(variables, i1, i2, test_mode=True)[1]
         return flow_up, jnp.sum(flow_up)
 
-    def throughput(batch: int) -> float:
+    def throughput(batch: int, fwd_fn=None) -> float:
+        fwd_fn = fwd_fn or fwd
         img = jnp.broadcast_to(img1, (batch, H, W, 3))
         for _ in range(WARMUP):
-            float(fwd(img, img)[1])
+            float(fwd_fn(img, img)[1])
         # Dispatch all reps, sync once — measures device pipeline rate
         # (how eval/training actually stream batches), not the host↔device
         # round-trip latency of a lone request.
@@ -220,7 +221,7 @@ def main():
         # buffers are freed as they complete instead of 10 being pinned.
         t0 = time.perf_counter()
         for _ in range(REPS):
-            out = fwd(img, img)
+            out = fwd_fn(img, img)
         float(out[1])
         return REPS * batch / (time.perf_counter() - t0)
 
@@ -241,10 +242,31 @@ def main():
     }
     _HEADLINE = payload   # from here on a watchdog fire publishes these
     if platform == "cpu":
-        # full-size SparseRAFT on CPU takes hours; the secondary metric
-        # is a TPU measurement, not part of the CPU smoke contract
+        # full-size secondaries on CPU take hours; they are TPU
+        # measurements, not part of the CPU smoke contract
         payload["sparse_skipped"] = "cpu"
     else:
+        try:
+            # The HBM-traffic lever: identical to the headline config
+            # except the volume pyramid is stored bf16 (accuracy budget
+            # pinned by tests/test_golden.py::test_golden_bf16_corr_storage).
+            # corr_dtype only changes storage, not parameters, so the
+            # headline's variables are reused — no second eager init.
+            cfg16 = RAFTConfig(iters=ITERS,
+                               mixed_precision=(platform == "tpu"),
+                               corr_dtype="bfloat16")
+            model16 = RAFT(cfg16)
+
+            @jax.jit
+            def fwd16(i1, i2):
+                flow_up = model16.apply(variables, i1, i2,
+                                        test_mode=True)[1]
+                return flow_up, jnp.sum(flow_up)
+
+            payload["value_bf16_volume"] = round(
+                throughput(BATCH, fwd16), 3)
+        except Exception as e:
+            payload["bf16_error"] = f"{type(e).__name__}: {e}"
         try:
             payload.update(_sparse_metrics())
         except Exception as e:  # secondary must never sink the artifact
