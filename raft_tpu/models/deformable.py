@@ -52,6 +52,10 @@ class MSDeformAttn(nn.Module):
     n_heads: int = 8
     n_points: int = 4
     dtype: Any = jnp.float32
+    # sampling-core dispatch: "auto" | "jnp" | "pallas"
+    # (raft_tpu.ops.msda.ms_deform_attn — pallas pays off for
+    # dense-query encoder layers on TPU)
+    backend: str = "auto"
 
     @nn.compact
     def __call__(self, query, reference_points, value_flatten,
@@ -96,7 +100,8 @@ class MSDeformAttn(nn.Module):
 
         out = ms_deform_attn(value.astype(jnp.float32), spatial_shapes,
                              locations.astype(jnp.float32),
-                             weights.astype(jnp.float32))
+                             weights.astype(jnp.float32),
+                             backend=self.backend)
         out = nn.Dense(self.d_model, dtype=self.dtype,
                        name="output_proj")(out.astype(self.dtype))
         return out, weights
@@ -185,6 +190,7 @@ class DeformableTransformerEncoderLayer(nn.Module):
     n_heads: int = 8
     n_points: int = 4
     dtype: Any = jnp.float32
+    backend: str = "auto"   # MSDA sampling-core dispatch (see MSDeformAttn)
 
     @nn.compact
     def __call__(self, src, pos, reference_points,
@@ -192,6 +198,7 @@ class DeformableTransformerEncoderLayer(nn.Module):
                  deterministic: bool = True):
         src2, _ = MSDeformAttn(self.d_model, self.n_levels, self.n_heads,
                                self.n_points, dtype=self.dtype,
+                               backend=self.backend,
                                name="self_attn")(
             _with_pos(src, pos), reference_points, src, spatial_shapes)
         src = src + nn.Dropout(self.dropout)(src2,

@@ -12,9 +12,11 @@ keypoints × 8 heads × 6 levels × 4 points ≈ 19k samples per image — three
 orders of magnitude smaller than the token grid. The op is
 bandwidth-trivial; what matters is that the gathers vectorize and fuse under
 XLA, so the core is expressed as one batched ``bilinear_sampler`` call per
-level (static level loop) and a single weighted reduction. A Pallas kernel
-would only pay off for dense-query encoder layers (reference keeps those
-disabled, ``core/ours.py:97-109``); revisit if that regime is enabled.
+level (static level loop) and a single weighted reduction. Dense-query
+*encoder* layers (``ours_07`` lineage / ``full_transformer``: every HW
+token is a query) are a different regime — per-scalar gathers cost a full
+HBM tile each there, so ``backend='auto'`` dispatches them to the
+hat-matmul Pallas kernel (:mod:`raft_tpu.ops.msda_pallas`) on TPU.
 
 Sampling convention matches ``F.grid_sample(align_corners=False,
 padding_mode='zeros')``: normalized location ``u ∈ [0,1]`` maps to pixel
@@ -26,15 +28,23 @@ from __future__ import annotations
 
 from typing import Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.sampling import bilinear_sampler
 
 
+# Dense-query regimes (encoder stacks: every HW token is a query) switch
+# to the Pallas kernel on TPU above this query count; below it the gather
+# traffic is trivial and the jnp core fuses fine.
+_PALLAS_MIN_QUERIES = 512
+
+
 def ms_deform_attn(value: jnp.ndarray,
                    spatial_shapes: Sequence[Tuple[int, int]],
                    sampling_locations: jnp.ndarray,
-                   attention_weights: jnp.ndarray) -> jnp.ndarray:
+                   attention_weights: jnp.ndarray,
+                   backend: str = "auto") -> jnp.ndarray:
     """Deformable attention sampling.
 
     Args:
@@ -44,10 +54,34 @@ def ms_deform_attn(value: jnp.ndarray,
       sampling_locations: ``(B, Lq, M, L, P, 2)`` normalized (x, y) in
         [0, 1].
       attention_weights: ``(B, Lq, M, L, P)``, softmaxed over ``L*P``.
+      backend: ``jnp`` (vectorized gathers — right for sparse-query
+        decoders), ``pallas`` (the hat-matmul TPU kernel,
+        :mod:`raft_tpu.ops.msda_pallas` — right for dense-query encoder
+        layers), or ``auto`` (pallas on TPU when the query set is dense
+        and the shapes fit the kernel's VMEM layout).
 
     Returns:
       ``(B, Lq, M*D)``.
     """
+    if backend not in ("jnp", "pallas", "auto"):
+        raise ValueError(f"unknown MSDA backend {backend!r} "
+                         "(expected 'jnp', 'pallas' or 'auto')")
+    if backend != "jnp":
+        from raft_tpu.ops import msda_pallas
+        eligible = msda_pallas.pallas_eligible(value.shape,
+                                               spatial_shapes)
+        if backend == "pallas" and not eligible:
+            raise ValueError(
+                "backend='pallas' but the shapes don't fit the kernel's "
+                f"VMEM-resident layout (value {value.shape}, levels "
+                f"{list(spatial_shapes)}); see msda_pallas.pallas_eligible")
+        if backend == "pallas" or (
+                backend == "auto" and eligible
+                and sampling_locations.shape[1] >= _PALLAS_MIN_QUERIES
+                and jax.default_backend() == "tpu"):
+            return msda_pallas.ms_deform_attn_pallas(
+                value, spatial_shapes, sampling_locations,
+                attention_weights)
     B, S, M, D = value.shape
     _, Lq, _, L, P, _ = sampling_locations.shape
     assert L == len(spatial_shapes)
