@@ -6,11 +6,19 @@ the rest; results appended per-section to ``TPU_EXTRAS.json``):
 
 * ``sparse_train``  — SparseRAFT train-step timing at the fork's active
   resolution (352x480, ``train_standard.sh:6``), batch swept.
+* ``raft_train``    — canonical RAFT train-step timing at the original
+  chairs-stage resolution (368x496, ``train_mixed.sh:3``), batch swept.
 * ``kitti_eval``    — canonical RAFT eval forward at KITTI resolution
   (1242x375 → padded 1248x384, ``BASELINE.json`` configs[4]) in mixed
-  precision, all-pairs vs ``alternate_corr``, with peak-HBM telemetry.
+  precision, all-pairs vs ``alternate_corr``, with per-program
+  compiled-footprint telemetry.
+* ``volume_memory`` — compiled HBM footprints (no execution) for the
+  two correlation regimes at a volume-dominated point (Sintel, batch 4),
+  where the on-demand path's memory advantage is visible.
 * ``batch1``        — single-pair latency breakdown (the bench's
-  batch-1 gap): plain batch 1 vs a double-buffered batch 2.
+  batch-1 gap): batch sweep 1-4. Round-2 result: per-pair cost is flat
+  b1→b3 and only falls at b4, i.e. the gap is small-tile MXU/VPU
+  utilization, not host latency (see BASELINE.md).
 * ``msda_dense``    — one ``DeformableTransformerEncoderLayer`` at dense
   HW-token scale (the gather-bound path flagged in VERDICT r1 #10).
 
@@ -25,8 +33,11 @@ Timing uses a scalar host readback after every measured region —
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -50,23 +61,37 @@ def _time(fn, *args, reps: int = REPS) -> float:
     return (time.perf_counter() - t0) / reps
 
 
-def _peak_hbm_gb() -> float:
-    stats = jax.devices()[0].memory_stats() or {}
-    return round(stats.get("peak_bytes_in_use", 0) / 2 ** 30, 3)
+def _compile(jitted, *args):
+    """One AOT compile used for BOTH timing and footprint, so nothing is
+    compiled twice and per-program numbers aren't polluted by the
+    process-lifetime ``memory_stats()`` high-water mark (which is also
+    simply unavailable through the accelerator tunnel)."""
+    return jitted.lower(*args).compile()
 
 
-def sparse_train() -> dict:
-    """SparseRAFT forward AND train-step rates at 352x480."""
-    from raft_tpu.config import OursConfig, TrainConfig
-    from raft_tpu.models import SparseRAFT
+def _hbm_gb(compiled) -> float:
+    """Peak-HBM estimate from XLA's own buffer assignment."""
+    try:
+        ma = compiled.memory_analysis()
+        total = (ma.temp_size_in_bytes + ma.argument_size_in_bytes +
+                 ma.output_size_in_bytes)
+        return round(total / 2 ** 30, 3)
+    except Exception:
+        return 0.0
+
+
+def _train_rates(make_model, tcfg_kwargs, H, W, batches) -> dict:
+    """Train-step timing sweep shared by the raft_train / sparse_train
+    sections: state + jitted step per batch size, timed with the scalar
+    readback, peak HBM from runtime telemetry or XLA buffer assignment."""
+    from raft_tpu.config import TrainConfig
     from raft_tpu.parallel import create_train_state, make_train_step
 
-    H, W = 352, 480
     out = {"resolution": [H, W]}
-    for batch in (2, 4, 8):
-        tcfg = TrainConfig(model_family="sparse", batch_size=batch,
-                           image_size=(H, W), iters=6, sparse_lambda=0.1)
-        model = SparseRAFT(OursConfig(mixed_precision=True))
+    for batch in batches:
+        tcfg = TrainConfig(batch_size=batch, image_size=(H, W),
+                           **tcfg_kwargs)
+        model = make_model()
         rng = jax.random.PRNGKey(0)
         state = create_train_state(rng, model, tcfg, (H, W))
         step_fn = make_train_step(tcfg, donate=False)
@@ -75,15 +100,47 @@ def sparse_train() -> dict:
              "flow": jnp.zeros((batch, H, W, 2)),
              "valid": jnp.ones((batch, H, W))}
 
+        # Compile the FULL train step once (lowering a loss-only wrapper
+        # would let XLA DCE the backward + optimizer and fake both the
+        # timing and the footprint).
+        compiled = _compile(step_fn, state, b, rng)
+
         def step(state_in):
-            s2, metrics = step_fn(state_in, b, rng)
+            s2, metrics = compiled(state_in, b, rng)
             return metrics["loss"]
 
         dt = _time(step, state, reps=5)
         out[f"train_step_ms_b{batch}"] = round(dt * 1e3, 2)
         out[f"train_samples_per_sec_b{batch}"] = round(batch / dt, 2)
-        out[f"peak_hbm_gb_b{batch}"] = _peak_hbm_gb()
+        out[f"peak_hbm_gb_b{batch}"] = _hbm_gb(compiled)
     return out
+
+
+def sparse_train() -> dict:
+    """SparseRAFT train-step rates at the fork's active resolution
+    (352x480, ``train_standard.sh:6``)."""
+    from raft_tpu.config import OursConfig
+
+    def make_model():
+        from raft_tpu.models import SparseRAFT
+        return SparseRAFT(OursConfig(mixed_precision=True))
+
+    return _train_rates(
+        make_model,
+        dict(model_family="sparse", iters=6, sparse_lambda=0.1),
+        352, 480, (2, 4, 8))
+
+
+def raft_train() -> dict:
+    """Canonical RAFT train-step rates at the original chairs-stage
+    resolution (368x496, ``train_mixed.sh:3``), mixed precision."""
+    from raft_tpu.config import RAFTConfig
+
+    def make_model():
+        from raft_tpu.models.raft import RAFT
+        return RAFT(RAFTConfig(iters=12, mixed_precision=True))
+
+    return _train_rates(make_model, dict(iters=12), 368, 496, (4, 8))
 
 
 def kitti_eval() -> dict:
@@ -108,10 +165,39 @@ def kitti_eval() -> dict:
             return jnp.sum(model.apply(variables, i1, i2,
                                        test_mode=True)[1])
 
-        dt = _time(fwd, img, img)
+        compiled = _compile(fwd, img, img)
+        dt = _time(compiled, img, img)
         out[f"{name}_ms"] = round(dt * 1e3, 2)
         out[f"{name}_pairs_per_sec"] = round(1.0 / dt, 2)
-        out[f"{name}_peak_hbm_gb"] = _peak_hbm_gb()
+        out[f"{name}_compiled_hbm_gb"] = _hbm_gb(compiled)
+    return out
+
+
+def volume_memory() -> dict:
+    """Where the on-demand path's memory win actually shows: compiled
+    footprints (XLA buffer assignment, no execution) for all-pairs vs
+    alternate_corr at a volume-dominated operating point — Sintel
+    440x1024, batch 4, the f32 volume pyramid alone is ~1.1 GB."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W, batch = 440, 1024, 4
+    out = {"resolution": [H, W], "batch": batch, "iters": 12}
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (batch, H, W, 3), jnp.float32) * 255.0
+    for name, alt in (("all_pairs", False), ("alternate_corr", True)):
+        cfg = RAFTConfig(iters=12, mixed_precision=True,
+                         alternate_corr=alt)
+        model = RAFT(cfg)
+        variables = model.init({"params": rng, "dropout": rng},
+                               img[:1], img[:1], iters=1)
+
+        @jax.jit
+        def fwd(i1, i2):
+            return jnp.sum(model.apply(variables, i1, i2,
+                                       test_mode=True)[1])
+
+        out[f"{name}_compiled_hbm_gb"] = _hbm_gb(_compile(fwd, img, img))
     return out
 
 
@@ -139,14 +225,14 @@ def batch1() -> dict:
         dt = _time(fwd, img, img)
         out[f"ms_b{batch}"] = round(dt * 1e3, 2)
         out[f"pairs_per_sec_b{batch}"] = round(batch / dt, 2)
-    # sequential-pair rate a latency-bound client actually sees at b=1,
-    # vs streaming two pairs as one batch=2 (the double-buffer lever)
     return out
 
 
 def msda_dense() -> dict:
     """DeformableTransformerEncoderLayer at dense HW-token scale
-    (sparse-family stride-8 grid of the fork's training res)."""
+    (sparse-family stride-8 grid of the fork's training res): the
+    gather-based jnp core vs the hat-matmul Pallas kernel
+    (``raft_tpu/ops/msda_pallas.py``; ``backend`` dispatch)."""
     from raft_tpu.models.deformable import \
         DeformableTransformerEncoderLayer, DeformableTransformerEncoder
 
@@ -154,25 +240,31 @@ def msda_dense() -> dict:
     for (h, w) in ((44, 60), (88, 120)):
         d_model = 128
         tokens = h * w
-        layer = DeformableTransformerEncoderLayer(
-            d_model=d_model, d_ffn=d_model * 4, dropout=0.0,
-            activation="gelu", n_levels=1, n_heads=8, n_points=4)
-        rng = jax.random.PRNGKey(0)
-        src = jax.random.normal(rng, (1, tokens, d_model))
-        ref = DeformableTransformerEncoder.get_reference_points([(h, w)])
-        ref = jnp.broadcast_to(ref, (1, tokens, 1, 2))
-        variables = layer.init({"params": rng}, src, None, ref, [(h, w)])
+        for backend in ("jnp", "pallas"):
+            layer = DeformableTransformerEncoderLayer(
+                d_model=d_model, d_ffn=d_model * 4, dropout=0.0,
+                activation="gelu", n_levels=1, n_heads=8, n_points=4,
+                backend=backend)
+            rng = jax.random.PRNGKey(0)
+            src = jax.random.normal(rng, (1, tokens, d_model))
+            ref = DeformableTransformerEncoder.get_reference_points(
+                [(h, w)])
+            ref = jnp.broadcast_to(ref, (1, tokens, 1, 2))
+            variables = layer.init({"params": rng}, src, None, ref,
+                                   [(h, w)])
 
-        @jax.jit
-        def fwd(s):
-            return jnp.sum(layer.apply(variables, s, None, ref, [(h, w)]))
+            @jax.jit
+            def fwd(s):
+                return jnp.sum(layer.apply(variables, s, None, ref,
+                                           [(h, w)]))
 
-        dt = _time(fwd, src)
-        out[f"tokens_{tokens}_ms"] = round(dt * 1e3, 3)
+            dt = _time(fwd, src)
+            out[f"tokens_{tokens}_{backend}_ms"] = round(dt * 1e3, 3)
     return out
 
 
-SECTIONS = {"sparse_train": sparse_train, "kitti_eval": kitti_eval,
+SECTIONS = {"sparse_train": sparse_train, "raft_train": raft_train,
+            "kitti_eval": kitti_eval, "volume_memory": volume_memory,
             "batch1": batch1, "msda_dense": msda_dense}
 
 
