@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Ad-hoc on-device profiling probes (run alone on the TPU host).
+
+Sections:
+* ``msda``     — one dense-token DeformableTransformerEncoderLayer
+  (jnp vs pallas backend), per-op breakdown.
+* ``headline`` — the bench.py headline forward at batch 24, per-op
+  breakdown of one dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils import profiling
+
+
+def _run(fn, *args):
+    for _ in range(2):
+        jnp.sum(fn(*args)).block_until_ready()
+    with profiling.trace() as t:
+        out = fn(*args)
+        float(jnp.sum(out))
+    profiling.print_breakdown(t.logdir, steps=1, top=14)
+
+
+def msda():
+    from raft_tpu.models.deformable import (
+        DeformableTransformerEncoder, DeformableTransformerEncoderLayer)
+
+    h, w, d_model = 88, 120, 128
+    tokens = h * w
+    rng = jax.random.PRNGKey(0)
+    src = jax.random.normal(rng, (1, tokens, d_model))
+    ref = DeformableTransformerEncoder.get_reference_points([(h, w)])
+    ref = jnp.broadcast_to(ref, (1, tokens, 1, 2))
+    for backend in ("jnp", "pallas"):
+        layer = DeformableTransformerEncoderLayer(
+            d_model=d_model, d_ffn=d_model * 4, dropout=0.0,
+            activation="gelu", n_levels=1, n_heads=8, n_points=4,
+            backend=backend)
+        variables = layer.init({"params": rng}, src, None, ref, [(h, w)])
+        fwd = jax.jit(lambda s: layer.apply(variables, s, None, ref,
+                                            [(h, w)]))
+        print(f"=== msda_dense {tokens} tokens, backend={backend}")
+        _run(fwd, src)
+
+
+def headline():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 440, 1024
+    batch = int(os.environ.get("RAFT_PROBE_BATCH", "24"))
+    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+    img = jnp.broadcast_to(img1, (batch, H, W, 3))
+    fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
+                                           test_mode=True)[1])
+    print(f"=== headline {batch}x{H}x{W} iters=12")
+    _run(fwd, img, img)
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or ["msda", "headline"]
+    print("devices:", jax.devices(), flush=True)
+    for n in names:
+        {"msda": msda, "headline": headline}[n]()
