@@ -20,7 +20,10 @@ the rest; results appended per-section to ``TPU_EXTRAS.json``):
   b1→b3 and only falls at b4, i.e. the gap is small-tile MXU/VPU
   utilization, not host latency (see BASELINE.md).
 * ``msda_dense``    — one ``DeformableTransformerEncoderLayer`` at dense
-  HW-token scale (the gather-bound path flagged in VERDICT r1 #10).
+  HW-token scale (the gather-bound path flagged in VERDICT r1 #10),
+  jnp vs Pallas backends.
+* ``encoder_family`` — end-to-end ours_07-lineage forward (SparseRAFT
+  with active encoder stacks), MSDA auto-Pallas vs forced gather path.
 
 Run alone on the TPU host (the tunnel serializes processes):
 
@@ -263,9 +266,50 @@ def msda_dense() -> dict:
     return out
 
 
+def encoder_family() -> dict:
+    """End-to-end forward of the ours_07-lineage model (SparseRAFT with
+    active deformable encoder stacks — the dense-query regime) at the
+    fork's training resolution, with the MSDA auto dispatch (Pallas on
+    TPU) vs the gather path forced via the dispatch threshold."""
+    from raft_tpu.config import OursConfig
+    from raft_tpu.models import SparseRAFT
+    from raft_tpu.ops import msda
+
+    # The A/B below is only meaningful where the auto dispatch can pick
+    # the kernel — assert rather than silently record jnp-vs-jnp.
+    assert jax.default_backend() == "tpu", \
+        "encoder_family compares MSDA backends; auto==pallas only on TPU"
+    H, W, batch = 352, 480, 4
+    out = {"resolution": [H, W], "batch": batch, "encoder_iterations": 2,
+           "platform": jax.default_backend()}
+    model = SparseRAFT(OursConfig(mixed_precision=True,
+                                  encoder_iterations=2))
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.uniform(rng, (batch, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img, img)
+
+    @jax.jit
+    def fwd(i1, i2):
+        return jnp.sum(model.apply(variables, i1, i2, test_mode=True)[1])
+
+    saved = msda._PALLAS_MIN_QUERIES
+    try:
+        for name, threshold in (("auto_pallas", saved),
+                                ("jnp", 10 ** 9)):
+            msda._PALLAS_MIN_QUERIES = threshold
+            compiled = _compile(fwd, img, img)
+            dt = _time(compiled, img, img)
+            out[f"{name}_ms"] = round(dt * 1e3, 2)
+            out[f"{name}_pairs_per_sec"] = round(batch / dt, 2)
+    finally:
+        msda._PALLAS_MIN_QUERIES = saved
+    return out
+
+
 SECTIONS = {"sparse_train": sparse_train, "raft_train": raft_train,
             "kitti_eval": kitti_eval, "volume_memory": volume_memory,
-            "batch1": batch1, "msda_dense": msda_dense}
+            "batch1": batch1, "msda_dense": msda_dense,
+            "encoder_family": encoder_family}
 
 
 def main(argv):

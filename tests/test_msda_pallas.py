@@ -56,6 +56,22 @@ def test_forward_parity():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_forward_parity_three_level_pyramid():
+    """Stride-8/16/32 style level pyramid (the encoder-family regime)."""
+    shapes = [(8, 12), (4, 6), (2, 3)]
+    s = sum(h * w for h, w in shapes)
+    rng = np.random.RandomState(5)
+    value = jnp.asarray(rng.randn(1, s, M, D).astype(np.float32))
+    loc = jnp.asarray(
+        rng.uniform(0.05, 0.95, (1, s, M, 3, P, 2)).astype(np.float32))
+    w = rng.rand(1, s, M, 3, P).astype(np.float32)
+    w = jnp.asarray(w / w.sum(axis=(3, 4), keepdims=True))
+    ref = ms_deform_attn(value, shapes, loc, w)
+    out = ms_deform_attn_pallas(value, shapes, loc, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_forward_parity_lane_multiple_queries():
     value, loc, w = _inputs(seed=3, lq=128)
     ref = ms_deform_attn(value, SHAPES, loc, w)
