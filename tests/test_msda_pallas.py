@@ -99,6 +99,30 @@ def test_gradient_parity(argnum, name):
                                atol=2e-3, rtol=1e-3, err_msg=name)
 
 
+def test_dispatch_validation():
+    value, loc, w = _inputs(seed=7)
+    with pytest.raises(ValueError, match="unknown MSDA backend"):
+        ms_deform_attn(value, SHAPES, loc, w, backend="palas")
+    # forced pallas on ineligible shapes is a clear error, not a Mosaic
+    # failure: a 1024x1024 level's value block blows the VMEM budget
+    big = [(1024, 1024)]
+    s = 1024 * 1024
+    bv = jnp.zeros((1, s, 1, 8), jnp.float32)
+    bl = jnp.zeros((1, 4, 1, 1, 2, 2), jnp.float32)
+    bw = jnp.ones((1, 4, 1, 1, 2), jnp.float32) / 2.0
+    with pytest.raises(ValueError, match="VMEM"):
+        ms_deform_attn(bv, big, bl, bw, backend="pallas")
+
+
+def test_auto_dispatch_small_query_matches_jnp():
+    """Below the dense-query threshold auto must take the jnp path
+    bit-for-bit (it is the jnp path)."""
+    value, loc, w = _inputs(seed=8)
+    a = ms_deform_attn(value, SHAPES, loc, w, backend="auto")
+    b = ms_deform_attn(value, SHAPES, loc, w, backend="jnp")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_module_backend_parity():
     """MSDeformAttn(backend='pallas') == backend='jnp' through the flax
     module (value projection, offset/weight heads, output projection)."""
