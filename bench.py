@@ -18,23 +18,50 @@ than ``block_until_ready`` through the accelerator tunnel) so the device
 pipeline rate is measured, not the host↔device round-trip latency of a
 lone request.
 
-Failure contract: this script ALWAYS prints exactly one JSON line.  If the
-accelerator tunnel is down, retries are bounded (``RAFT_BENCH_RETRY_S``,
-default 15s x 4 attempts) and absolute wall-clock deadlines
-(``RAFT_BENCH_DEADLINE_S`` for backend init, then
-``RAFT_BENCH_TOTAL_DEADLINE_S`` as a total cap, both measured from the
-FIRST exec across re-exec retries) are enforced by a watchdog thread —
-backend init can hang inside C code far past any Python-level timeout —
-so the driver artifact parses regardless of tunnel weather.
+Failure contract: this script ALWAYS prints exactly one JSON line.
+
+Tunnel-outage strategy (round-3 redesign — two prior rounds lost the
+driver artifact to backend-init hangs):
+
+* **Probe ladder, not one long wait.** ``jax.devices()`` on a wedged
+  tunnel blocks inside C past any Python timeout, and jax caches a failed
+  backend in-process. So the parent process never initializes the backend
+  blind: it spawns disposable ``python -c "import jax; jax.devices()"``
+  probe children with a per-probe timeout (``RAFT_BENCH_PROBE_TIMEOUT_S``,
+  default 75s) and retries until the probe budget — the total deadline
+  minus a compute margin — is spent. A dead-all-round tunnel yields an
+  artifact recording every attempt (≥10 across the window) instead of one
+  silent 20-minute hang.
+* **Persistent XLA compilation cache.** ``JAX_COMPILATION_CACHE_DIR`` is
+  pointed at ``.jax_cache/`` in the repo (committed after local captures),
+  so a warm driver re-run spends seconds, not minutes, compiling inside
+  the tunnel window.
+* **Watchdog total cap.** A daemon thread enforces an absolute wall
+  deadline (``RAFT_BENCH_TOTAL_DEADLINE_S``, default 1500s from the FIRST
+  exec, surviving re-exec) with ``os._exit`` so even a post-probe init
+  hang still emits the artifact before the driver's rc=124.
+* **Context travels with failure.** A null-value artifact embeds
+  ``init_attempts`` and ``last_local_capture`` (the most recent committed
+  local capture, clearly labelled — value itself stays null; no faking).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
+
+# Persistent compilation cache — must be in the environment before jax
+# initializes. min-compile-time/entry-size floors dropped to zero so every
+# executable (including the small scalar-readback helpers) is cached.
+_REPO = os.path.dirname(os.path.abspath(__file__))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 METRIC = "sintel_image_pairs_per_sec_per_chip_iters12"
 UNIT = "image-pairs/sec"
@@ -47,7 +74,6 @@ REPS = 10
 # sparse-family secondary metric: the fork's active training resolution
 # (reference train_standard.sh:6: 352x480)
 SPARSE_H, SPARSE_W, SPARSE_BATCH = 352, 480, 8
-
 
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
@@ -70,6 +96,31 @@ def _emit(payload: dict) -> bool:
 _PLATFORM: str | None = None   # set once the backend is up, for triage
 _HEADLINE: dict | None = None  # completed headline numbers, survive a
                                # failure in the secondary metric
+_INIT_ATTEMPTS: list[dict] = []  # probe-ladder log, embedded in artifacts
+try:
+    # survive the one re-exec retry (see _wait_for_backend) so the
+    # artifact records every attempt, not just post-exec ones
+    _INIT_ATTEMPTS.extend(
+        json.loads(os.environ.get("RAFT_BENCH_ATTEMPT_LOG", "[]")))
+except ValueError:
+    pass
+
+
+def _last_local_capture() -> dict | None:
+    """Most recent committed local capture, embedded in failure artifacts
+    so the context travels with the null (the value stays null — this is
+    labelled context, not a substitute measurement)."""
+    for name in ("BENCH_local.json", "BENCH_r03_local.json",
+                 "BENCH_r02_local.json"):
+        path = os.path.join(_REPO, name)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and data.get("value") is not None:
+            return {"file": name, **data}
+    return None
 
 
 def _emit_failure(msg: str) -> None:
@@ -87,6 +138,14 @@ def _emit_failure(msg: str) -> None:
     payload["error"] = msg
     if _PLATFORM is not None:
         payload.setdefault("platform", _PLATFORM)
+    if _INIT_ATTEMPTS:
+        # distinct key from the success artifact's int init_attempt_count
+        # so the field never flips type between artifacts
+        payload["init_attempt_log"] = _INIT_ATTEMPTS
+    if payload.get("value") is None:
+        local = _last_local_capture()
+        if local is not None:
+            payload["last_local_capture"] = local
     _emit(payload)
 
 
@@ -98,95 +157,177 @@ class _Watchdog:
     try/except — only a watchdog thread + ``os._exit`` reliably gets the
     JSON line out before the driver's own timeout (rc=124, no artifact).
 
-    Two phases, BOTH anchored to the first-exec start time so the whole
-    process fits inside the driver's kill window (round-1 evidence puts
-    that window near 30 min): a tight init deadline
-    (``RAFT_BENCH_DEADLINE_S``) while the backend comes up, then — via
-    :meth:`rearm` once the backend is healthy — a total-wall cap
-    (``RAFT_BENCH_TOTAL_DEADLINE_S``, default 1500s from first exec) for
-    compile + measurement, so a tunnel death mid-run still emits the
-    artifact before the driver's rc=124.
+    One absolute cap (``RAFT_BENCH_TOTAL_DEADLINE_S``, default 1500s),
+    anchored to the FIRST exec start time (``RAFT_BENCH_START`` env,
+    preserved across re-exec) so the whole process fits inside the
+    driver's kill window (round-1 evidence puts that window near 30 min).
+    The init phase is additionally bounded by the probe ladder itself
+    (:func:`_wait_for_backend`), which never blocks in C.
     """
 
     def __init__(self) -> None:
-        deadline_s = float(os.environ.get("RAFT_BENCH_DEADLINE_S", "1200"))
-        self._start = float(os.environ.setdefault("RAFT_BENCH_START",
-                                                  str(time.time())))
-        self._expiry = self._start + deadline_s
-        self._reason = "backend-init"
+        total_s = float(
+            os.environ.get("RAFT_BENCH_TOTAL_DEADLINE_S", "1500"))
+        self.start = float(os.environ.setdefault("RAFT_BENCH_START",
+                                                 str(time.time())))
+        self.total_expiry = self.start + total_s
+        self._expiry = self.total_expiry
+        self._reason = "total wall cap"
         if time.time() >= self._expiry:
-            _emit_failure(f"deadline {deadline_s:.0f}s exceeded "
-                          f"before start")
+            _emit_failure(f"deadline {total_s:.0f}s exceeded before start")
             os._exit(0)
         threading.Thread(target=self._watch, daemon=True).start()
 
-    def rearm(self, unbounded: bool = False) -> None:
-        if unbounded:
-            # Explicitly-requested CPU smoke runs are interactive, not
-            # driver artifacts; full-size CPU compute takes hours and
-            # must not be misreported as an accelerator hang.
-            self._expiry = float("inf")
-            return
-        total_s = float(
-            os.environ.get("RAFT_BENCH_TOTAL_DEADLINE_S", "1500"))
-        self._expiry = self._start + total_s
-        self._reason = "compute (total wall cap)"
+    def lift(self) -> None:
+        # Explicitly-requested CPU smoke runs are interactive, not
+        # driver artifacts; full-size CPU compute takes hours and
+        # must not be misreported as an accelerator hang.
+        self._expiry = float("inf")
 
     def _watch(self) -> None:
         while True:
             remaining = self._expiry - time.time()
             if remaining <= 0:
-                _emit_failure(
-                    f"{self._reason} deadline exceeded "
-                    f"(accelerator hang?)")
+                try:
+                    _emit_failure(
+                        f"{self._reason} deadline exceeded "
+                        f"(accelerator hang?)")
+                except BaseException:   # artifact at any cost
+                    try:
+                        _emit({"metric": METRIC, "value": None,
+                               "unit": UNIT, "vs_baseline": None,
+                               "error": "watchdog emit failed"})
+                    except BaseException:
+                        pass
                 os._exit(0)
             time.sleep(min(remaining, 5.0))
 
 
-def _wait_for_backend(attempts: int = 4) -> bool:
-    """Survive transient accelerator-tunnel outages: backend init failures
-    are retried by re-execing (jax caches a failed backend in-process).
-    The retry budget (attempts x RAFT_BENCH_RETRY_S) is kept far below the
-    driver's timeout; terminal failure exits via ``_emit_failure``.
+def _probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Check backend health in a disposable child process.  The child —
+    not the parent — eats any in-C init hang; the parent reliably times
+    it out and kills it.  Returns (ok, platform-or-error)."""
+    # The axon plugin pins jax_platforms in jax.config at interpreter
+    # startup, overriding the env var — re-apply JAX_PLATFORMS explicitly
+    # so a requested CPU run really probes CPU (see tests/conftest.py).
+    # A silent accelerator→CPU *fallback* is a probe failure, not
+    # success: committing to a full-size CPU bench is a guaranteed
+    # watchdog timeout, exactly what the ladder exists to avoid.
+    code = ("import os, jax, sys\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "plat = jax.devices()[0].platform\n"
+            "if plat == 'cpu' and not (p or '').startswith('cpu'):\n"
+            "    sys.stderr.write('silent CPU fallback')\n"
+            "    sys.exit(3)\n"
+            "sys.stdout.write(plat)")
+    env = dict(os.environ)
+    env.pop("RAFT_BENCH_START", None)   # child is a probe, not a bench
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout {timeout_s:.0f}s"
+    except OSError as e:
+        return False, f"probe spawn failed: {e}"
+    if r.returncode == 0 and r.stdout.strip():
+        return True, r.stdout.strip()
+    tail = (r.stderr or "").strip().splitlines()
+    return False, (tail[-1][-200:] if tail else f"rc={r.returncode}")
 
-    Returns True iff the run is an *explicitly requested* CPU run (local
-    smoke) — the caller uses this to lift the watchdog's wall cap."""
+
+def _wait_for_backend(watchdog: _Watchdog) -> bool:
+    """Probe ladder: many short, killable init attempts spread across the
+    window, instead of one long blind wait.  Probing stops when less than
+    ``RAFT_BENCH_COMPUTE_MARGIN_S`` (default 420s; a warm compile cache
+    needs far less) remains before the total deadline, reserving room for
+    the real compile + measurement after a late success.
+
+    On probe success the parent initializes its own backend (covered by
+    the watchdog; one re-exec retry if that init *errors* — jax caches a
+    failed backend in-process).  Returns True iff the run is an
+    *explicitly requested* CPU run (local smoke) — the caller uses this
+    to lift the watchdog's wall cap."""
     global _PLATFORM
-    import jax
+    probe_timeout = float(os.environ.get("RAFT_BENCH_PROBE_TIMEOUT_S", "75"))
+    retry_s = float(os.environ.get("RAFT_BENCH_RETRY_S", "15"))
+    margin_s = float(os.environ.get("RAFT_BENCH_COMPUTE_MARGIN_S", "420"))
+    # A short caller-set total deadline must still yield >=1 real probe:
+    # cap the margin at a third of the remaining window.
+    margin_s = min(margin_s, (watchdog.total_expiry - time.time()) / 3.0)
+    probe_budget_end = watchdog.total_expiry - margin_s
 
-    delay_s = float(os.environ.get("RAFT_BENCH_RETRY_S", "15"))
+    attempt = len(_INIT_ATTEMPTS)
+    while True:
+        attempt += 1
+        now = time.time()
+        budget = probe_budget_end - now
+        if budget <= 0:
+            _emit_failure(
+                f"accelerator backend unavailable after {attempt - 1} "
+                f"probe attempts spanning "
+                f"{now - watchdog.start:.0f}s")
+            sys.exit(0)
+        ok, info = _probe_backend(min(probe_timeout, budget))
+        _INIT_ATTEMPTS.append({
+            "t_s": round(time.time() - watchdog.start, 1),
+            "ok": ok, "info": info})
+        if ok:
+            break
+        print(f"backend probe {attempt} failed: {info}; "
+              f"retrying in {retry_s:.0f}s "
+              f"({probe_budget_end - time.time():.0f}s of probe budget "
+              f"left)", file=sys.stderr, flush=True)
+        time.sleep(min(retry_s, max(0.0, probe_budget_end - time.time())))
+
+    # Probe says healthy — initialize in-process. A hang here is caught
+    # by the watchdog; an *error* (jax poisons a failed backend) gets one
+    # re-exec, deadline still anchored to first exec via RAFT_BENCH_START.
+    import jax
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        # Same plugin-pinned-config override as the probe child.
+        jax.config.update("jax_platforms", env_platforms)
     try:
         dev = jax.devices()[0]
-    except Exception as e:  # backend-init failures vary in exception type
-        tried = int(os.environ.get("RAFT_BENCH_INIT_TRY", "0"))
-        if tried + 1 >= attempts:
-            _emit_failure(
-                f"accelerator backend unavailable after {attempts} "
-                f"attempts: {e}")
+    except Exception as e:
+        if os.environ.get("RAFT_BENCH_INIT_TRY"):
+            _emit_failure(f"backend init failed after healthy probe "
+                          f"(twice): {e}")
             sys.exit(0)
-        print(f"backend init failed (attempt {tried + 1}/{attempts}): {e}; "
-              f"retrying in {delay_s:.0f}s", file=sys.stderr, flush=True)
-        os.environ["RAFT_BENCH_INIT_TRY"] = str(tried + 1)
-        time.sleep(delay_s)
+        print(f"init failed after healthy probe: {e}; re-exec once",
+              file=sys.stderr, flush=True)
+        os.environ["RAFT_BENCH_INIT_TRY"] = "1"
+        os.environ["RAFT_BENCH_ATTEMPT_LOG"] = json.dumps(_INIT_ATTEMPTS)
         os.execv(sys.executable, [sys.executable] + sys.argv)
     _PLATFORM = dev.platform
     requested = (os.environ.get("JAX_PLATFORMS")
                  or str(getattr(jax.config, "jax_platforms", "") or ""))
     cpu_explicit = requested.startswith("cpu")
     if dev.platform == "cpu" and not cpu_explicit:
-        # Silent accelerator→CPU fallback would publish a wildly wrong
-        # vs_baseline; make it loud (explicit cpu runs stay quiet).
-        print("WARNING: no accelerator available — benchmarking on "
-              "CPU; vs_baseline is not comparable",
-              file=sys.stderr, flush=True)
-    os.environ.pop("RAFT_BENCH_INIT_TRY", None)
+        # Silent accelerator→CPU fallback: mirror the probe child's
+        # policy (a full-size CPU bench is a guaranteed watchdog timeout
+        # with a misleading error). One re-exec retry — the tunnel may
+        # have flapped between probe and init — then a clean failure
+        # artifact while probe budget still remains.
+        if os.environ.get("RAFT_BENCH_INIT_TRY"):
+            _emit_failure("silent CPU fallback after healthy probe "
+                          "(twice)")
+            sys.exit(0)
+        print("accelerator fell back to CPU after healthy probe; "
+              "re-exec once", file=sys.stderr, flush=True)
+        os.environ["RAFT_BENCH_INIT_TRY"] = "1"
+        os.environ["RAFT_BENCH_ATTEMPT_LOG"] = json.dumps(_INIT_ATTEMPTS)
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     return dev.platform == "cpu" and cpu_explicit
 
 
 def main():
     watchdog = _Watchdog()
-    cpu_smoke = _wait_for_backend()
-    watchdog.rearm(unbounded=cpu_smoke)
+    cpu_smoke = _wait_for_backend(watchdog)
+    if cpu_smoke:
+        watchdog.lift()
     import jax
     import jax.numpy as jnp
     from raft_tpu.config import RAFTConfig
@@ -239,8 +380,13 @@ def main():
         "value_batch1": round(batch1, 3),
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC, 3),
         "vs_baseline_batch1": round(batch1 / BASELINE_PAIRS_PER_SEC, 3),
+        "init_attempt_count": len(_INIT_ATTEMPTS),
     }
-    _HEADLINE = payload   # from here on a watchdog fire publishes these
+    # From here on a watchdog fire publishes the headline numbers.
+    # Snapshot (never alias) — the watchdog thread reads _HEADLINE while
+    # main keeps mutating payload with secondary-metric keys, and
+    # dict()-copying a dict being resized concurrently raises.
+    _HEADLINE = dict(payload)
     if platform == "cpu":
         # full-size secondaries on CPU take hours; they are TPU
         # measurements, not part of the CPU smoke contract
@@ -267,6 +413,7 @@ def main():
                 throughput(BATCH, fwd16), 3)
         except Exception as e:
             payload["bf16_error"] = f"{type(e).__name__}: {e}"
+        _HEADLINE = dict(payload)   # refresh snapshot between sections
         try:
             payload.update(_sparse_metrics())
         except Exception as e:  # secondary must never sink the artifact
