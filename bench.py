@@ -115,7 +115,11 @@ def _last_local_capture() -> dict | None:
         path = os.path.join(_REPO, name)
         try:
             with open(path) as f:
-                data = json.load(f)
+                lines = [l for l in f if l.strip()]
+            # last non-empty line: tunnel_watch copies bench stdout
+            # verbatim, and third-party libraries may have printed
+            # above the artifact line
+            data = json.loads(lines[-1]) if lines else None
         except (OSError, ValueError):
             continue
         if isinstance(data, dict) and data.get("value") is not None:
@@ -292,6 +296,8 @@ def _wait_for_backend(watchdog: _Watchdog) -> bool:
     try:
         dev = jax.devices()[0]
     except Exception as e:
+        # distinct retry flags per failure reason so diagnostics don't
+        # conflate one init error + one CPU fallback into "(twice)"
         if os.environ.get("RAFT_BENCH_INIT_TRY"):
             _emit_failure(f"backend init failed after healthy probe "
                           f"(twice): {e}")
@@ -311,13 +317,13 @@ def _wait_for_backend(watchdog: _Watchdog) -> bool:
         # with a misleading error). One re-exec retry — the tunnel may
         # have flapped between probe and init — then a clean failure
         # artifact while probe budget still remains.
-        if os.environ.get("RAFT_BENCH_INIT_TRY"):
+        if os.environ.get("RAFT_BENCH_CPU_TRY"):
             _emit_failure("silent CPU fallback after healthy probe "
                           "(twice)")
             sys.exit(0)
         print("accelerator fell back to CPU after healthy probe; "
               "re-exec once", file=sys.stderr, flush=True)
-        os.environ["RAFT_BENCH_INIT_TRY"] = "1"
+        os.environ["RAFT_BENCH_CPU_TRY"] = "1"
         os.environ["RAFT_BENCH_ATTEMPT_LOG"] = json.dumps(_INIT_ATTEMPTS)
         os.execv(sys.executable, [sys.executable] + sys.argv)
     return dev.platform == "cpu" and cpu_explicit

@@ -43,6 +43,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# The axon plugin pins jax_platforms in jax.config at interpreter
+# startup, overriding the env var; honor an explicit JAX_PLATFORMS so
+# sections can be smoke-run on CPU (see tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 
 OUT_PATH = "TPU_EXTRAS.json"
@@ -288,21 +295,31 @@ def encoder_family() -> dict:
     img = jax.random.uniform(rng, (batch, H, W, 3), jnp.float32) * 255.0
     variables = model.init({"params": rng, "dropout": rng}, img, img)
 
-    @jax.jit
-    def fwd(i1, i2):
-        return jnp.sum(model.apply(variables, i1, i2, test_mode=True)[1])
-
     saved = msda._PALLAS_MIN_QUERIES
+    hlo_fingerprint = {}
     try:
         for name, threshold in (("auto_pallas", saved),
                                 ("jnp", 10 ** 9)):
             msda._PALLAS_MIN_QUERIES = threshold
-            compiled = _compile(fwd, img, img)
+
+            # A FRESH jitted callable per arm: jax's tracing cache is
+            # keyed on the function object, so re-lowering one shared
+            # `fwd` would silently reuse the jaxpr traced under the
+            # previous threshold and time the same program twice.
+            def arm_fwd(i1, i2):
+                return jnp.sum(model.apply(variables, i1, i2,
+                                           test_mode=True)[1])
+
+            compiled = _compile(jax.jit(arm_fwd), img, img)
+            hlo_fingerprint[name] = hash(compiled.as_text())
             dt = _time(compiled, img, img)
             out[f"{name}_ms"] = round(dt * 1e3, 2)
             out[f"{name}_pairs_per_sec"] = round(batch / dt, 2)
     finally:
         msda._PALLAS_MIN_QUERIES = saved
+    assert hlo_fingerprint["auto_pallas"] != hlo_fingerprint["jnp"], \
+        "A/B arms compiled to identical programs — dispatch didn't switch"
+    out["arms_compiled_distinct"] = True
     return out
 
 
