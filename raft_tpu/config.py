@@ -49,6 +49,14 @@ class RAFTConfig:
     # traffic of the framework's dominant memory object (~0.3% relative
     # flow change at Sintel scale); "auto" = bfloat16 iff mixed_precision.
     corr_dtype: str = "float32"     # float32 | bfloat16 | auto
+    # Operand dtype of the on-demand (alternate_corr) Pallas kernel's
+    # correlation matmuls. Accumulation is always float32; "bfloat16"
+    # operands quadruple MXU throughput with the same contract as the
+    # mixed-precision encoder policy. "auto" = bfloat16 iff
+    # mixed_precision (matching the policy boundary at reference
+    # core/raft.py:100-103, where features enter corr from autocast
+    # regions). No effect on the materialized all-pairs path.
+    corr_mxu_dtype: str = "auto"    # float32 | bfloat16 | auto
     # Number of refinement iterations (train default 12; eval uses 24/32 —
     # reference train.py:445, evaluate.py:75,102,251).
     iters: int = 12
@@ -58,12 +66,23 @@ class RAFTConfig:
             raise ValueError(
                 f"corr_dtype must be 'auto', 'float32' or 'bfloat16', "
                 f"got {self.corr_dtype!r}")
+        if self.corr_mxu_dtype not in ("auto", "float32", "bfloat16"):
+            raise ValueError(
+                f"corr_mxu_dtype must be 'auto', 'float32' or 'bfloat16', "
+                f"got {self.corr_mxu_dtype!r}")
         if self.alternate_corr and self.corr_dtype == "bfloat16":
             # The on-demand path never materializes a volume pyramid, so an
             # explicit bfloat16 request would be a silent no-op.
             raise ValueError(
                 "corr_dtype='bfloat16' has no effect with alternate_corr "
                 "(the on-demand path stores no correlation pyramid)")
+        if not self.alternate_corr and self.corr_mxu_dtype == "bfloat16":
+            # Mirror of the check above: the MXU-operand dtype only exists
+            # on the on-demand kernel's matmuls.
+            raise ValueError(
+                "corr_mxu_dtype='bfloat16' has no effect without "
+                "alternate_corr (the materialized path controls volume "
+                "precision via corr_dtype)")
 
     @property
     def fnet_dim(self) -> int:
@@ -87,6 +106,12 @@ class RAFTConfig:
         if self.corr_dtype == "auto":
             return jnp.bfloat16 if self.mixed_precision else jnp.float32
         return jnp.dtype(self.corr_dtype)
+
+    @property
+    def corr_mxu(self) -> str:
+        if self.corr_mxu_dtype == "auto":
+            return "bfloat16" if self.mixed_precision else "float32"
+        return self.corr_mxu_dtype
 
     @staticmethod
     def large(**kw) -> "RAFTConfig":

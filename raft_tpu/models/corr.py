@@ -169,35 +169,42 @@ def build_feature_pyramid(fmap2: jnp.ndarray, num_levels: int):
     return tuple(pyramid2)
 
 
-def _resolve_window_fn(backend: str):
-    """Resolve the on-demand window implementation.
+def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
+                     radius: int, scale: bool = True,
+                     backend: str = "auto",
+                     mxu_dtype: str = "float32") -> jnp.ndarray:
+    """On-demand windowed lookup over a pooled feature pyramid; numerically
+    identical to ``pyramid_lookup`` over the materialized volume.
 
     ``auto`` picks the Pallas kernel only on TPU — off-TPU the kernel would
     run through the (slow) Pallas interpreter, so the vectorized jnp
-    reference is the right default there. Note the backends differ in one
+    reference is the right default there. On the Pallas path all pyramid
+    levels run in ONE fused kernel launch. The backends differ in one
     gradient contract: the Pallas kernel treats coordinates as
     non-differentiable (zero gradient — the reference extension's behavior,
     ``alt_cuda_corr/correlation_kernel.cu:307``), while the jnp path
     propagates bilinear-sampler coordinate gradients. RAFT stop-gradients
     coords before lookup, so the model is backend-agnostic.
+
+    ``mxu_dtype``: operand dtype for the Pallas kernel's correlation
+    matmuls (f32 accumulation; see ``RAFTConfig.corr_mxu_dtype``).
+    Ignored by the jnp path, which always computes in float32.
     """
-    if backend == "jnp":
-        return windowed_correlation
-    if backend == "auto" and jax.default_backend() != "tpu":
-        return windowed_correlation
-    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
-    return windowed_correlation_pallas
-
-
-def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
-                     radius: int, scale: bool = True,
-                     backend: str = "auto") -> jnp.ndarray:
-    """On-demand windowed lookup over a pooled feature pyramid; numerically
-    identical to ``pyramid_lookup`` over the materialized volume."""
-    fn = _resolve_window_fn(backend)
+    if backend not in ("auto", "jnp", "pallas"):
+        raise ValueError(f"unknown correlation backend {backend!r} "
+                         f"(want 'auto', 'jnp' or 'pallas')")
+    use_pallas = backend == "pallas" or (
+        backend == "auto" and jax.default_backend() == "tpu")
+    if use_pallas:
+        from raft_tpu.ops.corr_pallas import (
+            windowed_correlation_pallas_fused)
+        return windowed_correlation_pallas_fused(
+            fmap1, tuple(pyramid2), coords, radius, scale=scale,
+            mxu_dtype=mxu_dtype)
     out = []
     for lvl, f2 in enumerate(pyramid2):
-        out.append(fn(fmap1, f2, coords / (2 ** lvl), radius, scale))
+        out.append(windowed_correlation(fmap1, f2, coords / (2 ** lvl),
+                                        radius, scale))
     return jnp.concatenate(out, axis=-1)
 
 
@@ -208,13 +215,15 @@ class AlternateCorrBlock:
 
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True,
-                 backend: str = "auto"):
+                 backend: str = "auto", mxu_dtype: str = "float32"):
         self.radius = radius
         self.scale = scale
         self.backend = backend
+        self.mxu_dtype = mxu_dtype
         self.fmap1 = fmap1
         self.pyramid2 = build_feature_pyramid(fmap2, num_levels)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
         return alternate_lookup(self.fmap1, self.pyramid2, coords,
-                                self.radius, self.scale, self.backend)
+                                self.radius, self.scale, self.backend,
+                                self.mxu_dtype)

@@ -100,7 +100,8 @@ def _lookup(cfg: RAFTConfig, corr_state, coords):
     if kind == "alt":
         fmap1, pyramid2 = payload
         return corr.alternate_lookup(fmap1, pyramid2, coords, cfg.radius,
-                                     cfg.corr_scale)
+                                     cfg.corr_scale,
+                                     mxu_dtype=cfg.corr_mxu)
     return corr.pyramid_lookup(payload, coords, cfg.radius)
 
 

@@ -14,11 +14,11 @@ Design (TPU-first, not a CUDA translation):
   per-pixel gathers are the wrong shape for TPU. Instead we use two facts:
 
   1. **Blockwise recompute**: for a tile of ``TQ`` query pixels, the rows of
-     the all-pairs volume they need are ONE MXU matmul of the query tile
-     against the target features. The result lives only in VMEM scratch and
-     is consumed immediately — the flash-attention memory pattern applied
-     to the correlation volume (the quadratic object of this workload,
-     SURVEY.md §5 "long-context equivalent").
+     the all-pairs volume they need are MXU matmuls of the query tile
+     against target-row chunks. Results live only in VMEM and are consumed
+     immediately — the flash-attention memory pattern applied to the
+     correlation volume (the quadratic object of this workload, SURVEY.md
+     §5 "long-context equivalent").
 
   2. **Separable bilinear windows**: a bilinear sample at ``(cx+ox, cy+oy)``
      factors into 1-D "hat" weights ``max(0, 1-|y-(cy+oy)|)`` times
@@ -32,30 +32,62 @@ Design (TPU-first, not a CUDA translation):
 
   Everything is strictly 2-D inside the kernel (Mosaic's vector layout
   requirement) and laid out **query-minor**: the query-tile axis is the lane
-  dimension, so the y-sweep's dynamic row slices land on the sublane axis
-  and the target width only needs 8-alignment (not 128), minimizing padding
-  for narrow training crops.
+  dimension, so the y-sweep's row chunks land on the sublane axis and the
+  target width only needs 8-alignment (not 128), minimizing padding for
+  narrow training crops.
 
-* Backward is the transpose of the same dense pipeline (hat-weighted
-  assembly of dL/d(corr tile) in scratch, then two MXU matmuls); ``fmap2``
-  gradients accumulate across query tiles in VMEM via output-block
-  revisiting — no atomics, unlike the CUDA kernel's ``atomicAdd``
-  (``correlation_kernel.cu:229-238``). Coordinates get zero gradient,
-  matching the CUDA extension (``coords_grad`` is allocated but never
-  written, ``correlation_kernel.cu:307``) and the per-iteration
+Round-3 performance redesign (VERDICT r2 #2 — the kernel lost to the
+materialized path at KITTI eval, 12.1 vs 18.1 pairs/s):
+
+* **Dynamic y-band skipping.** The hat weight of query ``n`` is *exactly
+  zero* for target rows outside ``[cy_n - r - 1, cy_n + r + 1]``, so each
+  query tile only needs the rows in the band spanned by its own
+  ``[min(cy), max(cy)]``. The kernel computes that band from the (already
+  VMEM-resident) coordinates and runs a dynamic-bound ``fori_loop`` over
+  row *chunks*, skipping both the MXU matmul and the VPU sweep for
+  untouched chunks — numerics-exact, worst case (wild flow spread) equals
+  the full sweep. RAFT's lookups are ``grid + flow`` with smooth flow, so
+  a raster-order query tile typically touches ~``2(r+1) + tile_rows`` of
+  the ``H2`` target rows.
+* **All pyramid levels in ONE kernel launch.** The pooled feature levels
+  are passed as separate VMEM-resident inputs and looped statically inside
+  the kernel: one launch per lookup instead of four, and the query tile's
+  features/coords are loaded once for all levels.
+* **Scratch-ref accumulators.** The y-offset accumulators live in a VMEM
+  scratch ref updated in place; the previous formulation concatenated
+  ``2r+1`` fresh blocks per target row and added them into a carried array,
+  doubling the sweep's VPU traffic.
+* **Optional bf16 MXU operands** (``mxu_dtype='bfloat16'``): the
+  correlation matmuls read ``f1``/``f2`` as bfloat16 with float32
+  accumulation (``preferred_element_type``) — 4x MXU throughput, the same
+  contract as the model's mixed-precision policy. All hat-weight
+  arithmetic and accumulation stay float32. The *backward* matmuls also
+  round the assembled f32 cotangent to bfloat16 (standard mixed-precision
+  backprop; gradients carry bf16-rounding error the forward avoids —
+  bounded in ``test_bf16_mxu_operands_close_to_f32``).
+
+* Backward is the transpose of the same banded pipeline: the x-side
+  adjoint is assembled once per (tile, level), then a dynamic-bound chunk
+  loop assembles dL/d(corr chunk) in registers and feeds two MXU matmuls
+  per chunk; ``fmap2`` gradients accumulate across query tiles in VMEM via
+  output-block revisiting — no atomics, unlike the CUDA kernel's
+  ``atomicAdd`` (``correlation_kernel.cu:229-238``). Coordinates get zero
+  gradient, matching the CUDA extension (``coords_grad`` is allocated but
+  never written, ``correlation_kernel.cu:307``) and the per-iteration
   ``coords1.detach()`` upstream (reference ``core/raft.py:124``).
 
-VMEM envelope: the target level (``H2*W2p x C``), the corr-tile scratch
-(``H2*W2p x TQ``) and (backward only) the fmap2 gradient block must co-reside
-in ~16 MB of VMEM. At stride-8 feature resolution this holds for full Sintel
-and KITTI eval forward passes and for all reference training crop sizes;
-float32 full-resolution *backward* at 1242x375 would not fit — but the
-reference's training never runs full-resolution backward either (crops,
-SURVEY.md §2.5).
+VMEM envelope: the pooled target levels (Σ_l ``H2l*W2lp x C``) plus
+per-tile scratch must co-reside in ~16 MB of VMEM; the banded backward no
+longer needs its former ``(H2*W2p x TQ)`` cotangent scratch. At stride-8
+feature resolution this holds for full Sintel and KITTI eval forward
+passes and for all reference training crop sizes. Residency is set by the
+*input* dtype: bfloat16 feature maps (the mixed-precision policy) halve
+the envelope; ``mxu_dtype`` alone only changes the per-chunk cast, not
+what is staged.
 
-Numerics: accumulation in float32 regardless of input dtype; parity with the
-jnp reference ``raft_tpu.models.corr.windowed_correlation`` is asserted in
-``tests/test_corr_pallas.py``.
+Numerics: accumulation in float32 regardless of input or MXU dtype; parity
+with the jnp reference ``raft_tpu.models.corr.windowed_correlation`` is
+asserted in ``tests/test_corr_pallas.py``.
 """
 
 from __future__ import annotations
@@ -67,25 +99,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Rows per banded chunk: one MXU matmul + unrolled sweep per chunk. 8 keeps
+# the dynamic-slice starts sublane-aligned for every 8-aligned level width.
+_CHUNK = 8
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _choose_tile(h2w2p: int, c: int) -> int:
-    """Query-tile size keeping the per-tile VMEM working set bounded.
+def _choose_tile(n: int) -> int:
+    """Query-tile (lane-axis) size. The banded pipeline's per-tile VMEM is
+    small (chunked matmuls, no full-level scratch), so the tile is sized
+    for grid-overhead amortization; lane-dim blocks must stay
+    128-divisible once the grid has more than one tile."""
+    return 256 if n >= 256 else 128
 
-    Budgeted for the *backward* pass (the larger of the two): fmap2 block +
-    df2 output block (both ``h2w2p * c``) + the g2 scratch (``h2w2p * tq``)
-    must co-reside. The forward reuses the same tile so the cotangent
-    layout always divides evenly."""
-    f2_bytes = h2w2p * c * 4
-    budget = 12 * 2 ** 20
-    if 2 * f2_bytes + 256 * h2w2p * 4 < budget:
-        return 256
-    # 128 is the floor: the query tile is the lane axis, and lane-dim blocks
-    # must be 128-divisible once the grid has more than one tile.
-    return 128
+
+def _mxu(mxu_dtype: str):
+    return jnp.bfloat16 if mxu_dtype == "bfloat16" else jnp.float32
 
 
 def _hat(dist: jnp.ndarray) -> jnp.ndarray:
@@ -98,116 +130,178 @@ def _x_iota(w2p: int, tq: int) -> jnp.ndarray:
         jnp.float32)
 
 
-def _fwd_kernel(cx_ref, cy_ref, f1_ref, f2_ref, out_ref, corr_ref, *,
-                radius: int, scale: bool, h2: int, w2p: int):
+def _band_chunks(cy, radius, h2l, nchunks, band):
+    """Chunk-index range [c_lo, c_hi) of target rows whose hat weight can
+    be nonzero for ANY query in the tile. Exact: row y contributes to
+    query n iff |y - cy_n - off| < 1 for some |off| <= r."""
+    if not band:
+        return jnp.int32(0), jnp.int32(nchunks)
+    lo = jnp.maximum(jnp.floor(jnp.min(cy)) - (radius + 1), 0.0)
+    hi = jnp.minimum(jnp.ceil(jnp.max(cy)) + (radius + 1),
+                     jnp.float32(h2l - 1))
+    c_lo = jnp.minimum(lo.astype(jnp.int32) // _CHUNK, nchunks)
+    c_hi = jnp.minimum(hi.astype(jnp.int32) // _CHUNK + 1, nchunks)
+    return c_lo, c_hi
+
+
+def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
+                levels: tuple, mxu_dtype: str, band: bool):
+    """refs = (f2_l0..f2_lN, out, t1_scratch); levels = ((h2l, h2lp, w2pl),…)
+    with h2lp the CHUNK-padded row count (padded rows are zero features →
+    zero contribution)."""
+    nl = len(levels)
+    f2_refs, out_ref, t1_ref = refs[:nl], refs[nl], refs[nl + 1]
     win = 2 * radius + 1
+    mdt = _mxu(mxu_dtype)
+    f1 = f1_ref[0].astype(mdt)                           # (TQ, C)
+    tq, c = f1.shape
+    cx0 = cx_ref[0].astype(jnp.float32)                  # (1, TQ)
+    cy0 = cy_ref[0].astype(jnp.float32)
+    inv_sqrt_c = 1.0 / (c ** 0.5)
+
+    for l, (h2l, h2lp, w2pl) in enumerate(levels):
+        cx = cx0 * (1.0 / 2 ** l)
+        cy = cy0 * (1.0 / 2 ** l)
+        nchunks = h2lp // _CHUNK
+        t1_ref[0:win * w2pl, :] = jnp.zeros((win * w2pl, tq), jnp.float32)
+        c_lo, c_hi = _band_chunks(cy, radius, h2l, nchunks, band)
+
+        def body(yc, _, l=l, w2pl=w2pl, cy=cy):
+            # The query tile's slice of the all-pairs volume for this row
+            # chunk: one MXU matmul, consumed immediately.
+            f2c = f2_refs[l][0, pl.ds(yc * (_CHUNK * w2pl), _CHUNK * w2pl), :]
+            corr = jax.lax.dot_general(
+                f2c.astype(mdt), f1, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # (CHUNK*W2PL, TQ)
+            y0f = (yc * _CHUNK).astype(jnp.float32)
+            for r_i in range(_CHUNK):
+                row = corr[r_i * w2pl:(r_i + 1) * w2pl, :]
+                for i in range(win):                     # y-offset index
+                    wy = _hat(y0f + r_i - (cy + (i - radius)))  # (1, TQ)
+                    t1_ref[i * w2pl:(i + 1) * w2pl, :] += wy * row
+            return 0
+
+        jax.lax.fori_loop(c_lo, c_hi, body, 0)
+
+        # x-side hat contraction → window rows in the reference order
+        # (core/corr.py delta grid: first window axis moves x).
+        xi = _x_iota(w2pl, tq)
+        rows = []
+        for a in range(win):                             # x-offset index
+            vx = _hat(xi - (cx + (a - radius)))          # (W2PL, TQ)
+            for b in range(win):                         # y-offset index
+                t1_b = t1_ref[b * w2pl:(b + 1) * w2pl, :]
+                rows.append(jnp.sum(t1_b * vx, axis=0, keepdims=True))
+        out = jnp.concatenate(rows, axis=0)              # (win*win, TQ)
+        if scale:
+            out = out * inv_sqrt_c
+        out_ref[0, l * win * win:(l + 1) * win * win, :] = out
+
+
+def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
+                levels: tuple, mxu_dtype: str, band: bool):
+    """refs = (f2_l0.., g, df1, df2_l0.., u_scratch). df2 blocks are
+    revisited across the query-tile grid axis: zeroed at tile 0, then
+    band-accumulated — no atomics."""
+    nl = len(levels)
+    f2_refs = refs[:nl]
+    g_ref = refs[nl]
+    df1_ref = refs[nl + 1]
+    df2_refs = refs[nl + 2:nl + 2 + nl]
+    u_ref = refs[nl + 2 + nl]
+    win = 2 * radius + 1
+    mdt = _mxu(mxu_dtype)
     f1 = f1_ref[0].astype(jnp.float32)                   # (TQ, C)
     tq, c = f1.shape
-    cx = cx_ref[0].astype(jnp.float32)                   # (1, TQ)
-    cy = cy_ref[0].astype(jnp.float32)
-
-    # The query tile's rows of the all-pairs volume, transposed: ONE large
-    # MXU matmul, held only in VMEM scratch (never HBM).
-    corr_ref[...] = jax.lax.dot_general(
-        f2_ref[0].astype(jnp.float32), f1, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (H2*W2P, TQ)
-
-    # y-sweep: fold each target row's correlation slice into the 2r+1
-    # y-offset accumulators with its scalar hat weight (pure VPU).
-    def body(y, t1):
-        corr_y = corr_ref[pl.ds(y * w2p, w2p), :]        # (W2P, TQ)
-        yf = y.astype(jnp.float32)
-        parts = []
-        for i in range(win):                             # y-offset index
-            wy = _hat(yf - (cy + (i - radius)))          # (1, TQ)
-            parts.append(wy * corr_y)
-        return t1 + jnp.concatenate(parts, axis=0)
-
-    t1 = jax.lax.fori_loop(
-        0, h2, body, jnp.zeros((win * w2p, tq), jnp.float32))
-
-    # x-side hat contraction → window rows in the reference order
-    # (core/corr.py delta grid: first window axis moves x).
-    xi = _x_iota(w2p, tq)
-    rows = []
-    for a in range(win):                                 # x-offset index
-        vx = _hat(xi - (cx + (a - radius)))              # (W2P, TQ)
-        for b in range(win):                             # y-offset index
-            t1_b = t1[b * w2p:(b + 1) * w2p, :]
-            rows.append(jnp.sum(t1_b * vx, axis=0, keepdims=True))
-    out = jnp.concatenate(rows, axis=0)                  # (win*win, TQ)
-    if scale:
-        out = out * (1.0 / (c ** 0.5))
-    out_ref[0] = out
-
-
-def _bwd_kernel(cx_ref, cy_ref, f1_ref, f2_ref, g_ref,
-                df1_ref, df2_ref, g2_ref, *,
-                radius: int, scale: bool, h2: int, w2p: int):
-    win = 2 * radius + 1
-    f1 = f1_ref[0].astype(jnp.float32)                   # (TQ, C)
-    tq, c = f1.shape
-    g = g_ref[0].astype(jnp.float32)                     # (win*win, TQ)
-    if scale:
-        g = g * (1.0 / (c ** 0.5))
-    cx = cx_ref[0].astype(jnp.float32)                   # (1, TQ)
-    cy = cy_ref[0].astype(jnp.float32)
-
-    # U_b[x, n] = sum_a g[a*win+b, n] * hat(x - cx - (a - r)) — the x-side
-    # adjoint, shared across the y sweep.
-    xi = _x_iota(w2p, tq)
-    u = []
-    for b in range(win):
-        acc = jnp.zeros((w2p, tq), jnp.float32)
-        for a in range(win):
-            vx = _hat(xi - (cx + (a - radius)))
-            acc = acc + g[a * win + b:a * win + b + 1, :] * vx
-        u.append(acc)
-    uflat = jnp.concatenate(u, axis=0)                   # (win*W2P, TQ)
-
-    # Assemble dL/d(corr tile) row-block by row-block into VMEM scratch…
-    def body(y, _):
-        yf = y.astype(jnp.float32)
-        g2y = jnp.zeros((w2p, tq), jnp.float32)
-        for b in range(win):
-            wy = _hat(yf - (cy + (b - radius)))          # (1, TQ)
-            g2y = g2y + wy * uflat[b * w2p:(b + 1) * w2p, :]
-        g2_ref[pl.ds(y * w2p, w2p), :] = g2y
-        return 0
-
-    jax.lax.fori_loop(0, h2, body, 0)
-
-    # …then both gradients are single MXU matmuls against the scratch.
-    g2 = g2_ref[...]                                     # (H2*W2P, TQ)
-    df1_ref[0] = jax.lax.dot_general(
-        g2, f2_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (TQ, C)
-    contrib = jax.lax.dot_general(
-        g2, f1, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (H2*W2P, C)
-
+    f1m = f1.astype(mdt)
+    cx0 = cx_ref[0].astype(jnp.float32)
+    cy0 = cy_ref[0].astype(jnp.float32)
     t = pl.program_id(1)
 
-    @pl.when(t == 0)
-    def _():
-        df2_ref[0] = contrib
+    df1 = jnp.zeros((tq, c), jnp.float32)
+    for l, (h2l, h2lp, w2pl) in enumerate(levels):
+        cx = cx0 * (1.0 / 2 ** l)
+        cy = cy0 * (1.0 / 2 ** l)
+        nchunks = h2lp // _CHUNK
+        g = g_ref[0, l * win * win:(l + 1) * win * win, :].astype(
+            jnp.float32)                                 # (win*win, TQ)
+        if scale:
+            g = g * (1.0 / (c ** 0.5))
 
-    @pl.when(t != 0)
-    def _():
-        df2_ref[0] = df2_ref[0] + contrib
+        # U_b[x, n] = sum_a g[a*win+b, n] * hat(x - cx_n - (a - r)) — the
+        # x-side adjoint, shared across the y sweep.
+        xi = _x_iota(w2pl, tq)
+        for b in range(win):
+            acc = jnp.zeros((w2pl, tq), jnp.float32)
+            for a in range(win):
+                vx = _hat(xi - (cx + (a - radius)))
+                acc = acc + g[a * win + b:a * win + b + 1, :] * vx
+            u_ref[b * w2pl:(b + 1) * w2pl, :] = acc
+
+        @pl.when(t == 0)
+        def _(l=l):
+            df2_refs[l][0] = jnp.zeros_like(df2_refs[l][0])
+
+        c_lo, c_hi = _band_chunks(cy, radius, h2l, nchunks, band)
+
+        def body(yc, df1_in, l=l, w2pl=w2pl, cy=cy):
+            base = yc * (_CHUNK * w2pl)
+            y0f = (yc * _CHUNK).astype(jnp.float32)
+            # Assemble dL/d(corr chunk) from the adjoint with y-side hats.
+            g2_rows = []
+            for r_i in range(_CHUNK):
+                g2y = jnp.zeros((w2pl, tq), jnp.float32)
+                for b in range(win):
+                    wy = _hat(y0f + r_i - (cy + (b - radius)))
+                    g2y = g2y + wy * u_ref[b * w2pl:(b + 1) * w2pl, :]
+                g2_rows.append(g2y)
+            g2 = jnp.concatenate(g2_rows, axis=0)        # (CHUNK*W2PL, TQ)
+            f2c = f2_refs[l][0, pl.ds(base, _CHUNK * w2pl), :]
+            df1_out = df1_in + jax.lax.dot_general(
+                g2.astype(mdt), f2c.astype(mdt), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # (TQ, C)
+            contrib = jax.lax.dot_general(
+                g2.astype(mdt), f1m, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # (CHUNK*W2PL, C)
+            df2_refs[l][0, pl.ds(base, _CHUNK * w2pl), :] += contrib
+            return df1_out
+
+        df1 = jax.lax.fori_loop(c_lo, c_hi, body, df1)
+    df1_ref[0] = df1
 
 
-def _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
-    """f1: (B, Np, C); f2: (B, H2*W2p, C); cx/cy: (B, 1, Np); Np % tq == 0.
-    Returns (B, win*win, Np) — query-minor; transposed by the wrapper."""
+def _level_geometry(pyramid_shapes):
+    """Per-level (h2l, h2lp, w2pl): width padded to sublane alignment,
+    rows padded to the chunk size (both paddings are zero features →
+    exactly zero contribution)."""
+    levels = []
+    for (h2, w2) in pyramid_shapes:
+        w2p = _round_up(w2, 8)
+        h2p = _round_up(h2, _CHUNK)
+        levels.append((h2, h2p, w2p))
+    return tuple(levels)
+
+
+def _pad_level(f2, h2p, w2p):
+    b, h2, w2, c = f2.shape
+    f2 = jnp.pad(f2, ((0, 0), (0, h2p - h2), (0, w2p - w2), (0, 0)))
+    return f2.reshape(b, h2p * w2p, c)
+
+
+def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
+                mxu_dtype, band):
+    """f1: (B, Np, C); f2s: per-level (B, H2lp*W2lp, C); cx/cy: (B, 1, Np)
+    at level-0 scale; Np % tq == 0. Returns (B, L*win*win, Np) —
+    query-minor; transposed by the wrapper."""
     b, np_, c = f1.shape
-    h2w2p = f2.shape[1]
-    h2 = h2w2p // w2p
     win = 2 * radius + 1
+    nl = len(levels)
     grid = (b, np_ // tq)
+    w2p_max = max(w2pl for (_, _, w2pl) in levels)
 
     kernel = functools.partial(_fwd_kernel, radius=radius, scale=scale,
-                               h2=h2, w2p=w2p)
+                               levels=levels, mxu_dtype=mxu_dtype,
+                               band=band)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -215,24 +309,30 @@ def _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
             pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
             pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
             pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
-            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
+        ] + [
+            pl.BlockSpec((1, f2.shape[1], c), lambda bi, ti: (bi, 0, 0))
+            for f2 in f2s
         ],
-        out_specs=pl.BlockSpec((1, win * win, tq), lambda bi, ti: (bi, 0, ti)),
-        out_shape=jax.ShapeDtypeStruct((b, win * win, np_), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((h2w2p, tq), jnp.float32)],
+        out_specs=pl.BlockSpec((1, nl * win * win, tq),
+                               lambda bi, ti: (bi, 0, ti)),
+        out_shape=jax.ShapeDtypeStruct((b, nl * win * win, np_),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((win * w2p_max, tq), jnp.float32)],
         interpret=interpret,
-    )(cx, cy, f1, f2)
+    )(cx, cy, f1, *f2s)
 
 
-def _pallas_bwd(f1, f2, cx, cy, g, radius, scale, interpret, w2p, tq):
+def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
+                mxu_dtype, band):
     b, np_, c = f1.shape
-    h2w2p = f2.shape[1]
-    h2 = h2w2p // w2p
     win = 2 * radius + 1
+    nl = len(levels)
     grid = (b, np_ // tq)
+    w2p_max = max(w2pl for (_, _, w2pl) in levels)
 
     kernel = functools.partial(_bwd_kernel, radius=radius, scale=scale,
-                               h2=h2, w2p=w2p)
+                               levels=levels, mxu_dtype=mxu_dtype,
+                               band=band)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -240,78 +340,94 @@ def _pallas_bwd(f1, f2, cx, cy, g, radius, scale, interpret, w2p, tq):
             pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
             pl.BlockSpec((1, 1, tq), lambda bi, ti: (bi, 0, ti)),
             pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
-            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
-            pl.BlockSpec((1, win * win, tq), lambda bi, ti: (bi, 0, ti)),
+        ] + [
+            pl.BlockSpec((1, f2.shape[1], c), lambda bi, ti: (bi, 0, 0))
+            for f2 in f2s
+        ] + [
+            pl.BlockSpec((1, nl * win * win, tq),
+                         lambda bi, ti: (bi, 0, ti)),
         ],
         out_specs=[
             pl.BlockSpec((1, tq, c), lambda bi, ti: (bi, ti, 0)),
-            pl.BlockSpec((1, h2w2p, c), lambda bi, ti: (bi, 0, 0)),
+        ] + [
+            pl.BlockSpec((1, f2.shape[1], c), lambda bi, ti: (bi, 0, 0))
+            for f2 in f2s
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, np_, c), jnp.float32),
-            jax.ShapeDtypeStruct((b, h2w2p, c), jnp.float32),
+        ] + [
+            jax.ShapeDtypeStruct(f2.shape, jnp.float32) for f2 in f2s
         ],
-        scratch_shapes=[pltpu.VMEM((h2w2p, tq), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((win * w2p_max, tq), jnp.float32)],
         interpret=interpret,
-    )(cx, cy, f1, f2, g)
+    )(cx, cy, f1, *f2s, g)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _windowed(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
-    return _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
+              mxu_dtype, band):
+    return _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
+                       tq, mxu_dtype, band)
 
 
-def _windowed_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq):
-    out = _pallas_fwd(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
-    return out, (f1, f2, cx, cy)
+def _windowed_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
+                  mxu_dtype, band):
+    out = _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
+                      tq, mxu_dtype, band)
+    return out, (f1, f2s, cx, cy)
 
 
-def _windowed_bwd(radius, scale, interpret, w2p, tq, res, g):
-    f1, f2, cx, cy = res
-    df1, df2 = _pallas_bwd(f1, f2, cx, cy, g, radius, scale, interpret,
-                           w2p, tq)
+def _windowed_bwd(radius, scale, interpret, levels, tq, mxu_dtype, band,
+                  res, g):
+    f1, f2s, cx, cy = res
+    grads = _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret,
+                        levels, tq, mxu_dtype, band)
+    df1, df2s = grads[0], grads[1:]
     # Zero coordinate gradient — the contract of the reference extension
     # (correlation_kernel.cu:307) and of the detach-per-iteration scan.
-    return (df1.astype(f1.dtype), df2.astype(f2.dtype),
+    return (df1.astype(f1.dtype),
+            tuple(df2.astype(f2.dtype) for df2, f2 in zip(df2s, f2s)),
             jnp.zeros_like(cx), jnp.zeros_like(cy))
 
 
 _windowed.defvjp(_windowed_fwd, _windowed_bwd)
 
 
-def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
-                                coords: jnp.ndarray, radius: int,
-                                scale: bool = True,
-                                interpret: bool | None = None) -> jnp.ndarray:
-    """Drop-in Pallas replacement for
-    ``raft_tpu.models.corr.windowed_correlation``.
+def windowed_correlation_pallas_fused(
+        fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray, radius: int,
+        scale: bool = True, mxu_dtype: str = "float32",
+        interpret: bool | None = None, band: bool = True) -> jnp.ndarray:
+    """All pyramid levels of the on-demand windowed lookup in ONE fused
+    Pallas launch; numerically identical to concatenating
+    ``raft_tpu.models.corr.windowed_correlation`` over the levels with
+    ``coords / 2**level``.
 
     Args:
       fmap1: ``(B, H, W, C)`` query features.
-      fmap2: ``(B, H2, W2, C)`` target features (one pyramid level).
-      coords: ``(B, H, W, 2)`` pixel coords (x, y) at fmap2's scale.
-      radius: lookup radius r; output window is ``(2r+1)^2``.
+      pyramid2: sequence of ``(B, H2l, W2l, C)`` pooled target levels.
+      coords: ``(B, H, W, 2)`` pixel coords (x, y) at LEVEL-0 scale (the
+        kernel applies the per-level ``1/2^l``).
+      radius: lookup radius r; per-level window is ``(2r+1)^2``.
       scale: divide by ``sqrt(C)`` (reference ``core/corr.py:61``).
-      interpret: force Pallas interpreter mode (defaults to True off-TPU so
-        the same tests run on CPU).
+      mxu_dtype: ``'float32'`` or ``'bfloat16'`` operands for the
+        correlation matmuls (accumulation is always float32).
+      interpret: force Pallas interpreter mode (defaults to True off-TPU
+        so the same tests run on CPU).
+      band: dynamic y-band skipping (exact; disable only for debugging).
 
     Returns:
-      ``(B, H, W, (2r+1)^2)`` float32 correlation features.
+      ``(B, H, W, L*(2r+1)^2)`` float32, level-major on the last axis.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, h, w, c = fmap1.shape
-    _, h2, w2, _ = fmap2.shape
     win = 2 * radius + 1
-
-    # Pad W2 to sublane alignment; zero columns get zero hat weight, which
-    # preserves zeros-padding semantics.
-    w2p = _round_up(w2, 8)
-    f2 = jnp.pad(fmap2, ((0, 0), (0, 0), (0, w2p - w2), (0, 0)))
-    f2 = f2.reshape(b, h2 * w2p, c)
+    levels = _level_geometry([f2.shape[1:3] for f2 in pyramid2])
+    f2s = tuple(_pad_level(f2, h2p, w2p)
+                for f2, (_, h2p, w2p) in zip(pyramid2, levels))
 
     n = h * w
-    tq = min(_choose_tile(h2 * w2p, c), _round_up(n, 8))
+    tq = min(_choose_tile(n), _round_up(n, 128))
     np_ = _round_up(n, tq)
     f1 = fmap1.reshape(b, n, c)
     f1 = jnp.pad(f1, ((0, 0), (0, np_ - n), (0, 0)))
@@ -320,6 +436,24 @@ def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     cx = cf[..., 0][:, None, :]                          # (B, 1, Np)
     cy = cf[..., 1][:, None, :]
 
-    out = _windowed(f1, f2, cx, cy, radius, scale, interpret, w2p, tq)
-    out = jnp.swapaxes(out, 1, 2)                        # (B, Np, win*win)
-    return out[:, :n].reshape(b, h, w, win * win)
+    out = _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
+                    mxu_dtype, band)
+    out = jnp.swapaxes(out, 1, 2)                        # (B, Np, L*win*win)
+    return out[:, :n].reshape(b, h, w, len(levels) * win * win)
+
+
+def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
+                                coords: jnp.ndarray, radius: int,
+                                scale: bool = True,
+                                interpret: bool | None = None,
+                                mxu_dtype: str = "float32",
+                                band: bool = True) -> jnp.ndarray:
+    """Single-level wrapper of the fused kernel — drop-in Pallas
+    replacement for ``raft_tpu.models.corr.windowed_correlation``
+    (``coords`` already at ``fmap2``'s scale).
+
+    Returns ``(B, H, W, (2r+1)^2)`` float32 correlation features.
+    """
+    return windowed_correlation_pallas_fused(
+        fmap1, (fmap2,), coords, radius, scale=scale, mxu_dtype=mxu_dtype,
+        interpret=interpret, band=band)

@@ -131,3 +131,101 @@ def test_under_jit_and_vmapless_batching(rng):
     ref = windowed_correlation(f1, f2, coords, r)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def _jnp_multilevel(f1, pyr, coords, radius, scale=True):
+    ref = [windowed_correlation(f1, f2, coords / (2 ** l), radius, scale)
+           for l, f2 in enumerate(pyr)]
+    return jnp.concatenate(ref, axis=-1)
+
+
+def test_fused_multilevel_matches_jnp(rng):
+    # The fused single-launch kernel over a 4-level pyramid == per-level
+    # jnp reference with coords/2^l (the alternate_lookup contract).
+    B, C, H, W, r = 2, 32, 16, 24, 4
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(
+        rng.uniform(-2.0, max(H, W) + 1.0, (B, H, W, 2)), jnp.float32)
+    pyr = build_feature_pyramid(f2, 4)
+
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+    got = windowed_correlation_pallas_fused(f1, pyr, coords, r,
+                                            interpret=True)
+    ref = _jnp_multilevel(f1, pyr, coords, r)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_band_skipping_is_exact(rng):
+    # The dynamic y-band skips rows whose hat weights are identically
+    # zero — band on/off must agree bit-for-bit even with coords far
+    # outside the image (empty band => all-zero windows).
+    B, C, H, W, r = 1, 16, 8, 16, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+    pyr = build_feature_pyramid(f2, 2)
+    for lo, hi in ((-3.0, H + 2.0), (100.0, 200.0), (-50.0, -20.0)):
+        coords = jnp.asarray(rng.uniform(lo, hi, (B, H, W, 2)), jnp.float32)
+        banded = windowed_correlation_pallas_fused(
+            f1, pyr, coords, r, interpret=True, band=True)
+        full = windowed_correlation_pallas_fused(
+            f1, pyr, coords, r, interpret=True, band=False)
+        np.testing.assert_array_equal(np.asarray(banded), np.asarray(full))
+        ref = _jnp_multilevel(f1, pyr, coords, r)
+        np.testing.assert_allclose(np.asarray(banded), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fused_multilevel_gradients(rng):
+    B, C, H, W, r, L = 1, 16, 8, 12, 3, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(0, 8, (B, H, W, 2)), jnp.float32)
+    cot = _rand(rng, B, H, W, L * (2 * r + 1) ** 2)
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+
+    def loss_ref(a, b):
+        pyr = build_feature_pyramid(b, L)
+        return jnp.sum(_jnp_multilevel(a, pyr, coords, r) * cot)
+
+    def loss_pl(a, b):
+        pyr = build_feature_pyramid(b, L)
+        return jnp.sum(windowed_correlation_pallas_fused(
+            a, pyr, coords, r, interpret=True) * cot)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(f1, f2)
+    g_pl = jax.grad(loss_pl, argnums=(0, 1))(f1, f2)
+    for a, b in zip(g_ref, g_pl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_mxu_operands_close_to_f32(rng):
+    # bf16 MXU operands (f32 accumulation) stay within bf16 rounding of
+    # the f32 kernel — forward and gradients.
+    B, C, H, W, r = 1, 32, 8, 12, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(0, 8, (B, H, W, 2)), jnp.float32)
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+    pyr = build_feature_pyramid(f2, 2)
+    f32 = windowed_correlation_pallas_fused(f1, pyr, coords, r,
+                                            interpret=True)
+    b16 = windowed_correlation_pallas_fused(f1, pyr, coords, r,
+                                            mxu_dtype="bfloat16",
+                                            interpret=True)
+    # dot of C=32 bf16 products: relative error ~ C_eps ≈ 1e-2
+    np.testing.assert_allclose(np.asarray(b16), np.asarray(f32),
+                               rtol=0.05, atol=0.05)
+
+    g16 = jax.grad(lambda a, b: jnp.sum(windowed_correlation_pallas_fused(
+        a, build_feature_pyramid(b, 2), coords, r, mxu_dtype="bfloat16",
+        interpret=True)), argnums=(0, 1))(f1, f2)
+    gf = jax.grad(lambda a, b: jnp.sum(windowed_correlation_pallas_fused(
+        a, build_feature_pyramid(b, 2), coords, r,
+        interpret=True)), argnums=(0, 1))(f1, f2)
+    for a, b in zip(gf, g16):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=0.1, atol=0.1)
