@@ -12,11 +12,38 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
 # (reference core/update.py:121, core/raft.py:74-85).
 UPSAMPLE_MASK_CHANNELS = 9 * 8 * 8
+
+
+def _concat_conv(x, convs, padding, dtype):
+    """Run several same-geometry convs over the SAME input as ONE conv by
+    concatenating their kernels along the output-channel axis, then split.
+
+    Exact: each output channel's dot product is unchanged. The param tree
+    (and hence the torch-weight mapping) is untouched — the concat reads
+    the child convs' existing parameters, and XLA hoists this
+    loop-invariant weight concat out of the refinement scan. Motivation:
+    at batch 1 the per-iteration profile is ~500 small kernels (VERDICT
+    r2 #3); merging same-input convs halves the GRU's gate launches and
+    doubles their MXU N-dimension.
+    """
+    ks, bs = [], []
+    for c in convs:
+        p = c.variables["params"]
+        ks.append(p["kernel"])
+        bs.append(p["bias"])
+    k = jnp.concatenate(ks, axis=-1).astype(dtype)
+    b = jnp.concatenate(bs).astype(dtype)
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype), k, (1, 1), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+    return jnp.split(y, len(convs), axis=-1)
 
 
 class FlowHead(nn.Module):
@@ -50,8 +77,13 @@ class ConvGRU(nn.Module):
 
     def __call__(self, h, x):
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(self.convz(hx))
-        r = nn.sigmoid(self.convr(hx))
+        if self.is_initializing():
+            z = nn.sigmoid(self.convz(hx))
+            r = nn.sigmoid(self.convr(hx))
+        else:
+            cz, cr = _concat_conv(hx, (self.convz, self.convr),
+                                  ((1, 1), (1, 1)), self.dtype)
+            z, r = nn.sigmoid(cz), nn.sigmoid(cr)
         q = nn.tanh(self.convq(jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
 
@@ -72,18 +104,22 @@ class SepConvGRU(nn.Module):
         self.convr2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0), dtype=d)
         self.convq2 = nn.Conv(self.hidden_dim, (5, 1), padding=(2, 0), dtype=d)
 
-    def __call__(self, h, x):
+    def _step(self, h, x, convz, convr, convq, padding):
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(self.convz1(hx))
-        r = nn.sigmoid(self.convr1(hx))
-        q = nn.tanh(self.convq1(jnp.concatenate([r * h, x], axis=-1)))
-        h = (1 - z) * h + z * q
-
-        hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(self.convz2(hx))
-        r = nn.sigmoid(self.convr2(hx))
-        q = nn.tanh(self.convq2(jnp.concatenate([r * h, x], axis=-1)))
+        if self.is_initializing():
+            z = nn.sigmoid(convz(hx))
+            r = nn.sigmoid(convr(hx))
+        else:
+            cz, cr = _concat_conv(hx, (convz, convr), padding, self.dtype)
+            z, r = nn.sigmoid(cz), nn.sigmoid(cr)
+        q = nn.tanh(convq(jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
+
+    def __call__(self, h, x):
+        h = self._step(h, x, self.convz1, self.convr1, self.convq1,
+                       ((0, 0), (2, 2)))
+        return self._step(h, x, self.convz2, self.convr2, self.convq2,
+                          ((2, 2), (0, 0)))
 
 
 class SmallMotionEncoder(nn.Module):
@@ -172,17 +208,30 @@ class BasicUpdateBlock(nn.Module):
         motion_features = self.encoder(flow, corr)
         inp = jnp.concatenate([inp, motion_features], axis=-1)
         net = self.gru(net, inp)
-        delta_flow = self.flow_head(net)
 
         # 0.25 balances gradients into the mask head (core/update.py:133).
         def _mask(mdl, n):
             return 0.25 * mdl.mask_conv2(nn.relu(mdl.mask_conv1(n)))
 
-        if isinstance(compute_mask, bool) or self.is_initializing():
+        if self.is_initializing():
+            delta_flow = self.flow_head(net)
             mask = _mask(self, net)
+        elif isinstance(compute_mask, (bool, np.bool_)):
+            # Static flag (training): the pre-existing contract is that a
+            # Python bool — True OR False — computes the real mask head.
+            # Flow head and mask head share their input, so merge their
+            # first 3x3 convs (both 256-out) into one launch
+            # (see _concat_conv).
+            f_hid, m_hid = _concat_conv(
+                net, (self.flow_head.conv1, self.mask_conv1),
+                ((1, 1), (1, 1)), self.dtype)
+            delta_flow = self.flow_head.conv2(nn.relu(f_hid))
+            mask = 0.25 * self.mask_conv2(nn.relu(m_hid))
         else:
+            delta_flow = self.flow_head(net)
             mask = nn.cond(compute_mask, _mask,
                            lambda mdl, n: jnp.zeros(
-                               n.shape[:3] + (UPSAMPLE_MASK_CHANNELS,), n.dtype),
+                               n.shape[:3] + (UPSAMPLE_MASK_CHANNELS,),
+                               n.dtype),
                            self, net)
         return net, mask, delta_flow

@@ -432,7 +432,11 @@ def windowed_correlation_pallas_fused(
     f1 = fmap1.reshape(b, n, c)
     f1 = jnp.pad(f1, ((0, 0), (0, np_ - n), (0, 0)))
     cf = coords.reshape(b, n, 2)
-    cf = jnp.pad(cf, ((0, 0), (0, np_ - n), (0, 0)))
+    # Edge-pad (replicate the last real coordinate) rather than zero-pad:
+    # padded queries contribute nothing (their f1 rows and cotangents are
+    # zero), but a zero cy would drag the tail tile's y-band up to row 0
+    # and defeat the band skip for queries near the image bottom.
+    cf = jnp.pad(cf, ((0, 0), (0, np_ - n), (0, 0)), mode="edge")
     cx = cf[..., 0][:, None, :]                          # (B, 1, Np)
     cy = cf[..., 1][:, None, :]
 

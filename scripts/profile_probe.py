@@ -71,8 +71,33 @@ def headline():
     _run(fwd, img, img)
 
 
+def sparse_b8():
+    """VERDICT r2 #6: sparse_train b4->b8 doubles step time with flat
+    samples/s and non-monotonic peak HBM. Per-op breakdown of one train
+    step at both batches to name the op that doubles."""
+    from raft_tpu.config import OursConfig, TrainConfig
+    from raft_tpu.models import SparseRAFT
+    from raft_tpu.parallel import create_train_state, make_train_step
+
+    H, W = 352, 480
+    rng = jax.random.PRNGKey(0)
+    for batch in (4, 8):
+        tcfg = TrainConfig(batch_size=batch, image_size=(H, W),
+                           model_family="sparse", iters=6,
+                           sparse_lambda=0.1)
+        model = SparseRAFT(OursConfig(mixed_precision=True))
+        state = create_train_state(rng, model, tcfg, (H, W))
+        step_fn = make_train_step(tcfg, donate=False)
+        b = {"image1": jnp.ones((batch, H, W, 3)) * 127.0,
+             "image2": jnp.ones((batch, H, W, 3)) * 127.0,
+             "flow": jnp.zeros((batch, H, W, 2)),
+             "valid": jnp.ones((batch, H, W))}
+        print(f"=== sparse_train step b{batch} {H}x{W}")
+        _run(lambda s: step_fn(s, b, rng)[1]["loss"], state)
+
+
 if __name__ == "__main__":
     names = sys.argv[1:] or ["msda", "headline"]
     print("devices:", jax.devices(), flush=True)
     for n in names:
-        {"msda": msda, "headline": headline}[n]()
+        {"msda": msda, "headline": headline, "sparse_b8": sparse_b8}[n]()

@@ -323,10 +323,41 @@ def encoder_family() -> dict:
     return out
 
 
+def msda_threshold() -> dict:
+    """Measure the MSDA backend crossover across the dispatch boundary
+    (VERDICT r2 #9: ``_PALLAS_MIN_QUERIES = 512`` was picked, not
+    measured — the round-2 crossover data points were 2640/10560 tokens
+    only). Raw op timing, fresh jit per arm, dense-regime value map
+    (stride-8 grid of the fork's training res, d_model=128, 8 heads)."""
+    from raft_tpu.ops.msda import ms_deform_attn
+
+    h, w, m, d, p, L = 44, 60, 8, 16, 4, 1
+    s = h * w
+    shapes = ((h, w),)
+    rng = jax.random.PRNGKey(0)
+    value = jax.random.normal(rng, (1, s, m, d), jnp.float32)
+    out = {"value_tokens": s, "heads": m, "head_dim": d,
+           "current_threshold": 512}
+    for lq in (128, 256, 512, 1024, 2048, s):
+        loc = jax.random.uniform(jax.random.PRNGKey(lq),
+                                 (1, lq, m, L, p, 2), jnp.float32)
+        wts = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(lq + 1),
+                              (1, lq, m, L, p)), axis=-1)
+        for backend in ("jnp", "pallas"):
+            def arm(v, l, a, _b=backend):
+                return jnp.sum(ms_deform_attn(v, shapes, l, a, backend=_b))
+            compiled = _compile(jax.jit(arm), value, loc, wts)
+            dt = _time(compiled, value, loc, wts)
+            out[f"lq{lq}_{backend}_us"] = round(dt * 1e6, 1)
+    return out
+
+
 SECTIONS = {"sparse_train": sparse_train, "raft_train": raft_train,
             "kitti_eval": kitti_eval, "volume_memory": volume_memory,
             "batch1": batch1, "msda_dense": msda_dense,
-            "encoder_family": encoder_family}
+            "encoder_family": encoder_family,
+            "msda_threshold": msda_threshold}
 
 
 def main(argv):
