@@ -43,12 +43,16 @@ class RAFTConfig:
     # Storage dtype of the materialized correlation pyramid. The volume and
     # its avg-pools are always *computed* in float32 (the reference exempts
     # the volume from autocast, core/raft.py:100-103); this controls only
-    # how the pyramid is stored between refinement iterations. The default
-    # "float32" preserves the reference's autocast regions exactly, even
-    # under mixed_precision. "bfloat16" halves the HBM footprint and read
-    # traffic of the framework's dominant memory object (~0.3% relative
-    # flow change at Sintel scale); "auto" = bfloat16 iff mixed_precision.
-    corr_dtype: str = "float32"     # float32 | bfloat16 | auto
+    # how the pyramid is stored between refinement iterations. "bfloat16"
+    # halves the HBM footprint and read traffic of the framework's
+    # dominant memory object. The default "auto" = bfloat16 iff
+    # mixed_precision AND inference (test_mode): measured flow delta at
+    # Sintel resolution is mean 0.0026 px / max 0.0093 px (BASELINE.md,
+    # round 3) — far inside the 0.02 parity band — while *training* keeps
+    # the reference's autocast-exempt f32 volume so gradient numerics
+    # match train_mixed.sh semantics exactly. "float32" forces the old
+    # default everywhere.
+    corr_dtype: str = "auto"        # auto | float32 | bfloat16
     # Operand dtype of the on-demand (alternate_corr) Pallas kernel's
     # correlation matmuls. Accumulation is always float32; "bfloat16"
     # operands quadruple MXU throughput with the same contract as the
@@ -100,11 +104,11 @@ class RAFTConfig:
     def radius(self) -> int:
         return 3 if self.small else self.corr_radius
 
-    @property
-    def corr_storage_dtype(self):
+    def corr_storage(self, inference: bool):
         import jax.numpy as jnp
         if self.corr_dtype == "auto":
-            return jnp.bfloat16 if self.mixed_precision else jnp.float32
+            return (jnp.bfloat16 if (self.mixed_precision and inference)
+                    else jnp.float32)
         return jnp.dtype(self.corr_dtype)
 
     @property

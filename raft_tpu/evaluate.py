@@ -429,7 +429,7 @@ def load_predictor(model_path: str, small: bool = False,
                    mixed_precision: bool = False,
                    iters: int = 32,
                    model_family: str = "raft",
-                   corr_dtype: str = "float32",
+                   corr_dtype: str = "auto",
                    spatial_shards: int = 1) -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
@@ -506,7 +506,7 @@ def _raft_only_selections(small, alternate_corr, corr_dtype):
     canonical RAFT family: ``(name, non-default?)`` pairs."""
     return (("small", small),
             ("alternate_corr", alternate_corr),
-            ("corr_dtype", corr_dtype != "float32"))
+            ("corr_dtype", corr_dtype not in ("float32", "auto")))
 
 
 def reject_raft_only_flags(parser, args) -> None:
@@ -549,7 +549,7 @@ def main(argv=None):
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--warm_start", action="store_true")
-    parser.add_argument("--corr_dtype", default="float32",
+    parser.add_argument("--corr_dtype", default="auto",
                         choices=["float32", "bfloat16", "auto"],
                         help="storage dtype of the correlation pyramid "
                              "(float32 = reference autocast semantics; "

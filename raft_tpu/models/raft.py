@@ -79,20 +79,22 @@ class _UpdateStep(nn.Module):
         return (net, coords1, up_mask), ()
 
 
-def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2):
+def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2, inference: bool):
     """Precompute the scan-invariant correlation state.
 
     All-pairs mode: the pooled 4D-volume pyramid (tuple of arrays).
     Alternate mode: fmap1 + the pooled fmap2 pyramid (tuple of arrays).
     Returned as plain pytrees so they can cross ``nn.scan`` as broadcast
-    arguments.
+    arguments. ``inference`` resolves corr_dtype="auto" (bf16 storage is
+    an inference-only lever; training keeps the reference's
+    autocast-exempt f32 volume — see RAFTConfig.corr_dtype).
     """
     if cfg.alternate_corr:
         return ("alt", (fmap1, corr.build_feature_pyramid(
             fmap2, cfg.corr_levels)))
     return ("allpairs", corr.build_corr_pyramid(
         fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
-        cfg.corr_storage_dtype))
+        cfg.corr_storage(inference)))
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
@@ -157,7 +159,8 @@ class RAFT(nn.Module):
                           train=norm_train, deterministic=not train)
         fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
 
-        corr_state = _build_corr_state(cfg, fmap1, fmap2)
+        corr_state = _build_corr_state(cfg, fmap1, fmap2,
+                                       inference=bool(test_mode))
 
         cnet_out = self.cnet(image1, train=norm_train,
                              deterministic=not train)

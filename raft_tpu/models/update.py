@@ -14,7 +14,6 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
 # (reference core/update.py:121, core/raft.py:74-85).
@@ -216,9 +215,11 @@ class BasicUpdateBlock(nn.Module):
         if self.is_initializing():
             delta_flow = self.flow_head(net)
             mask = _mask(self, net)
-        elif isinstance(compute_mask, (bool, np.bool_)):
+        elif isinstance(compute_mask, bool):
             # Static flag (training): the pre-existing contract is that a
             # Python bool — True OR False — computes the real mask head.
+            # (Plain bool only, matching _UpdateStep's check in raft.py —
+            # np.bool_ flags go through nn.cond: correct, just unfused.)
             # Flow head and mask head share their input, so merge their
             # first 3x3 convs (both 256-out) into one launch
             # (see _concat_conv).

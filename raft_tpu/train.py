@@ -294,7 +294,7 @@ def main(argv=None):
     parser.add_argument("--add_noise", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--alternate_corr", action="store_true")
-    parser.add_argument("--corr_dtype", default="float32",
+    parser.add_argument("--corr_dtype", default="auto",
                         choices=["float32", "bfloat16", "auto"],
                         help="storage dtype of the correlation pyramid "
                              "(float32 = reference autocast semantics; "

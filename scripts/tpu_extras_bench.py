@@ -42,6 +42,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Same persistent compilation cache as bench.py — warm re-runs inside a
+# tunnel window spend seconds, not minutes, compiling.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import jax
 
 # The axon plugin pins jax_platforms in jax.config at interpreter
@@ -329,6 +338,7 @@ def msda_threshold() -> dict:
     measured — the round-2 crossover data points were 2640/10560 tokens
     only). Raw op timing, fresh jit per arm, dense-regime value map
     (stride-8 grid of the fork's training res, d_model=128, 8 heads)."""
+    from raft_tpu.ops import msda
     from raft_tpu.ops.msda import ms_deform_attn
 
     h, w, m, d, p, L = 44, 60, 8, 16, 4, 1
@@ -337,7 +347,7 @@ def msda_threshold() -> dict:
     rng = jax.random.PRNGKey(0)
     value = jax.random.normal(rng, (1, s, m, d), jnp.float32)
     out = {"value_tokens": s, "heads": m, "head_dim": d,
-           "current_threshold": 512}
+           "current_threshold": msda._PALLAS_MIN_QUERIES}
     for lq in (128, 256, 512, 1024, 2048, s):
         loc = jax.random.uniform(jax.random.PRNGKey(lq),
                                  (1, lq, m, L, p, 2), jnp.float32)
