@@ -193,11 +193,21 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     if backend not in ("auto", "jnp", "pallas"):
         raise ValueError(f"unknown correlation backend {backend!r} "
                          f"(want 'auto', 'jnp' or 'pallas')")
+    from raft_tpu.ops.corr_pallas import (fused_eligible,
+                                          windowed_correlation_pallas_fused)
+    shapes = [f2.shape[1:3] for f2 in pyramid2]
+    channels = fmap1.shape[-1]
+    dtype_bytes = jnp.dtype(pyramid2[0].dtype).itemsize
+    eligible = fused_eligible(shapes, channels, dtype_bytes, radius)
+    if backend == "pallas" and not eligible:
+        raise ValueError(
+            "backend='pallas' but the pooled levels don't fit the "
+            f"kernel's VMEM-resident layout (levels {list(shapes)}, "
+            f"C={channels}); see corr_pallas.fused_eligible")
     use_pallas = backend == "pallas" or (
-        backend == "auto" and jax.default_backend() == "tpu")
+        backend == "auto" and eligible
+        and jax.default_backend() == "tpu")
     if use_pallas:
-        from raft_tpu.ops.corr_pallas import (
-            windowed_correlation_pallas_fused)
         return windowed_correlation_pallas_fused(
             fmap1, tuple(pyramid2), coords, radius, scale=scale,
             mxu_dtype=mxu_dtype)

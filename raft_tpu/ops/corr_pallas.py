@@ -393,10 +393,31 @@ def _windowed_bwd(radius, scale, interpret, levels, tq, mxu_dtype, band,
 _windowed.defvjp(_windowed_fwd, _windowed_bwd)
 
 
+def fused_eligible(pyramid_shapes, channels: int,
+                   dtype_bytes: int = 4, radius: int = 4) -> bool:
+    """Whether the kernel's VMEM-resident layout holds for these levels:
+    every pooled target level stays resident for a whole batch element,
+    plus the per-tile scratch. Forward-pass residency (the eval path);
+    a full-resolution *backward* additionally keeps the df2 blocks
+    resident — training always runs on crops (SURVEY.md §2.5), which fit
+    with a wide margin."""
+    total = 0
+    w2p_max = 8
+    for (h2, w2) in pyramid_shapes:
+        w2p = _round_up(w2, 8)
+        w2p_max = max(w2p_max, w2p)
+        total += _round_up(h2, _CHUNK) * w2p * channels * dtype_bytes
+    # t1/u accumulator scratch at the actual window size, tq=256, f32 —
+    # doubled for margin (chunk matmul operands, out block)
+    scratch = 2 * (2 * radius + 1) * w2p_max * 256 * 4
+    return total + scratch <= 13 * 2 ** 20
+
+
 def windowed_correlation_pallas_fused(
         fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray, radius: int,
         scale: bool = True, mxu_dtype: str = "float32",
-        interpret: bool | None = None, band: bool = True) -> jnp.ndarray:
+        interpret: bool | None = None,
+        band: bool | None = None) -> jnp.ndarray:
     """All pyramid levels of the on-demand windowed lookup in ONE fused
     Pallas launch; numerically identical to concatenating
     ``raft_tpu.models.corr.windowed_correlation`` over the levels with
@@ -414,12 +435,18 @@ def windowed_correlation_pallas_fused(
       interpret: force Pallas interpreter mode (defaults to True off-TPU
         so the same tests run on CPU).
       band: dynamic y-band skipping (exact; disable only for debugging).
+        Default reads ``RAFT_CORR_BAND`` (unset/"1" = on) — an escape
+        hatch for unattended captures should a Mosaic version reject the
+        dynamic-bound row loop.
 
     Returns:
       ``(B, H, W, L*(2r+1)^2)`` float32, level-major on the last axis.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if band is None:
+        import os
+        band = os.environ.get("RAFT_CORR_BAND", "1") != "0"
     b, h, w, c = fmap1.shape
     win = 2 * radius + 1
     levels = _level_geometry([f2.shape[1:3] for f2 in pyramid2])
@@ -451,7 +478,7 @@ def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                                 scale: bool = True,
                                 interpret: bool | None = None,
                                 mxu_dtype: str = "float32",
-                                band: bool = True) -> jnp.ndarray:
+                                band: bool | None = None) -> jnp.ndarray:
     """Single-level wrapper of the fused kernel — drop-in Pallas
     replacement for ``raft_tpu.models.corr.windowed_correlation``
     (``coords`` already at ``fmap2``'s scale).

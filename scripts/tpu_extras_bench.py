@@ -172,9 +172,14 @@ def kitti_eval() -> dict:
     out = {"resolution": [H, W], "iters": 24}
     rng = jax.random.PRNGKey(0)
     img = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
-    for name, alt in (("all_pairs", False), ("alternate_corr", True)):
+    # alternate_corr runs bf16 MXU operands by default under mixed
+    # precision (corr_mxu_dtype="auto"); the f32-MXU arm isolates that
+    # lever from the banding/fusion redesign.
+    for name, alt, mxu in (("all_pairs", False, "auto"),
+                           ("alternate_corr", True, "auto"),
+                           ("alternate_corr_f32mxu", True, "float32")):
         cfg = RAFTConfig(iters=24, mixed_precision=True,
-                         alternate_corr=alt)
+                         alternate_corr=alt, corr_mxu_dtype=mxu)
         model = RAFT(cfg)
         variables = model.init({"params": rng, "dropout": rng}, img, img,
                                iters=1)

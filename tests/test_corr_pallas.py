@@ -229,3 +229,31 @@ def test_bf16_mxu_operands_close_to_f32(rng):
     for a, b in zip(gf, g16):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=0.1, atol=0.1)
+
+
+def test_fused_eligibility_gate(rng):
+    from raft_tpu.ops.corr_pallas import fused_eligible
+
+    # eval-scale pyramids fit (bf16 features = the mixed-precision policy)
+    sintel = [(55, 128), (27, 64), (13, 32), (6, 16)]
+    assert fused_eligible(sintel, 256, dtype_bytes=2)
+    kitti = [(48, 156), (24, 78), (12, 39), (6, 19)]
+    assert fused_eligible(kitti, 256, dtype_bytes=2)
+    # an unpooled full-resolution level does not
+    assert not fused_eligible([(440, 1024)], 256, dtype_bytes=4)
+
+    # forced pallas on ineligible levels is a clear error, not a Mosaic
+    # failure; auto on an INELIGIBLE level must fall back to the jnp
+    # path bit-for-bit on any backend (an eligible level would dispatch
+    # to the kernel on TPU hosts and defeat the comparison)
+    from raft_tpu.models.corr import alternate_lookup
+    f1 = _rand(rng, 1, 4, 6, 8)
+    big = jnp.zeros((1, 800, 800, 8), jnp.float32)   # ~20 MB > VMEM cap
+    assert not fused_eligible([(800, 800)], 8, dtype_bytes=4)
+    coords = jnp.zeros((1, 4, 6, 2), jnp.float32)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="VMEM"):
+        alternate_lookup(f1, (big,), coords, 2, backend="pallas")
+    a = alternate_lookup(f1, (big,), coords, 2, backend="auto")
+    b = alternate_lookup(f1, (big,), coords, 2, backend="jnp")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
