@@ -159,6 +159,7 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
     cy0 = cy_ref[0].astype(jnp.float32)
     inv_sqrt_c = 1.0 / (c ** 0.5)
 
+    level_rows = []
     for l, (h2l, h2lp, w2pl) in enumerate(levels):
         cx = cx0 * (1.0 / 2 ** l)
         cy = cy0 * (1.0 / 2 ** l)
@@ -186,16 +187,19 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
         # x-side hat contraction → window rows in the reference order
         # (core/corr.py delta grid: first window axis moves x).
         xi = _x_iota(w2pl, tq)
-        rows = []
         for a in range(win):                             # x-offset index
             vx = _hat(xi - (cx + (a - radius)))          # (W2PL, TQ)
             for b in range(win):                         # y-offset index
                 t1_b = t1_ref[b * w2pl:(b + 1) * w2pl, :]
-                rows.append(jnp.sum(t1_b * vx, axis=0, keepdims=True))
-        out = jnp.concatenate(rows, axis=0)              # (win*win, TQ)
-        if scale:
-            out = out * inv_sqrt_c
-        out_ref[0, l * win * win:(l + 1) * win * win, :] = out
+                level_rows.append(
+                    jnp.sum(t1_b * vx, axis=0, keepdims=True))
+
+    # ONE aligned full-block store: per-level stores at row offset
+    # l*win*win (81, 162, …) would be sublane-unaligned.
+    out = jnp.concatenate(level_rows, axis=0)            # (L*win*win, TQ)
+    if scale:
+        out = out * inv_sqrt_c
+    out_ref[0] = out
 
 
 def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
@@ -218,15 +222,18 @@ def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
     cy0 = cy_ref[0].astype(jnp.float32)
     t = pl.program_id(1)
 
+    # ONE aligned full-block load; per-level row offsets (l*win*win) are
+    # sublane-unaligned, so slice the loaded value instead of the ref.
+    g_all = g_ref[0].astype(jnp.float32)                 # (L*win*win, TQ)
+    if scale:
+        g_all = g_all * (1.0 / (c ** 0.5))
+
     df1 = jnp.zeros((tq, c), jnp.float32)
     for l, (h2l, h2lp, w2pl) in enumerate(levels):
         cx = cx0 * (1.0 / 2 ** l)
         cy = cy0 * (1.0 / 2 ** l)
         nchunks = h2lp // _CHUNK
-        g = g_ref[0, l * win * win:(l + 1) * win * win, :].astype(
-            jnp.float32)                                 # (win*win, TQ)
-        if scale:
-            g = g * (1.0 / (c ** 0.5))
+        g = g_all[l * win * win:(l + 1) * win * win, :]  # (win*win, TQ)
 
         # U_b[x, n] = sum_a g[a*win+b, n] * hat(x - cx_n - (a - r)) — the
         # x-side adjoint, shared across the y sweep.
