@@ -368,11 +368,41 @@ def msda_threshold() -> dict:
     return out
 
 
+def golden_on_chip() -> dict:
+    """Hardware-accuracy validation of the round-3 kernel work: golden
+    parity EPEs measured ON the chip (the CPU suite runs the Pallas
+    kernel in interpreter mode only). Arms: all-pairs f32 and the banded
+    Pallas alternate path (both vs the stored f32 torch outputs — expect
+    float-noise, ~3e-6 on CPU), plus the mixed-precision policy arms
+    (bf16 encoders/update + bf16 MXU operands + bf16 volume; the parity
+    number then reads the whole bf16 compute-policy deviation against
+    the f32-recorded golden — ~0.065 px on CPU, where the kernel/volume
+    levers are inactive; the on-chip value bounds the full policy)."""
+    from raft_tpu.evaluate import (ASSETS_DIR, load_predictor,
+                                   validate_golden)
+
+    weights = os.path.join(ASSETS_DIR, "golden", "weights.npz")
+    out = {}
+    for name, kw in (
+            ("all_pairs_f32", {}),
+            ("alternate_f32", dict(alternate_corr=True)),
+            ("policy_mixed", dict(mixed_precision=True)),
+            ("policy_mixed_alt", dict(alternate_corr=True,
+                                      mixed_precision=True))):
+        pred = load_predictor(weights, iters=12, **kw)
+        res = validate_golden(pred)
+        # raw float: the f32 arms measure float-noise-scale parity that
+        # sub-1e-6 rounding would erase
+        out[f"{name}_parity_epe"] = res["golden_parity_epe"]
+    return out
+
+
 SECTIONS = {"sparse_train": sparse_train, "raft_train": raft_train,
             "kitti_eval": kitti_eval, "volume_memory": volume_memory,
             "batch1": batch1, "msda_dense": msda_dense,
             "encoder_family": encoder_family,
-            "msda_threshold": msda_threshold}
+            "msda_threshold": msda_threshold,
+            "golden_on_chip": golden_on_chip}
 
 
 def main(argv):
