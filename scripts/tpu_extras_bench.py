@@ -24,6 +24,11 @@ the rest; results appended per-section to ``TPU_EXTRAS.json``):
   jnp vs Pallas backends.
 * ``encoder_family`` — end-to-end ours_07-lineage forward (SparseRAFT
   with active encoder stacks), MSDA auto-Pallas vs forced gather path.
+* ``msda_threshold`` — raw-op backend crossover across the dense-query
+  dispatch boundary (query-count sweep, fresh jit per arm).
+* ``golden_on_chip`` — golden parity EPEs measured on the chip for the
+  all-pairs / banded-alternate / mixed-precision-policy arms (the CPU
+  suite only runs the Pallas kernel in interpreter mode).
 
 Run alone on the TPU host (the tunnel serializes processes):
 
