@@ -72,17 +72,31 @@ class MSDeformAttn(nn.Module):
             value = jnp.where(padding_mask[..., None], 0.0, value)
         value = value.reshape(B, -1, M, D)
 
-        offsets = nn.Dense(
+        off_dense = nn.Dense(
             M * L * P * 2, dtype=self.dtype,
             kernel_init=nn.initializers.zeros,
             bias_init=lambda key, shape, dtype=jnp.float32: jnp.asarray(
                 _directional_bias(M, L, P), dtype),
-            name="sampling_offsets")(query)
-        offsets = offsets.reshape(B, Lq, M, L, P, 2)
-
-        weights = nn.Dense(M * L * P, dtype=self.dtype,
+            name="sampling_offsets")
+        w_dense = nn.Dense(M * L * P, dtype=self.dtype,
                            kernel_init=nn.initializers.zeros,
-                           name="attention_weights")(query)
+                           name="attention_weights")
+        if self.is_initializing():
+            offsets = off_dense(query)
+            weights = w_dense(query)
+        else:
+            # Both heads consume `query`: one fused matmul (kernel concat
+            # along the output axis — exact, param tree untouched; same
+            # launch-merging rationale as models/update.py::_concat_conv).
+            po = self.variables["params"]["sampling_offsets"]
+            pw = self.variables["params"]["attention_weights"]
+            k = jnp.concatenate([po["kernel"], pw["kernel"]],
+                                axis=-1).astype(self.dtype)
+            b = jnp.concatenate([po["bias"], pw["bias"]]).astype(self.dtype)
+            fused = query.astype(self.dtype) @ k + b
+            offsets, weights = (fused[..., :M * L * P * 2],
+                                fused[..., M * L * P * 2:])
+        offsets = offsets.reshape(B, Lq, M, L, P, 2)
         weights = nn.softmax(weights.reshape(B, Lq, M, L * P), axis=-1)
         weights = weights.reshape(B, Lq, M, L, P)
 
