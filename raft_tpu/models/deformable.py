@@ -94,8 +94,10 @@ class MSDeformAttn(nn.Module):
                                 axis=-1).astype(self.dtype)
             b = jnp.concatenate([po["bias"], pw["bias"]]).astype(self.dtype)
             fused = query.astype(self.dtype) @ k + b
-            offsets, weights = (fused[..., :M * L * P * 2],
-                                fused[..., M * L * P * 2:])
+            # split derived from the actual kernel width so the slice can
+            # never drift from the head definitions above
+            split = po["kernel"].shape[-1]
+            offsets, weights = fused[..., :split], fused[..., split:]
         offsets = offsets.reshape(B, Lq, M, L, P, 2)
         weights = nn.softmax(weights.reshape(B, Lq, M, L * P), axis=-1)
         weights = weights.reshape(B, Lq, M, L, P)

@@ -37,7 +37,18 @@ from raft_tpu.ops.sampling import bilinear_sampler
 # Dense-query regimes (encoder stacks: every HW token is a query) switch
 # to the Pallas kernel on TPU above this query count; below it the gather
 # traffic is trivial and the jnp core fuses fine.
-_PALLAS_MIN_QUERIES = 512
+# RAFT_MSDA_MIN_QUERIES overrides the default so an operator can apply a
+# crossover measured by scripts/tpu_extras_bench.py::msda_threshold
+# (which itself monkeypatches this global per arm) without a code edit.
+# Read ONCE at import — set it before importing raft_tpu; malformed
+# values fall back to the default rather than poisoning every import.
+import os as _os
+
+try:
+    _PALLAS_MIN_QUERIES = int(
+        _os.environ.get("RAFT_MSDA_MIN_QUERIES", "512"))
+except ValueError:
+    _PALLAS_MIN_QUERIES = 512
 
 
 def ms_deform_attn(value: jnp.ndarray,
