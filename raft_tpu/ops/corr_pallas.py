@@ -93,6 +93,7 @@ asserted in ``tests/test_corr_pallas.py``.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -452,7 +453,6 @@ def windowed_correlation_pallas_fused(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if band is None:
-        import os
         band = os.environ.get("RAFT_CORR_BAND", "1") != "0"
     b, h, w, c = fmap1.shape
     win = 2 * radius + 1
@@ -478,6 +478,45 @@ def windowed_correlation_pallas_fused(
                     mxu_dtype, band)
     out = jnp.swapaxes(out, 1, 2)                        # (B, Np, L*win*win)
     return out[:, :n].reshape(b, h, w, len(levels) * win * win)
+
+
+def run_with_band_retry(run, record: dict, name: str) -> bool:
+    """Measurement-harness self-healing for this kernel's one
+    never-compiled-on-chip construct (the dynamic-bound row loop).
+
+    Runs ``run()`` under the current band mode, recording
+    ``{name}_band`` on success. If the banded attempt fails, retries
+    once under the static-bound fallback (``RAFT_CORR_BAND=0``),
+    restoring any pre-existing operator setting afterwards. Both
+    failures are recorded under distinct ``{name}_band_{mode}_error``
+    keys and swallowed (a sibling arm's numbers must survive), returning
+    False. An operator-forced ``RAFT_CORR_BAND=0`` is honored: the first
+    attempt is labelled ``off`` and there is nothing to retry.
+    """
+    prev = os.environ.get("RAFT_CORR_BAND")
+    first_mode = "off" if prev == "0" else "on"
+    try:
+        run()
+        record[f"{name}_band"] = first_mode
+        return True
+    except Exception as e:
+        record[f"{name}_band_{first_mode}_error"] = \
+            f"{type(e).__name__}: {e}"
+    if first_mode == "off":
+        return False
+    os.environ["RAFT_CORR_BAND"] = "0"
+    try:
+        run()
+        record[f"{name}_band"] = "off"
+        return True
+    except Exception as e:
+        record[f"{name}_band_off_error"] = f"{type(e).__name__}: {e}"
+        return False
+    finally:
+        if prev is None:
+            os.environ.pop("RAFT_CORR_BAND", None)
+        else:
+            os.environ["RAFT_CORR_BAND"] = prev
 
 
 def windowed_correlation_pallas(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
