@@ -445,8 +445,12 @@ def main(argv):
         except Exception as e:
             results[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"{name}: FAILED {e}", flush=True)
-        with open(OUT_PATH, "w") as f:
+        # atomic rewrite: a timeout mid-dump must not leave a truncated
+        # artifact where a full committed one stood
+        tmp = OUT_PATH + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=1)
+        os.replace(tmp, OUT_PATH)
     print("wrote", OUT_PATH)
 
 
