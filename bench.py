@@ -435,11 +435,12 @@ def main():
         # touches only each query tile's y-band of the target features
         # instead of re-reading the materialized volume pyramid — if the
         # band stays narrow this can beat the all-pairs arm outright, at
-        # a fraction of the memory. The dynamic-bound row loop is the
-        # one kernel construct never compiled on a real chip before this
-        # capture; run_with_band_retry self-heals via the static-bound
-        # fallback and records which mode produced the numbers
-        # (alternate_band / alternate_band_{on,off}_error keys).
+        # a fraction of the memory. The dynamic-trip-count row loop is
+        # the one kernel construct never compiled on a real chip before
+        # this capture; run_with_band_retry walks the dynamic →
+        # masked-static → off fallback ladder and records which mode
+        # produced the numbers (alternate_band /
+        # alternate_band_{mode}_error keys).
         from raft_tpu.ops.corr_pallas import run_with_band_retry
         cfga = RAFTConfig(iters=ITERS,
                           mixed_precision=(platform == "tpu"),

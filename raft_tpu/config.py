@@ -55,11 +55,12 @@ class RAFTConfig:
     corr_dtype: str = "auto"        # auto | float32 | bfloat16
     # Operand dtype of the on-demand (alternate_corr) Pallas kernel's
     # correlation matmuls. Accumulation is always float32; "bfloat16"
-    # operands quadruple MXU throughput with the same contract as the
-    # mixed-precision encoder policy. "auto" = bfloat16 iff
-    # mixed_precision (matching the policy boundary at reference
-    # core/raft.py:100-103, where features enter corr from autocast
-    # regions). No effect on the materialized all-pairs path.
+    # operands quadruple MXU throughput. The reference casts features to
+    # f32 before EITHER correlation path (core/raft.py:103-104), so
+    # "auto" mirrors corr_dtype's boundary exactly: bfloat16 iff
+    # mixed_precision AND inference (test_mode). Training matmuls stay
+    # f32 unless bfloat16 is explicitly requested, preserving reference
+    # training numerics. No effect on the materialized all-pairs path.
     corr_mxu_dtype: str = "auto"    # float32 | bfloat16 | auto
     # Number of refinement iterations (train default 12; eval uses 24/32 —
     # reference train.py:445, evaluate.py:75,102,251).
@@ -111,10 +112,12 @@ class RAFTConfig:
                     else jnp.float32)
         return jnp.dtype(self.corr_dtype)
 
-    @property
-    def corr_mxu(self) -> str:
+    def corr_mxu(self, inference: bool) -> str:
+        """Resolved MXU-operand dtype for the on-demand kernel's matmuls.
+        Mirrors ``corr_storage``: "auto" is a bf16 *inference* lever only."""
         if self.corr_mxu_dtype == "auto":
-            return "bfloat16" if self.mixed_precision else "float32"
+            return ("bfloat16" if (self.mixed_precision and inference)
+                    else "float32")
         return self.corr_mxu_dtype
 
     @staticmethod

@@ -76,7 +76,7 @@ def main(argv=None):
                         help="refinement iterations (canonical RAFT "
                              "only; default 20, reference demo.py:62)")
     parser.add_argument("--alternate_corr", action="store_true")
-    parser.add_argument("--corr_dtype", default="auto",
+    parser.add_argument("--corr_dtype", default=None,
                         choices=["float32", "bfloat16", "auto"])
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--show", action="store_true")

@@ -429,7 +429,7 @@ def load_predictor(model_path: str, small: bool = False,
                    mixed_precision: bool = False,
                    iters: int = 32,
                    model_family: str = "raft",
-                   corr_dtype: str = "auto",
+                   corr_dtype: Optional[str] = None,
                    spatial_shards: int = 1) -> FlowPredictor:
     """Build a :class:`FlowPredictor` from a checkpoint — torch ``.pth``
     (published reference weights, converted) or an orbax run directory
@@ -463,7 +463,7 @@ def load_predictor(model_path: str, small: bool = False,
     else:
         cfg = RAFTConfig(small=small, alternate_corr=alternate_corr,
                          mixed_precision=mixed_precision,
-                         corr_dtype=corr_dtype)
+                         corr_dtype=corr_dtype or "auto")
         model = RAFT(cfg)
 
     mesh = None
@@ -503,10 +503,16 @@ def load_predictor(model_path: str, small: bool = False,
 
 def _raft_only_selections(small, alternate_corr, corr_dtype):
     """The single source of truth for options that configure only the
-    canonical RAFT family: ``(name, non-default?)`` pairs."""
+    canonical RAFT family: ``(name, non-default?)`` pairs.
+
+    ``corr_dtype`` uses the explicit-selection convention: the CLIs (and
+    :func:`load_predictor`) default it to ``None`` and resolve to "auto"
+    only after this check, so an explicitly passed ``--corr_dtype
+    float32`` on a non-RAFT family is rejected rather than silently
+    treated as the default."""
     return (("small", small),
             ("alternate_corr", alternate_corr),
-            ("corr_dtype", corr_dtype not in ("float32", "auto")))
+            ("corr_dtype", corr_dtype is not None))
 
 
 def reject_raft_only_flags(parser, args) -> None:
@@ -549,7 +555,7 @@ def main(argv=None):
     parser.add_argument("--alternate_corr", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--warm_start", action="store_true")
-    parser.add_argument("--corr_dtype", default="auto",
+    parser.add_argument("--corr_dtype", default=None,
                         choices=["float32", "bfloat16", "auto"],
                         help="storage dtype of the correlation pyramid "
                              "(float32 = reference autocast semantics; "

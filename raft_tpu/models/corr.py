@@ -172,7 +172,8 @@ def build_feature_pyramid(fmap2: jnp.ndarray, num_levels: int):
 def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
                      radius: int, scale: bool = True,
                      backend: str = "auto",
-                     mxu_dtype: str = "float32") -> jnp.ndarray:
+                     mxu_dtype: str = "float32",
+                     differentiable: bool = False) -> jnp.ndarray:
     """On-demand windowed lookup over a pooled feature pyramid; numerically
     identical to ``pyramid_lookup`` over the materialized volume.
 
@@ -189,6 +190,12 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     ``mxu_dtype``: operand dtype for the Pallas kernel's correlation
     matmuls (f32 accumulation; see ``RAFTConfig.corr_mxu_dtype``).
     Ignored by the jnp path, which always computes in float32.
+
+    ``differentiable``: declare that this call may be differentiated
+    (training). The kernel's backward keeps more VMEM resident than its
+    forward (f32 df2 blocks + cotangent scratch), so the auto-dispatch
+    eligibility gate budgets for the backward too instead of admitting
+    a shape that compiles forward but fails VMEM allocation under grad.
     """
     if backend not in ("auto", "jnp", "pallas"):
         raise ValueError(f"unknown correlation backend {backend!r} "
@@ -198,7 +205,8 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     shapes = [f2.shape[1:3] for f2 in pyramid2]
     channels = fmap1.shape[-1]
     dtype_bytes = jnp.dtype(pyramid2[0].dtype).itemsize
-    eligible = fused_eligible(shapes, channels, dtype_bytes, radius)
+    eligible = fused_eligible(shapes, channels, dtype_bytes, radius,
+                              differentiable=differentiable)
     if backend == "pallas" and not eligible:
         raise ValueError(
             "backend='pallas' but the pooled levels don't fit the "
@@ -225,15 +233,17 @@ class AlternateCorrBlock:
 
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True,
-                 backend: str = "auto", mxu_dtype: str = "float32"):
+                 backend: str = "auto", mxu_dtype: str = "float32",
+                 differentiable: bool = False):
         self.radius = radius
         self.scale = scale
         self.backend = backend
         self.mxu_dtype = mxu_dtype
+        self.differentiable = differentiable
         self.fmap1 = fmap1
         self.pyramid2 = build_feature_pyramid(fmap2, num_levels)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
         return alternate_lookup(self.fmap1, self.pyramid2, coords,
                                 self.radius, self.scale, self.backend,
-                                self.mxu_dtype)
+                                self.mxu_dtype, self.differentiable)

@@ -85,25 +85,31 @@ def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2, inference: bool):
     All-pairs mode: the pooled 4D-volume pyramid (tuple of arrays).
     Alternate mode: fmap1 + the pooled fmap2 pyramid (tuple of arrays).
     Returned as plain pytrees so they can cross ``nn.scan`` as broadcast
-    arguments. ``inference`` resolves corr_dtype="auto" (bf16 storage is
-    an inference-only lever; training keeps the reference's
-    autocast-exempt f32 volume — see RAFTConfig.corr_dtype).
+    arguments. ``inference`` resolves both "auto" dtype levers (bf16
+    volume storage / bf16 MXU operands are inference-only; training keeps
+    the reference's autocast-exempt f32 correlation numerics — the
+    reference casts fmaps to f32 before either corr path,
+    ``core/raft.py:103-104``). The resolved MXU dtype and a
+    differentiable flag (training → the kernel-dispatch gate budgets
+    VMEM for the backward too) ride in the state tuple as static values
+    alongside the "alt"/"allpairs" tag.
     """
     if cfg.alternate_corr:
-        return ("alt", (fmap1, corr.build_feature_pyramid(
-            fmap2, cfg.corr_levels)))
-    return ("allpairs", corr.build_corr_pyramid(
+        return ("alt", (cfg.corr_mxu(inference), not inference), (fmap1,
+                corr.build_feature_pyramid(fmap2, cfg.corr_levels)))
+    return ("allpairs", ("float32", not inference), corr.build_corr_pyramid(
         fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
         cfg.corr_storage(inference)))
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
-    kind, payload = corr_state
+    kind, (mxu_dtype, differentiable), payload = corr_state
     if kind == "alt":
         fmap1, pyramid2 = payload
         return corr.alternate_lookup(fmap1, pyramid2, coords, cfg.radius,
                                      cfg.corr_scale,
-                                     mxu_dtype=cfg.corr_mxu)
+                                     mxu_dtype=mxu_dtype,
+                                     differentiable=differentiable)
     return corr.pyramid_lookup(payload, coords, cfg.radius)
 
 

@@ -294,7 +294,7 @@ def main(argv=None):
     parser.add_argument("--add_noise", action="store_true")
     parser.add_argument("--mixed_precision", action="store_true")
     parser.add_argument("--alternate_corr", action="store_true")
-    parser.add_argument("--corr_dtype", default="auto",
+    parser.add_argument("--corr_dtype", default=None,
                         choices=["float32", "bfloat16", "auto"],
                         help="storage dtype of the correlation pyramid "
                              "(float32 = reference autocast semantics; "
@@ -334,7 +334,7 @@ def main(argv=None):
         small=args.small, dropout=args.dropout, iters=iters,
         alternate_corr=args.alternate_corr,
         mixed_precision=args.mixed_precision,
-        corr_dtype=args.corr_dtype)
+        corr_dtype=args.corr_dtype or "auto")
 
     t0 = time.time()
     train(tcfg, mcfg, data_root=args.data_root, ckpt_dir=args.ckpt_dir,

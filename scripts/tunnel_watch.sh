@@ -37,21 +37,20 @@ sys.exit(0 if lines and json.loads(lines[-1]).get('value') is not None
     fi
     echo "capture done ($(date -u +%H:%M:%SZ))" | tee -a tunnel_watch.log
     # Guarded auto-commit: the capture validated non-null above, and the
-    # round may end before an interactive session can commit it. The
-    # compile cache rides along — it is what lets the driver's own bench
-    # run finish inside its deadline. Each path is added independently
-    # (git add is all-or-nothing across pathspecs and .jax_cache only
-    # exists if JAX actually wrote cache entries), TPU_EXTRAS.json is
-    # only staged if it still parses (the 3600s timeout can kill the
-    # sweep mid-rewrite), and the commit is pathspec-scoped so nothing a
-    # concurrent session staged gets swept in.
+    # round may end before an interactive session can commit it. Only
+    # the JSON capture artifacts are committed — .jax_cache stays local
+    # (gitignored): the driver reuses the on-disk cache in this same
+    # repo dir, and machine-specific binary XLA blobs don't belong in
+    # history. TPU_EXTRAS.json is only staged if it still parses (the
+    # 3600s timeout can kill the sweep mid-rewrite), and the commit is
+    # pathspec-scoped so nothing a concurrent session staged gets swept
+    # in.
     PATHS="BENCH_local.json"
     if python -c "import json; json.load(open('TPU_EXTRAS.json'))" 2>> tunnel_watch.log; then
       PATHS="$PATHS TPU_EXTRAS.json"
     else
       echo "TPU_EXTRAS.json invalid; not committing it" | tee -a tunnel_watch.log
     fi
-    [ -d .jax_cache ] && PATHS="$PATHS .jax_cache"
     for p in $PATHS; do git add "$p" 2>> tunnel_watch.log; done
     git commit -m "TPU capture: headline bench + extras sweep (tunnel recovery)" \
       -- $PATHS >> tunnel_watch.log 2>&1 \

@@ -10,6 +10,8 @@ On CPU the kernel runs in Pallas interpreter mode; the identical code path
 compiles on TPU.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -169,13 +171,84 @@ def test_fused_band_skipping_is_exact(rng):
     for lo, hi in ((-3.0, H + 2.0), (100.0, 200.0), (-50.0, -20.0)):
         coords = jnp.asarray(rng.uniform(lo, hi, (B, H, W, 2)), jnp.float32)
         banded = windowed_correlation_pallas_fused(
-            f1, pyr, coords, r, interpret=True, band=True)
+            f1, pyr, coords, r, interpret=True, band="dynamic")
+        static = windowed_correlation_pallas_fused(
+            f1, pyr, coords, r, interpret=True, band="static")
         full = windowed_correlation_pallas_fused(
-            f1, pyr, coords, r, interpret=True, band=False)
+            f1, pyr, coords, r, interpret=True, band="off")
         np.testing.assert_array_equal(np.asarray(banded), np.asarray(full))
+        np.testing.assert_array_equal(np.asarray(static), np.asarray(full))
         ref = _jnp_multilevel(f1, pyr, coords, r)
         np.testing.assert_allclose(np.asarray(banded), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_band_mode_gradients_agree(rng):
+    # All three band modes (dynamic / masked-static / off) must produce
+    # bit-identical df1/df2 — the masked-static mode predicates the same
+    # chunk work behind pl.when instead of a traced loop bound, and the
+    # backward's df1 now accumulates in scratch rather than a loop carry.
+    B, C, H, W, r = 1, 16, 8, 12, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(-2, 10, (B, H, W, 2)), jnp.float32)
+    pyr = build_feature_pyramid(f2, 2)
+    cot = _rand(rng, B, H, W, 2 * (2 * r + 1) ** 2)
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+
+    def grads(mode):
+        def loss(a, b):
+            out = windowed_correlation_pallas_fused(
+                a, build_feature_pyramid(b, 2), coords, r,
+                interpret=True, band=mode)
+            return jnp.sum(out * cot)
+        return jax.grad(loss, argnums=(0, 1))(f1, f2)
+
+    g_dyn = grads("dynamic")
+    g_sta = grads("static")
+    g_off = grads("off")
+    for a, b, c in zip(g_dyn, g_sta, g_off):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(c))
+
+
+def test_band_resolve_and_retry_ladder(monkeypatch):
+    from raft_tpu.ops import corr_pallas as cp
+    # env resolution
+    monkeypatch.delenv("RAFT_CORR_BAND", raising=False)
+    assert cp._resolve_band(None) == "dynamic"
+    monkeypatch.setenv("RAFT_CORR_BAND", "static")
+    assert cp._resolve_band(None) == "static"
+    monkeypatch.setenv("RAFT_CORR_BAND", "0")
+    assert cp._resolve_band(None) == "off"
+    assert cp._resolve_band(True) == "dynamic"
+    assert cp._resolve_band(False) == "off"
+    with pytest.raises(ValueError):
+        cp._resolve_band("banded")
+    # retry ladder: dynamic fails -> static fails -> off succeeds
+    monkeypatch.delenv("RAFT_CORR_BAND", raising=False)
+    calls = []
+
+    def run():
+        mode = os.environ["RAFT_CORR_BAND"]
+        calls.append(mode)
+        if mode != "0":
+            raise RuntimeError(f"boom {mode}")
+
+    rec = {}
+    assert cp.run_with_band_retry(run, rec, "arm") is True
+    assert calls == ["1", "static", "0"]
+    assert rec["arm_band"] == "off"
+    assert "arm_band_dynamic_error" in rec
+    assert "arm_band_static_error" in rec
+    assert "RAFT_CORR_BAND" not in os.environ
+    # operator-forced static start skips the dynamic rung
+    monkeypatch.setenv("RAFT_CORR_BAND", "static")
+    calls.clear()
+    rec2 = {}
+    assert cp.run_with_band_retry(run, rec2, "arm") is True
+    assert calls == ["static", "0"]
+    assert os.environ["RAFT_CORR_BAND"] == "static"
 
 
 def test_fused_multilevel_gradients(rng):
