@@ -23,6 +23,8 @@ that exactly; converted torch weights then consume identical channel order.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -197,6 +199,11 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     eligibility gate budgets for the backward too instead of admitting
     a shape that compiles forward but fails VMEM allocation under grad.
     """
+    if backend == "auto":
+        # Experiment hook (e.g. the bf16-backward training A/B, which
+        # must route CPU training through the kernel's interpret mode):
+        # RAFT_CORR_BACKEND=jnp|pallas overrides the auto dispatch.
+        backend = os.environ.get("RAFT_CORR_BACKEND", "auto")
     if backend not in ("auto", "jnp", "pallas"):
         raise ValueError(f"unknown correlation backend {backend!r} "
                          f"(want 'auto', 'jnp' or 'pallas')")
@@ -224,6 +231,24 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
         out.append(windowed_correlation(fmap1, f2, coords / (2 ** lvl),
                                         radius, scale))
     return jnp.concatenate(out, axis=-1)
+
+
+def alternate_eval_eligible(cfg, image_hw) -> bool:
+    """Whether the fused on-demand kernel admits a canonical-RAFT eval at
+    this padded image size (stride-8 features, ``cfg.corr_levels`` pooled
+    levels, bf16 features under the mixed-precision policy). Used by the
+    eval path's ``corr_impl="auto"`` dispatch — on-chip measurement
+    (BENCH r4: 84.3 vs 56.1 pairs/s at Sintel) made the on-demand kernel
+    the preferred eval path wherever it fits VMEM."""
+    from raft_tpu.ops.corr_pallas import fused_eligible
+    h, w = image_hw
+    h8, w8 = h // 8, w // 8
+    shapes = []
+    for _ in range(cfg.corr_levels):
+        shapes.append((max(h8, 1), max(w8, 1)))
+        h8, w8 = h8 // 2, w8 // 2      # avg_pool2x2 is VALID stride-2
+    dtype_bytes = 2 if cfg.mixed_precision else 4
+    return fused_eligible(shapes, cfg.fnet_dim, dtype_bytes, cfg.radius)
 
 
 class AlternateCorrBlock:

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""bf16-MXU-operand training A/B (VERDICT r3 weak #6 / next #8).
+
+``corr_mxu_dtype="bfloat16"`` quadruples the on-demand kernel's MXU
+throughput but rounds both the forward correlation operands and the
+backward's assembled cotangent to bfloat16 (corr_pallas.py backward).
+That is fine for the inference headline; the open question was whether
+the *gradient* rounding measurably changes training. This runs the same
+fixed-seed miniature training twice through the Pallas kernel (interpret
+mode off-TPU — bit-faithful emulation of the bf16 casts), f32 vs bf16
+operands, and records the loss-trajectory delta.
+
+Decision input for whether ``corr_mxu_dtype="auto"`` may ever resolve to
+bf16 for training (today it deliberately does not — config.py gates the
+auto lever to inference, mirroring the reference's pre-corr f32 casts at
+``core/raft.py:103-104``).
+
+CPU-cheap by design: run anywhere, writes BF16_BACKWARD_AB.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Route the model's alternate-corr lookups through the Pallas kernel even
+# off-TPU (interpret mode) — the jnp fallback ignores mxu_dtype entirely.
+os.environ["RAFT_CORR_BACKEND"] = "pallas"
+
+import jax
+import jax.numpy as jnp
+
+STEPS = int(os.environ.get("RAFT_AB_STEPS", "20"))
+H, W, BATCH, POOL = 64, 96, 2, 4
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "BF16_BACKWARD_AB.json")
+
+
+def _data(key):
+    from tpu_extras_bench import _warped_pairs
+    return _warped_pairs(key, POOL, H, W, max_shift=4)
+
+
+def run_arm(mxu_dtype: str) -> list:
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.parallel import create_train_state, make_train_step
+
+    tcfg = TrainConfig(batch_size=BATCH, image_size=(H, W),
+                       num_steps=STEPS, lr=2e-4, iters=6)
+    model = RAFT(RAFTConfig(small=True, iters=6, alternate_corr=True,
+                            corr_mxu_dtype=mxu_dtype))
+    rng = jax.random.PRNGKey(0)
+    i1, i2, fl, va = _data(jax.random.PRNGKey(7))
+    state = create_train_state(rng, model, tcfg, (H, W))
+    step_fn = make_train_step(tcfg, donate=False)
+    losses = []
+    for s in range(STEPS):
+        lo = (s * BATCH) % POOL
+        sel = (lo + jnp.arange(BATCH)) % POOL
+        b = {"image1": i1[sel], "image2": i2[sel],
+             "flow": fl[sel], "valid": va[sel]}
+        state, metrics = step_fn(state, b, rng)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def main():
+    t0 = time.time()
+    f32 = run_arm("float32")
+    bf16 = run_arm("bfloat16")
+    deltas = [abs(a - b) / max(abs(a), 1e-9) for a, b in zip(f32, bf16)]
+    payload = {
+        "steps": STEPS, "batch": BATCH, "resolution": [H, W],
+        "backend": jax.default_backend(),
+        "loss_f32": [round(x, 5) for x in f32],
+        "loss_bf16": [round(x, 5) for x in bf16],
+        "rel_delta_max": round(max(deltas), 5),
+        "rel_delta_final": round(deltas[-1], 5),
+        "f32_decreased": f32[-1] < f32[0],
+        "bf16_decreased": bf16[-1] < bf16[0],
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

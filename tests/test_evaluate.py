@@ -245,3 +245,65 @@ def test_load_predictor_random_weights():
     low, up = predictor(im, im)
     assert up.shape == (64, 96, 2)
     assert np.isfinite(up).all()
+
+
+def test_corr_dtype_explicit_selection_convention():
+    """An explicitly passed corr_dtype — even 'float32' or 'auto' — is a
+    RAFT-family-only selection; non-RAFT families must reject it instead
+    of silently treating it as the default (ADVICE r3)."""
+    with pytest.raises(ValueError, match="corr_dtype"):
+        evaluate.load_predictor("random", model_family="sparse",
+                                corr_dtype="float32")
+    # None (the CLI's new default) resolves to "auto" and is accepted
+    predictor = evaluate.load_predictor("random", small=True, iters=2,
+                                        corr_dtype=None)
+    assert predictor is not None
+
+
+def test_flow_predictor_corr_impl_auto():
+    """corr_impl='auto' builds the alternate-engine sibling (shared
+    params) for canonical RAFT; off-TPU the dispatch keeps the
+    materialized path, so results are unchanged on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.evaluate import FlowPredictor
+    from raft_tpu.models.raft import RAFT
+
+    model = RAFT(RAFTConfig.tiny(iters=2))
+    rng = jax.random.PRNGKey(0)
+    im = np.random.default_rng(0).uniform(
+        0, 255, (64, 96, 3)).astype(np.float32)
+    vs = model.init({"params": rng, "dropout": rng},
+                    jnp.asarray(im)[None], jnp.asarray(im)[None], iters=1)
+    auto = FlowPredictor(model, vs, iters=2, corr_impl="auto")
+    fixed = FlowPredictor(model, vs, iters=2)
+    assert auto._engines is not None
+    allpairs, alternate = auto._engines
+    assert allpairs is model
+    assert alternate.config.alternate_corr
+    la, ua = auto(im, im)
+    lf, uf = fixed(im, im)
+    np.testing.assert_allclose(ua, uf, rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError, match="corr_impl"):
+        FlowPredictor(model, vs, corr_impl="banded")
+    # an already-alternate model gets a materialized sibling (fallback
+    # for ineligible shapes), and per-engine dtype knobs survive replace
+    import dataclasses
+    alt_model = RAFT(dataclasses.replace(model.config,
+                                         alternate_corr=True))
+    auto2 = FlowPredictor(alt_model, vs, iters=2, corr_impl="auto")
+    ap2, al2 = auto2._engines
+    assert al2 is alt_model and not ap2.config.alternate_corr
+    # corr_dtype='bfloat16' (materialized-only knob) must not crash the
+    # alternate-sibling construction (code-review r4 finding)
+    bf_model = RAFT(dataclasses.replace(model.config,
+                                        corr_dtype="bfloat16"))
+    auto3 = FlowPredictor(bf_model, vs, iters=2, corr_impl="auto")
+    assert auto3._engines[1].config.alternate_corr
+    # explicit auto is rejected, not ignored, for non-RAFT families
+    from raft_tpu.config import OursConfig
+    from raft_tpu.models import SparseRAFT
+    with pytest.raises(ValueError, match="canonical RAFT"):
+        FlowPredictor(SparseRAFT(OursConfig()), vs, corr_impl="auto")
