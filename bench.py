@@ -1,13 +1,20 @@
 """Headline benchmark: Sintel image-pairs/sec/chip @ iters=12.
 
-Runs the flagship canonical RAFT-large forward (test_mode, all-pairs
-correlation) at Sintel resolution (436x1024 padded to 440x1024, the
-``InputPadder`` pad-to-/8 shape) on the available accelerator and prints ONE
-JSON line. ``vs_baseline`` is measured against the BASELINE.md north-star
-denominator: the PyTorch reference on 1xV100 at the same setting, estimated
-at 10 image-pairs/sec (RAFT paper reports ~10 fps at 1088x436 / 12 iters on
-a 1080Ti-class GPU; BASELINE.md records no in-repo number, so the target
-"≥4x vs V100" is normalized to this documented estimate).
+Runs the flagship canonical RAFT-large forward (test_mode) at Sintel
+resolution (436x1024 padded to 440x1024, the ``InputPadder`` pad-to-/8
+shape) on the available accelerator and prints ONE JSON line. The
+headline value is the eval-default correlation engine (round 4 flip:
+the fused on-demand banded kernel — the reference's own sanctioned
+``--alternate_corr`` eval mode, ``core/corr.py:64-92`` — wherever it
+fits VMEM; identical parameters and golden-parity numerics), with the
+materialized-volume arm always published alongside as
+``value_all_pairs`` and promoted back to the headline if the banded arm
+fails every band-mode rung. ``vs_baseline`` is measured against the
+BASELINE.md north-star denominator: the PyTorch reference on 1xV100 at
+the same setting, estimated at 10 image-pairs/sec (RAFT paper reports
+~10 fps at 1088x436 / 12 iters on a 1080Ti-class GPU; BASELINE.md
+records no in-repo number, so the target "≥4x vs V100" is normalized to
+this documented estimate).
 
 Throughput is measured at batch=24 (the sweep's knee on v5e-1; the f32
 all-pairs volume pyramid for 24 pairs is ~6 GB of the 16 GB HBM): per-chip
@@ -375,7 +382,16 @@ def main():
     global _HEADLINE
     # Headline FIRST: if the tunnel dies mid-run, the watchdog publishes
     # whatever _HEADLINE holds — the primary metric must land before any
-    # secondary measurement spends wall clock.
+    # secondary measurement spends wall clock. The materialized-volume
+    # arm runs first as the provisional headline (it has three rounds of
+    # on-chip history and zero compile risk); the on-demand banded arm
+    # then PROMOTES itself to the headline if it succeeds — since round
+    # 4 it is the framework's eval-default engine (corr_impl="auto";
+    # measured 84.3 vs 56.1 pairs/s at Sintel and 22.2 vs 18.4 at KITTI,
+    # BASELINE.md), and the reference itself sanctions the on-demand
+    # path as a first-class eval option (core/corr.py:64-92, README
+    # --alternate_corr). A failed banded arm leaves the materialized
+    # headline standing — the artifact is always valid.
     pairs_per_sec = throughput(BATCH)
     payload = {
         "metric": METRIC,
@@ -384,6 +400,8 @@ def main():
         "batch": BATCH,
         "platform": platform,
         "vs_baseline": round(pairs_per_sec / BASELINE_PAIRS_PER_SEC, 3),
+        "value_all_pairs": round(pairs_per_sec, 3),
+        "headline_engine": "all_pairs",
         "init_attempt_count": len(_INIT_ATTEMPTS),
     }
     # From here on a watchdog fire publishes the headline numbers.
@@ -391,10 +409,53 @@ def main():
     # main keeps mutating payload with secondary-metric keys, and
     # dict()-copying a dict being resized concurrently raises.
     _HEADLINE = dict(payload)
+    headline_fwd = fwd
+    if platform != "cpu":
+        # On-demand banded-correlation arm (identical numerics, asserted
+        # by tests): per iteration it touches only each query tile's
+        # y-band of the target features instead of re-reading the
+        # materialized volume pyramid. run_with_band_retry walks the
+        # dynamic → masked-static → off fallback ladder and records
+        # which mode produced the numbers (alternate_band /
+        # alternate_band_{mode}_error keys).
+        from raft_tpu.ops.corr_pallas import run_with_band_retry
+        cfga = RAFTConfig(iters=ITERS,
+                          mixed_precision=(platform == "tpu"),
+                          alternate_corr=True)
+        modela = RAFT(cfga)
+        alt_jit = []
+
+        def alternate_arm():
+            def fwda(i1, i2):
+                flow_up = modela.apply(variables, i1, i2,
+                                       test_mode=True)[1]
+                return flow_up, jnp.sum(flow_up)
+
+            jfwda = jax.jit(fwda)
+            rate = throughput(BATCH, jfwda)
+            payload["value_alternate_corr"] = round(rate, 3)
+            alt_jit.append((jfwda, rate))
+
+        if run_with_band_retry(alternate_arm, payload, "alternate"):
+            headline_fwd, alt_rate = alt_jit[-1]
+            payload["value"] = round(alt_rate, 3)
+            payload["vs_baseline"] = round(
+                alt_rate / BASELINE_PAIRS_PER_SEC, 3)
+            payload["headline_engine"] = "alternate_banded"
+            # Pin the surviving band rung for the rest of the process:
+            # batch1 below re-traces the promoted engine at batch 1, and
+            # without this it would re-try the default dynamic mode even
+            # when the ladder had to fall back (and the recorded
+            # alternate_band would no longer describe what batch1 ran).
+            os.environ["RAFT_CORR_BAND"] = {
+                "dynamic": "1", "static": "static",
+                "off": "0"}[payload["alternate_band"]]
+        _HEADLINE = dict(payload)
     try:
-        # single-pair throughput, apples-to-apples with the latency-bound
-        # 10 pairs/sec V100 estimate the baseline is normalized to
-        batch1 = throughput(1)
+        # single-pair throughput on the headline engine, apples-to-apples
+        # with the latency-bound 10 pairs/sec V100 estimate the baseline
+        # is normalized to
+        batch1 = throughput(1, headline_fwd)
         payload["value_batch1"] = round(batch1, 3)
         payload["vs_baseline_batch1"] = round(
             batch1 / BASELINE_PAIRS_PER_SEC, 3)
@@ -407,13 +468,14 @@ def main():
         payload["sparse_skipped"] = "cpu"
     else:
         try:
-            # A/B arm: force the old float32 volume storage. The headline
-            # config's corr_dtype="auto" resolves to bf16 storage at
-            # inference under mixed precision (round-3 default flip —
-            # measured flow delta mean 0.0026 px at Sintel res,
-            # BASELINE.md), so the f32 arm documents what the lever
-            # buys. corr_dtype only changes storage, not parameters, so
-            # the headline's variables are reused — no second eager init.
+            # A/B arm: force the old float32 volume storage. The
+            # materialized arm's corr_dtype="auto" resolves to bf16
+            # storage at inference under mixed precision (round-3
+            # default flip — measured flow delta mean 0.0026 px at
+            # Sintel res, BASELINE.md), so the f32 arm documents what
+            # the lever buys. corr_dtype only changes storage, not
+            # parameters, so the headline's variables are reused — no
+            # second eager init.
             cfg32 = RAFTConfig(iters=ITERS,
                                mixed_precision=(platform == "tpu"),
                                corr_dtype="float32")
@@ -430,34 +492,6 @@ def main():
         except Exception as e:
             payload["f32_volume_error"] = f"{type(e).__name__}: {e}"
         _HEADLINE = dict(payload)   # refresh snapshot between sections
-        # On-demand banded-correlation arm at the same headline config
-        # (identical numerics, asserted by tests): per iteration it
-        # touches only each query tile's y-band of the target features
-        # instead of re-reading the materialized volume pyramid — if the
-        # band stays narrow this can beat the all-pairs arm outright, at
-        # a fraction of the memory. The dynamic-trip-count row loop is
-        # the one kernel construct never compiled on a real chip before
-        # this capture; run_with_band_retry walks the dynamic →
-        # masked-static → off fallback ladder and records which mode
-        # produced the numbers (alternate_band /
-        # alternate_band_{mode}_error keys).
-        from raft_tpu.ops.corr_pallas import run_with_band_retry
-        cfga = RAFTConfig(iters=ITERS,
-                          mixed_precision=(platform == "tpu"),
-                          alternate_corr=True)
-        modela = RAFT(cfga)
-
-        def alternate_arm():
-            def fwda(i1, i2):
-                flow_up = modela.apply(variables, i1, i2,
-                                       test_mode=True)[1]
-                return flow_up, jnp.sum(flow_up)
-
-            payload["value_alternate_corr"] = round(
-                throughput(BATCH, jax.jit(fwda)), 3)
-
-        run_with_band_retry(alternate_arm, payload, "alternate")
-        _HEADLINE = dict(payload)
         try:
             payload.update(_sparse_metrics())
         except Exception as e:  # secondary must never sink the artifact

@@ -408,7 +408,11 @@ def golden_on_chip() -> dict:
                                       mixed_precision=True))):
 
         def run(name=name, kw=kw):
-            pred = load_predictor(weights, iters=12, **kw)
+            # corr_impl="fixed": each arm measures ITS engine — the
+            # round-4 "auto" eval default would re-dispatch the
+            # all-pairs arms onto the on-demand kernel on TPU.
+            pred = load_predictor(weights, iters=12, corr_impl="fixed",
+                                  **kw)
             res = validate_golden(pred)
             # raw float: the f32 arms measure float-noise-scale parity
             # that sub-1e-6 rounding would erase
