@@ -42,13 +42,23 @@ from raft_tpu.ops.sampling import bilinear_sampler
 # (which itself monkeypatches this global per arm) without a code edit.
 # Read ONCE at import — set it before importing raft_tpu; malformed
 # values fall back to the default rather than poisoning every import.
+#
+# Default 128: set from the round-4 on-chip crossover sweep
+# (TPU_EXTRAS.json ``msda_threshold``, v5e, 2640 value tokens): the
+# Pallas kernel never lost at ANY measured query count — 9675us vs
+# 9757us (jnp) already at Lq=128, widening to 9122 vs 12079 at
+# Lq=2640 — so the threshold is the smallest measured point rather
+# than the former unmeasured guess of 512. Below 128 sits only the
+# sparse-decoder regime (~100 learned queries/level), where the gather
+# path's advantage is architectural (tiny Lq, no dense structure) and
+# untimed differences are in the noise.
 import os as _os
 
 try:
     _PALLAS_MIN_QUERIES = int(
-        _os.environ.get("RAFT_MSDA_MIN_QUERIES", "512"))
+        _os.environ.get("RAFT_MSDA_MIN_QUERIES", "128"))
 except ValueError:
-    _PALLAS_MIN_QUERIES = 512
+    _PALLAS_MIN_QUERIES = 128
 
 
 def ms_deform_attn(value: jnp.ndarray,
