@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Batch-knee sweep for the round-4 headline engine (run alone on TPU).
+
+The bench headline batch (24) was tuned in round 2 for the
+*materialized* engine, whose f32 volume pyramid for 24 pairs fills
+~6 GB of HBM. The banded on-demand engine stores no volume
+(volume_memory: 0.69 vs 1.07 GB at b4), so its throughput knee may sit
+at a larger batch. Sweeps Sintel-resolution test_mode forward over
+batch sizes on both engines and prints one JSON line; feeds the
+bench.py BATCH decision (recorded in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax
+import jax.numpy as jnp
+
+H, W, ITERS = 440, 1024, 12
+WARMUP, REPS = 2, 6
+BATCHES = tuple(int(b) for b in
+                os.environ.get("RAFT_KNEE_BATCHES", "24,32,48,64").split(","))
+
+
+def main():
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.ops.corr_pallas import run_with_band_retry
+
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    base = RAFT(RAFTConfig(iters=ITERS, mixed_precision=True))
+    variables = base.init({"params": rng, "dropout": rng}, img1, img1,
+                          iters=1)
+    out = {"resolution": [H, W], "iters": ITERS, "reps": REPS}
+
+    for name, alt in (("alternate", True), ("all_pairs", False)):
+        model = RAFT(RAFTConfig(iters=ITERS, mixed_precision=True,
+                                alternate_corr=alt))
+
+        fwd = jax.jit(lambda a, b, m=model: (
+            lambda f: (f, jnp.sum(f)))(m.apply(variables, a, b,
+                                               test_mode=True)[1]))
+
+        for batch in BATCHES:
+            def arm(batch=batch, fwd=fwd, name=name):
+                img = jnp.broadcast_to(img1, (batch, H, W, 3))
+                for _ in range(WARMUP):
+                    float(fwd(img, img)[1])
+                t0 = time.perf_counter()
+                for _ in range(REPS):
+                    o = fwd(img, img)
+                float(o[1])
+                rate = REPS * batch / (time.perf_counter() - t0)
+                out[f"{name}_b{batch}_pairs_per_sec"] = round(rate, 2)
+
+            if alt:
+                if not run_with_band_retry(arm, out, f"{name}_b{batch}"):
+                    break               # OOM/compile wall: stop climbing
+            else:
+                try:
+                    arm()
+                except Exception as e:
+                    out[f"{name}_b{batch}_error"] = \
+                        f"{type(e).__name__}: {e}"
+                    break
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

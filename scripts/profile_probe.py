@@ -58,7 +58,10 @@ def headline():
 
     H, W = 440, 1024
     batch = int(os.environ.get("RAFT_PROBE_BATCH", "24"))
-    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    # RAFT_PROBE_ALT=1 profiles the on-demand banded engine (the round-4
+    # headline) instead of the materialized pyramid.
+    alt = os.environ.get("RAFT_PROBE_ALT") == "1"
+    cfg = RAFTConfig(iters=12, mixed_precision=True, alternate_corr=alt)
     model = RAFT(cfg)
     rng = jax.random.PRNGKey(0)
     img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
@@ -67,7 +70,8 @@ def headline():
     img = jnp.broadcast_to(img1, (batch, H, W, 3))
     fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
                                            test_mode=True)[1])
-    print(f"=== headline {batch}x{H}x{W} iters=12")
+    print(f"=== headline {batch}x{H}x{W} iters=12 "
+          f"engine={'alternate' if alt else 'all_pairs'}")
     _run(fwd, img, img)
 
 
