@@ -75,7 +75,13 @@ UNIT = "image-pairs/sec"
 BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
 H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
 ITERS = 12
-BATCH = 24
+BATCH = 24                      # materialized-arm knee (round-2 sweep:
+                                # its bf16 volume pyramid OOMs at b64)
+# Banded-arm operating point: the on-demand kernel stores no volume, so
+# its knee sits far higher — round-4 sweep (batch_knee_probe): 82.7 @
+# b24, 88.1 @ b48, 90.7 @ b64, 90.1 @ b96, 93.7 @ b128. b64 captures
+# all but ~3% of the measured max with half the compile/measure cost.
+ALT_BATCH = 64
 WARMUP = 2
 REPS = 10
 # sparse-family secondary metric: the fork's active training resolution
@@ -432,7 +438,7 @@ def main():
                 return flow_up, jnp.sum(flow_up)
 
             jfwda = jax.jit(fwda)
-            rate = throughput(BATCH, jfwda)
+            rate = throughput(ALT_BATCH, jfwda)
             payload["value_alternate_corr"] = round(rate, 3)
             alt_jit.append((jfwda, rate))
 
@@ -442,6 +448,8 @@ def main():
             payload["vs_baseline"] = round(
                 alt_rate / BASELINE_PAIRS_PER_SEC, 3)
             payload["headline_engine"] = "alternate_banded"
+            payload["batch"] = ALT_BATCH
+            payload["batch_all_pairs"] = BATCH
             # Pin the surviving band rung for the rest of the process:
             # batch1 below re-traces the promoted engine at batch 1, and
             # without this it would re-try the default dynamic mode even
