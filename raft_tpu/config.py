@@ -150,6 +150,14 @@ class OursConfig:
     dropout: float = 0.1
     corr_levels: int = 2            # fork default (reference core/corr.py:13)
     corr_radius: int = 4
+    # On-demand correlation for the one-shot center-grid lookups: computes
+    # each query's (2r+1)^2 window directly from (pooled) features instead
+    # of materializing the all-pairs volume + avg-pool chain — the chain
+    # the round-4 sparse_b8 profile measured at ~17% of the train step
+    # (pure HBM bandwidth). Numerically identical (linearity; contract
+    # tested incl. the fork's rescale=False drift). Off by default until
+    # the on-chip A/B lands.
+    alternate_corr: bool = False
     mixed_precision: bool = False
     # >0 enables the ours_07 lineage: that many deformable-encoder layers
     # refine the motion and context token sets (separate stacks) before

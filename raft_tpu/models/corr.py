@@ -175,7 +175,8 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
                      radius: int, scale: bool = True,
                      backend: str = "auto",
                      mxu_dtype: str = "float32",
-                     differentiable: bool = False) -> jnp.ndarray:
+                     differentiable: bool = False,
+                     rescale: bool = True) -> jnp.ndarray:
     """On-demand windowed lookup over a pooled feature pyramid; numerically
     identical to ``pyramid_lookup`` over the materialized volume.
 
@@ -225,10 +226,11 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     if use_pallas:
         return windowed_correlation_pallas_fused(
             fmap1, tuple(pyramid2), coords, radius, scale=scale,
-            mxu_dtype=mxu_dtype)
+            mxu_dtype=mxu_dtype, rescale=rescale)
     out = []
     for lvl, f2 in enumerate(pyramid2):
-        out.append(windowed_correlation(fmap1, f2, coords / (2 ** lvl),
+        lvl_coords = coords / (2 ** lvl) if rescale else coords
+        out.append(windowed_correlation(fmap1, f2, lvl_coords,
                                         radius, scale))
     return jnp.concatenate(out, axis=-1)
 
@@ -259,16 +261,18 @@ class AlternateCorrBlock:
     def __init__(self, fmap1: jnp.ndarray, fmap2: jnp.ndarray,
                  num_levels: int = 4, radius: int = 4, scale: bool = True,
                  backend: str = "auto", mxu_dtype: str = "float32",
-                 differentiable: bool = False):
+                 differentiable: bool = False, rescale: bool = True):
         self.radius = radius
         self.scale = scale
         self.backend = backend
         self.mxu_dtype = mxu_dtype
         self.differentiable = differentiable
+        self.rescale = rescale
         self.fmap1 = fmap1
         self.pyramid2 = build_feature_pyramid(fmap2, num_levels)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
         return alternate_lookup(self.fmap1, self.pyramid2, coords,
                                 self.radius, self.scale, self.backend,
-                                self.mxu_dtype, self.differentiable)
+                                self.mxu_dtype, self.differentiable,
+                                self.rescale)

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.config import OursConfig
-from raft_tpu.models.corr import CorrBlock
+from raft_tpu.models.corr import AlternateCorrBlock, CorrBlock
 from raft_tpu.models.deformable import (MLP,
                                         DeformableTransformerDecoderLayer,
                                         DeformableTransformerEncoderLayer)
@@ -109,20 +109,32 @@ class SparseRAFT(nn.Module):
         spatial_shapes = shapes * 2                  # img1 levels + img2
 
         # --- bidirectional fork-corr features per level (core/ours.py:370)
+        # cfg.alternate_corr computes the one-shot center-grid windows
+        # on demand (Pallas kernel on TPU) instead of materializing the
+        # all-pairs volume + avg-pool chain — numerically identical
+        # (linearity of pooling vs the dot product; the fork's
+        # rescale=False drift is reproduced in the kernel).
+        def _corr_block(f1, f2):
+            if cfg.alternate_corr:
+                return AlternateCorrBlock(
+                    f1, f2, num_levels=cfg.corr_levels,
+                    radius=cfg.corr_radius, rescale=False,
+                    differentiable=not test_mode)
+            return CorrBlock(f1, f2, num_levels=cfg.corr_levels,
+                             radius=cfg.corr_radius, rescale=False)
+
         corr_fwd, corr_bwd = [], []
         for lvl in range(L):
             h, w = E1[lvl].shape[1:3]
             centers = jnp.broadcast_to(
                 _center_grid(h, w, normalize=False).reshape(1, h, w, 2),
                 (B, h, w, 2))
-            corr_fwd.append(CorrBlock(
-                E1[lvl].astype(jnp.float32), E2[lvl].astype(jnp.float32),
-                num_levels=cfg.corr_levels, radius=cfg.corr_radius,
-                rescale=False)(centers).reshape(B, h * w, -1))
-            corr_bwd.append(CorrBlock(
-                E2[lvl].astype(jnp.float32), E1[lvl].astype(jnp.float32),
-                num_levels=cfg.corr_levels, radius=cfg.corr_radius,
-                rescale=False)(centers).reshape(B, h * w, -1))
+            corr_fwd.append(_corr_block(
+                E1[lvl].astype(jnp.float32),
+                E2[lvl].astype(jnp.float32))(centers).reshape(B, h * w, -1))
+            corr_bwd.append(_corr_block(
+                E2[lvl].astype(jnp.float32),
+                E1[lvl].astype(jnp.float32))(centers).reshape(B, h * w, -1))
 
         # --- token set: motion (corr MLP) + context (feature proj) halves
         corr_dim = cfg.corr_levels * (2 * cfg.corr_radius + 1) ** 2

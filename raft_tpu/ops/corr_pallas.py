@@ -176,7 +176,8 @@ def _chunk_loop(band: str, cy, radius, h2l, nchunks, body):
 
 
 def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
-                levels: tuple, mxu_dtype: str, band: str):
+                levels: tuple, mxu_dtype: str, band: str,
+                rescale: bool):
     """refs = (f2_l0..f2_lN, out, t1_scratch); levels = ((h2l, h2lp, w2pl),…)
     with h2lp the CHUNK-padded row count (padded rows are zero features →
     zero contribution)."""
@@ -192,8 +193,12 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
 
     level_rows = []
     for l, (h2l, h2lp, w2pl) in enumerate(levels):
-        cx = cx0 * (1.0 / 2 ** l)
-        cy = cy0 * (1.0 / 2 ** l)
+        # rescale=False reproduces the fork drift that samples every
+        # pooled level at UN-rescaled coords (core/corr.py:38-42) — the
+        # semantics the sparse-keypoint family was trained with.
+        lscale = (1.0 / 2 ** l) if rescale else 1.0
+        cx = cx0 * lscale
+        cy = cy0 * lscale
         nchunks = h2lp // _CHUNK
         t1_ref[0:win * w2pl, :] = jnp.zeros((win * w2pl, tq), jnp.float32)
 
@@ -232,7 +237,8 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
 
 
 def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
-                levels: tuple, mxu_dtype: str, band: str):
+                levels: tuple, mxu_dtype: str, band: str,
+                rescale: bool):
     """refs = (f2_l0.., g, df1, df2_l0.., u_scratch, df1_scratch). df2
     blocks are revisited across the query-tile grid axis: zeroed at tile
     0, then band-accumulated — no atomics. df1 accumulates in a VMEM
@@ -262,8 +268,9 @@ def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
 
     df1_acc_ref[...] = jnp.zeros((tq, c), jnp.float32)
     for l, (h2l, h2lp, w2pl) in enumerate(levels):
-        cx = cx0 * (1.0 / 2 ** l)
-        cy = cy0 * (1.0 / 2 ** l)
+        lscale = (1.0 / 2 ** l) if rescale else 1.0
+        cx = cx0 * lscale
+        cy = cy0 * lscale
         nchunks = h2lp // _CHUNK
         g = g_all[l * win * win:(l + 1) * win * win, :]  # (win*win, TQ)
 
@@ -325,7 +332,7 @@ def _pad_level(f2, h2p, w2p):
 
 
 def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                mxu_dtype, band):
+                mxu_dtype, band, rescale):
     """f1: (B, Np, C); f2s: per-level (B, H2lp*W2lp, C); cx/cy: (B, 1, Np)
     at level-0 scale; Np % tq == 0. Returns (B, L*win*win, Np) —
     query-minor; transposed by the wrapper."""
@@ -337,7 +344,7 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
 
     kernel = functools.partial(_fwd_kernel, radius=radius, scale=scale,
                                levels=levels, mxu_dtype=mxu_dtype,
-                               band=band)
+                               band=band, rescale=rescale)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -359,7 +366,7 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
 
 
 def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
-                mxu_dtype, band):
+                mxu_dtype, band, rescale):
     b, np_, c = f1.shape
     win = 2 * radius + 1
     nl = len(levels)
@@ -368,7 +375,7 @@ def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
 
     kernel = functools.partial(_bwd_kernel, radius=radius, scale=scale,
                                levels=levels, mxu_dtype=mxu_dtype,
-                               band=band)
+                               band=band, rescale=rescale)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -400,25 +407,26 @@ def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
     )(cx, cy, f1, *f2s, g)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-              mxu_dtype, band):
+              mxu_dtype, band, rescale):
     return _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                       tq, mxu_dtype, band)
+                       tq, mxu_dtype, band, rescale)
 
 
 def _windowed_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                  mxu_dtype, band):
+                  mxu_dtype, band, rescale):
     out = _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                      tq, mxu_dtype, band)
+                      tq, mxu_dtype, band, rescale)
     return out, (f1, f2s, cx, cy)
 
 
 def _windowed_bwd(radius, scale, interpret, levels, tq, mxu_dtype, band,
-                  res, g):
+                  rescale, res, g):
     f1, f2s, cx, cy = res
     grads = _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret,
-                        levels, tq, mxu_dtype, band)
+                        levels, tq, mxu_dtype, band, rescale)
     df1, df2s = grads[0], grads[1:]
     # Zero coordinate gradient — the contract of the reference extension
     # (correlation_kernel.cu:307) and of the detach-per-iteration scan.
@@ -487,11 +495,15 @@ def windowed_correlation_pallas_fused(
         fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray, radius: int,
         scale: bool = True, mxu_dtype: str = "float32",
         interpret: bool | None = None,
-        band: bool | None = None) -> jnp.ndarray:
+        band: bool | None = None,
+        rescale: bool = True) -> jnp.ndarray:
     """All pyramid levels of the on-demand windowed lookup in ONE fused
     Pallas launch; numerically identical to concatenating
     ``raft_tpu.models.corr.windowed_correlation`` over the levels with
-    ``coords / 2**level``.
+    ``coords / 2**level`` (``rescale=True``, canonical RAFT) or with
+    un-rescaled ``coords`` at every level (``rescale=False`` — the fork
+    drift the sparse-keypoint family was trained with,
+    ``core/corr.py:38-42``).
 
     Args:
       fmap1: ``(B, H, W, C)`` query features.
@@ -539,7 +551,7 @@ def windowed_correlation_pallas_fused(
     cy = cf[..., 1][:, None, :]
 
     out = _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                    mxu_dtype, band)
+                    mxu_dtype, band, rescale)
     out = jnp.swapaxes(out, 1, 2)                        # (B, Np, L*win*win)
     return out[:, :n].reshape(b, h, w, len(levels) * win * win)
 

@@ -142,17 +142,36 @@ def _train_rates(make_model, tcfg_kwargs, H, W, batches) -> dict:
 
 def sparse_train() -> dict:
     """SparseRAFT train-step rates at the fork's active resolution
-    (352x480, ``train_standard.sh:6``)."""
+    (352x480, ``train_standard.sh:6``); the ``alt_`` arms run the
+    on-demand correlation path (``OursConfig.alternate_corr`` — deletes
+    the volume + avg-pool chain the round-4 b8 profile measured at
+    ~17% of the step)."""
     from raft_tpu.config import OursConfig
 
-    def make_model():
+    def make_model(alternate=False):
         from raft_tpu.models import SparseRAFT
-        return SparseRAFT(OursConfig(mixed_precision=True))
+        return SparseRAFT(OursConfig(mixed_precision=True,
+                                     alternate_corr=alternate))
 
-    return _train_rates(
+    out = _train_rates(
         make_model,
         dict(model_family="sparse", iters=6, sparse_lambda=0.1),
         352, 480, (2, 4, 8))
+
+    # This is the first on-chip compile of the kernel's BACKWARD (the
+    # eval arms only ever ran the forward), so the band-retry wrapper is
+    # load-bearing: a Mosaic rejection must not discard the base arm's
+    # already-measured numbers above.
+    def alt_arm():
+        alt = _train_rates(
+            lambda: make_model(alternate=True),
+            dict(model_family="sparse", iters=6, sparse_lambda=0.1),
+            352, 480, (4, 8))
+        out.update({f"alt_{k}": v for k, v in alt.items()
+                    if k != "resolution"})
+
+    _run_with_band_retry(alt_arm, out, "alt_train", banded=True)
+    return out
 
 
 def raft_train() -> dict:

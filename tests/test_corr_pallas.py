@@ -330,3 +330,31 @@ def test_fused_eligibility_gate(rng):
     a = alternate_lookup(f1, (big,), coords, 2, backend="auto")
     b = alternate_lookup(f1, (big,), coords, 2, backend="jnp")
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rescale_false_matches_materialized(rng):
+    # The fork drift (rescale=False: every pooled level sampled at
+    # UN-rescaled coords, core/corr.py:38-42) must hold across the
+    # materialized pyramid, the jnp on-demand path, and the fused
+    # Pallas kernel — including coords that land outside the pooled
+    # levels' extent (where all paths must produce zeros).
+    from raft_tpu.models.corr import (AlternateCorrBlock, CorrBlock,
+                                      alternate_lookup,
+                                      build_feature_pyramid)
+    B, C, H, W, r, L = 1, 16, 12, 16, 3, 2
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(-1.0, max(H, W), (B, H, W, 2)),
+                         jnp.float32)
+    want = CorrBlock(f1, f2, num_levels=L, radius=r,
+                     rescale=False)(coords)
+    pyr = build_feature_pyramid(f2, L)
+    got_jnp = alternate_lookup(f1, pyr, coords, r, backend="jnp",
+                               rescale=False)
+    got_pallas = AlternateCorrBlock(f1, f2, num_levels=L, radius=r,
+                                    backend="pallas",
+                                    rescale=False)(coords)
+    np.testing.assert_allclose(np.asarray(got_jnp), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_pallas), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
