@@ -85,11 +85,15 @@ def sparse_b8():
 
     H, W = 352, 480
     rng = jax.random.PRNGKey(0)
+    # RAFT_PROBE_SPARSE_ALT=1 profiles the on-demand (alternate_corr)
+    # path — for the b4 anomaly (alt slower at b4 than b8, round 4).
+    alt = os.environ.get("RAFT_PROBE_SPARSE_ALT") == "1"
     for batch in (4, 8):
         tcfg = TrainConfig(batch_size=batch, image_size=(H, W),
                            model_family="sparse", iters=6,
                            sparse_lambda=0.1)
-        model = SparseRAFT(OursConfig(mixed_precision=True))
+        model = SparseRAFT(OursConfig(mixed_precision=True,
+                                      alternate_corr=alt))
         state = create_train_state(rng, model, tcfg, (H, W))
         step_fn = make_train_step(tcfg, donate=False)
         b = {"image1": jnp.ones((batch, H, W, 3)) * 127.0,
