@@ -9,6 +9,7 @@ over by ``jit`` without retracing surprises.
 from __future__ import annotations
 
 import dataclasses
+import os as _os
 from typing import Optional, Tuple
 
 
@@ -155,9 +156,18 @@ class OursConfig:
     # of materializing the all-pairs volume + avg-pool chain — the chain
     # the round-4 sparse_b8 profile measured at ~17% of the train step
     # (pure HBM bandwidth). Numerically identical (linearity; contract
-    # tested incl. the fork's rescale=False drift). Off by default until
-    # the on-chip A/B lands.
-    alternate_corr: bool = False
+    # tested incl. the fork's rescale=False drift). Default ON since the
+    # round-4 on-chip A/B: train step 108.6 → 89.8 ms at b4 (+21%) and
+    # 202.4 → 154.5 ms at b8 (+31%), stable over reps (TPU_EXTRAS
+    # sparse_train alt arms + the recheck recorded in BASELINE.md);
+    # device-time profile confirms the pool chain gone (85.0 → 62.3 ms
+    # at b4). False restores the materialized volume path; the
+    # RAFT_SPARSE_CORR=materialized env var does the same on every CLI
+    # entry point without a source edit (--alternate_corr stays a
+    # raft-family-only flag), read at config construction.
+    alternate_corr: bool = dataclasses.field(
+        default_factory=lambda: _os.environ.get(
+            "RAFT_SPARSE_CORR", "ondemand") != "materialized")
     mixed_precision: bool = False
     # >0 enables the ours_07 lineage: that many deformable-encoder layers
     # refine the motion and context token sets (separate stacks) before

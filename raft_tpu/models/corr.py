@@ -227,8 +227,19 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
         return windowed_correlation_pallas_fused(
             fmap1, tuple(pyramid2), coords, radius, scale=scale,
             mxu_dtype=mxu_dtype, rescale=rescale)
+    win = 2 * radius + 1
     out = []
     for lvl, f2 in enumerate(pyramid2):
+        if f2.shape[1] == 0 or f2.shape[2] == 0:
+            # Degenerate pooled level (a 1-row/col level pools to empty
+            # under VALID 2x2): every bilinear sample is out of range →
+            # exactly zero windows, matching the materialized pyramid's
+            # empty-volume-level behavior (its matmul form contracts
+            # over the empty axis). The gather-based sampler cannot
+            # index an empty array, so short-circuit.
+            b, h, w = fmap1.shape[0], coords.shape[1], coords.shape[2]
+            out.append(jnp.zeros((b, h, w, win * win), jnp.float32))
+            continue
         lvl_coords = coords / (2 ** lvl) if rescale else coords
         out.append(windowed_correlation(fmap1, f2, lvl_coords,
                                         radius, scale))
@@ -247,8 +258,11 @@ def alternate_eval_eligible(cfg, image_hw) -> bool:
     h8, w8 = h // 8, w // 8
     shapes = []
     for _ in range(cfg.corr_levels):
-        shapes.append((max(h8, 1), max(w8, 1)))
-        h8, w8 = h8 // 2, w8 // 2      # avg_pool2x2 is VALID stride-2
+        # True pooled shapes, including degenerate 0-size levels (VALID
+        # stride-2 pooling of a 1-row level) — fused_eligible rejects
+        # those, so the dispatch prediction matches the runtime gate.
+        shapes.append((h8, w8))
+        h8, w8 = h8 // 2, w8 // 2
     dtype_bytes = 2 if cfg.mixed_precision else 4
     return fused_eligible(shapes, cfg.fnet_dim, dtype_bytes, cfg.radius)
 

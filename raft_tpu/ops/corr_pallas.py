@@ -474,6 +474,11 @@ def fused_eligible(pyramid_shapes, channels: int,
     total = 0
     w2p_max = 8
     for (h2, w2) in pyramid_shapes:
+        if h2 == 0 or w2 == 0:
+            # Degenerate pooled level (tiny inputs): the jnp fallback
+            # short-circuits it to zero windows; the kernel's BlockSpecs
+            # can't express a zero-size input block.
+            return False
         w2p = _round_up(w2, 8)
         w2p_max = max(w2p_max, w2p)
         level = _round_up(h2, _CHUNK) * w2p * channels

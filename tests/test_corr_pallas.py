@@ -358,3 +358,26 @@ def test_rescale_false_matches_materialized(rng):
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(got_pallas), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_degenerate_pooled_level_matches_materialized(rng):
+    # A 1-row level pools to EMPTY under VALID 2x2 (tiny inputs — e.g.
+    # the multichip dryrun's shapes). The materialized pyramid yields
+    # all-zero windows there (matmul over the empty axis); the on-demand
+    # path must match instead of crashing the gather-based sampler, and
+    # the kernel-eligibility gate must reject the shape.
+    from raft_tpu.models.corr import (CorrBlock, alternate_lookup,
+                                      build_feature_pyramid)
+    from raft_tpu.ops.corr_pallas import fused_eligible
+    B, C, H, W, r, L = 1, 8, 1, 6, 2, 2
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(0, 4, (B, H, W, 2)), jnp.float32)
+    want = CorrBlock(f1, f2, num_levels=L, radius=r,
+                     rescale=False)(coords)
+    pyr = build_feature_pyramid(f2, L)
+    assert pyr[1].shape[1] == 0
+    assert not fused_eligible([p.shape[1:3] for p in pyr], C, 4, r)
+    got = alternate_lookup(f1, pyr, coords, r, rescale=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
