@@ -116,10 +116,13 @@ class SparseRAFT(nn.Module):
         # rescale=False drift is reproduced in the kernel).
         def _corr_block(f1, f2):
             if cfg.alternate_corr:
+                # out_dtype = the token projections' compute dtype (the
+                # consumer casts to it anyway); emitted in-kernel to
+                # skip the custom-call-boundary convert.
                 return AlternateCorrBlock(
                     f1, f2, num_levels=cfg.corr_levels,
                     radius=cfg.corr_radius, rescale=False,
-                    differentiable=not test_mode)
+                    differentiable=not test_mode, out_dtype=dtype)
             return CorrBlock(f1, f2, num_levels=cfg.corr_levels,
                              radius=cfg.corr_radius, rescale=False)
 

@@ -87,29 +87,40 @@ def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2, inference: bool):
     Returned as plain pytrees so they can cross ``nn.scan`` as broadcast
     arguments. ``inference`` resolves both "auto" dtype levers (bf16
     volume storage / bf16 MXU operands are inference-only; training keeps
-    the reference's autocast-exempt f32 correlation numerics — the
+    the reference's autocast-exempt f32 correlation *computation* — the
     reference casts fmaps to f32 before either corr path,
-    ``core/raft.py:103-104``). The resolved MXU dtype and a
+    ``core/raft.py:103-104``). The lookup's *output handoff* dtype is a
+    separate, numerics-neutral knob: under mixed precision the update
+    block always cast the windows to bf16 anyway, so the kernel emits
+    bf16 directly (bit-identical single rounding, training included) to
+    skip the custom-call-boundary convert. The resolved MXU dtype, a
     differentiable flag (training → the kernel-dispatch gate budgets
-    VMEM for the backward too) ride in the state tuple as static values
-    alongside the "alt"/"allpairs" tag.
+    VMEM for the backward too) and the output dtype ride in the state
+    tuple as static values alongside the "alt"/"allpairs" tag.
     """
     if cfg.alternate_corr:
-        return ("alt", (cfg.corr_mxu(inference), not inference), (fmap1,
-                corr.build_feature_pyramid(fmap2, cfg.corr_levels)))
-    return ("allpairs", ("float32", not inference), corr.build_corr_pyramid(
-        fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
-        cfg.corr_storage(inference)))
+        # out dtype = the update block's compute dtype: the lookup's
+        # consumer casts to it anyway (corr.astype(net.dtype)), and
+        # emitting it from inside the kernel skips the convert+copy at
+        # the custom-call boundary.
+        out_dt = "bfloat16" if cfg.mixed_precision else "float32"
+        return ("alt", (cfg.corr_mxu(inference), not inference, out_dt),
+                (fmap1, corr.build_feature_pyramid(fmap2, cfg.corr_levels)))
+    return ("allpairs", ("float32", not inference, "float32"),
+            corr.build_corr_pyramid(
+                fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
+                cfg.corr_storage(inference)))
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
-    kind, (mxu_dtype, differentiable), payload = corr_state
+    kind, (mxu_dtype, differentiable, out_dt), payload = corr_state
     if kind == "alt":
         fmap1, pyramid2 = payload
         return corr.alternate_lookup(fmap1, pyramid2, coords, cfg.radius,
                                      cfg.corr_scale,
                                      mxu_dtype=mxu_dtype,
-                                     differentiable=differentiable)
+                                     differentiable=differentiable,
+                                     out_dtype=jnp.dtype(out_dt))
     return corr.pyramid_lookup(payload, coords, cfg.radius)
 
 

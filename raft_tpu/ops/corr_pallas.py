@@ -233,7 +233,11 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
     out = jnp.concatenate(level_rows, axis=0)            # (L*win*win, TQ)
     if scale:
         out = out * inv_sqrt_c
-    out_ref[0] = out
+    # Emitting the consumer's dtype here is bit-identical to casting the
+    # float32 result outside the kernel, but saves the XLA-level
+    # convert+copy at the custom-call boundary (measured ~2% of the b64
+    # headline step as pure layout tax).
+    out_ref[0] = out.astype(out_ref.dtype)
 
 
 def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
@@ -332,7 +336,7 @@ def _pad_level(f2, h2p, w2p):
 
 
 def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                mxu_dtype, band, rescale):
+                mxu_dtype, band, rescale, out_dtype):
     """f1: (B, Np, C); f2s: per-level (B, H2lp*W2lp, C); cx/cy: (B, 1, Np)
     at level-0 scale; Np % tq == 0. Returns (B, L*win*win, Np) —
     query-minor; transposed by the wrapper."""
@@ -359,7 +363,7 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
         out_specs=pl.BlockSpec((1, nl * win * win, tq),
                                lambda bi, ti: (bi, 0, ti)),
         out_shape=jax.ShapeDtypeStruct((b, nl * win * win, np_),
-                                       jnp.float32),
+                                       out_dtype),
         scratch_shapes=[pltpu.VMEM((win * w2p_max, tq), jnp.float32)],
         interpret=interpret,
     )(cx, cy, f1, *f2s)
@@ -408,23 +412,25 @@ def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-              mxu_dtype, band, rescale):
+              mxu_dtype, band, rescale, out_dtype):
     return _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                       tq, mxu_dtype, band, rescale)
+                       tq, mxu_dtype, band, rescale, out_dtype)
 
 
 def _windowed_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                  mxu_dtype, band, rescale):
+                  mxu_dtype, band, rescale, out_dtype):
     out = _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                      tq, mxu_dtype, band, rescale)
+                      tq, mxu_dtype, band, rescale, out_dtype)
     return out, (f1, f2s, cx, cy)
 
 
 def _windowed_bwd(radius, scale, interpret, levels, tq, mxu_dtype, band,
-                  rescale, res, g):
+                  rescale, out_dtype, res, g):
     f1, f2s, cx, cy = res
+    # out_dtype shapes only the forward output; the cotangent g already
+    # arrives in it, and gradient outputs are always float32.
     grads = _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret,
                         levels, tq, mxu_dtype, band, rescale)
     df1, df2s = grads[0], grads[1:]
@@ -501,7 +507,8 @@ def windowed_correlation_pallas_fused(
         scale: bool = True, mxu_dtype: str = "float32",
         interpret: bool | None = None,
         band: bool | None = None,
-        rescale: bool = True) -> jnp.ndarray:
+        rescale: bool = True,
+        out_dtype=jnp.float32) -> jnp.ndarray:
     """All pyramid levels of the on-demand windowed lookup in ONE fused
     Pallas launch; numerically identical to concatenating
     ``raft_tpu.models.corr.windowed_correlation`` over the levels with
@@ -529,8 +536,16 @@ def windowed_correlation_pallas_fused(
         dynamic, "static", "0" → off); True/False accepted as
         dynamic/off.
 
+      out_dtype: dtype of the returned windows (default float32).
+        Emitted by the kernel's final store — bit-identical to casting
+        the float32 accumulator afterwards (one rounding either way;
+        ``test_out_dtype_bitexact_vs_external_cast``), but skips the
+        XLA convert+copy at the custom-call boundary (~2% of the b64
+        headline step). Gradients are always float32.
+
     Returns:
-      ``(B, H, W, L*(2r+1)^2)`` float32, level-major on the last axis.
+      ``(B, H, W, L*(2r+1)^2)`` ``out_dtype``, level-major on the last
+      axis.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -556,7 +571,7 @@ def windowed_correlation_pallas_fused(
     cy = cf[..., 1][:, None, :]
 
     out = _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                    mxu_dtype, band, rescale)
+                    mxu_dtype, band, rescale, jnp.dtype(out_dtype))
     out = jnp.swapaxes(out, 1, 2)                        # (B, Np, L*win*win)
     return out[:, :n].reshape(b, h, w, len(levels) * win * win)
 

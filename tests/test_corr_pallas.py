@@ -381,3 +381,42 @@ def test_degenerate_pooled_level_matches_materialized(rng):
     got = alternate_lookup(f1, pyr, coords, r, rescale=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_out_dtype_bitexact_vs_external_cast(rng):
+    # out_dtype=bfloat16 emitted from inside the kernel must be
+    # BIT-identical to casting the float32 kernel output afterwards
+    # (same single rounding of the f32 accumulator), forward and
+    # backward — the lever only removes the XLA convert+copy at the
+    # custom-call boundary, never changes numerics.
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+    B, C, H, W, r = 1, 16, 8, 12, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(-2, 10, (B, H, W, 2)), jnp.float32)
+    pyr = build_feature_pyramid(f2, 2)
+
+    direct = windowed_correlation_pallas_fused(
+        f1, pyr, coords, r, interpret=True, out_dtype=jnp.bfloat16)
+    external = windowed_correlation_pallas_fused(
+        f1, pyr, coords, r, interpret=True).astype(jnp.bfloat16)
+    assert direct.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(direct.astype(jnp.float32)),
+                                  np.asarray(external.astype(jnp.float32)))
+
+    cot = _rand(rng, B, H, W, 2 * (2 * r + 1) ** 2).astype(jnp.bfloat16)
+
+    def grads(out_dtype):
+        def loss(a, b):
+            out = windowed_correlation_pallas_fused(
+                a, build_feature_pyramid(b, 2), coords, r,
+                interpret=True, out_dtype=out_dtype)
+            return jnp.sum(out.astype(jnp.float32)
+                           * cot.astype(jnp.float32))
+        return jax.grad(loss, argnums=(0, 1))(f1, f2)
+
+    g_bf = grads(jnp.bfloat16)
+    g_f32 = grads(jnp.float32)
+    for a, b in zip(g_bf, g_f32):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
