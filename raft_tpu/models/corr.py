@@ -250,13 +250,17 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     return jnp.concatenate(out, axis=-1).astype(out_dtype)
 
 
-def alternate_eval_eligible(cfg, image_hw) -> bool:
-    """Whether the fused on-demand kernel admits a canonical-RAFT eval at
+def alternate_eval_eligible(cfg, image_hw,
+                            differentiable: bool = False) -> bool:
+    """Whether the fused on-demand kernel admits a canonical-RAFT run at
     this padded image size (stride-8 features, ``cfg.corr_levels`` pooled
     levels, bf16 features under the mixed-precision policy). Used by the
-    eval path's ``corr_impl="auto"`` dispatch — on-chip measurement
-    (BENCH r4: 84.3 vs 56.1 pairs/s at Sintel) made the on-demand kernel
-    the preferred eval path wherever it fits VMEM."""
+    ``corr_impl="auto"`` dispatch on both the eval path and (with
+    ``differentiable=True``, which budgets the backward's VMEM) the
+    training path — on-chip measurement made the on-demand kernel the
+    preferred engine wherever it fits VMEM (BENCH r4: 93.7 vs 55.9
+    pairs/s Sintel eval; train step +34%/+49% at chairs b4/b8,
+    TPU_EXTRAS raft_train alt arms)."""
     from raft_tpu.ops.corr_pallas import fused_eligible
     h, w = image_hw
     h8, w8 = h // 8, w // 8
@@ -268,7 +272,8 @@ def alternate_eval_eligible(cfg, image_hw) -> bool:
         shapes.append((h8, w8))
         h8, w8 = h8 // 2, w8 // 2
     dtype_bytes = 2 if cfg.mixed_precision else 4
-    return fused_eligible(shapes, cfg.fnet_dim, dtype_bytes, cfg.radius)
+    return fused_eligible(shapes, cfg.fnet_dim, dtype_bytes, cfg.radius,
+                          differentiable=differentiable)
 
 
 class AlternateCorrBlock:

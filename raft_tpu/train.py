@@ -253,6 +253,43 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     return state
 
 
+def resolve_train_corr_engine(model_family, corr_impl, alternate_corr,
+                              corr_dtype, small, mixed_precision,
+                              image_size, spatial_shards: int = 1) -> bool:
+    """Resolve whether canonical-RAFT training runs through the
+    on-demand banded kernel.
+
+    ``corr_impl=None`` defaults to "auto" for the raft family: train
+    through the kernel on TPU wherever the crop fits its *backward*
+    VMEM budget — measured +34%/+49% samples/s at chairs b4/b8 with
+    ~1.4 GB less HBM (TPU_EXTRAS raft_train alt arms), identical
+    numerics (f32 accumulation, same zero-coords-grad contract). An
+    explicit ``--alternate_corr`` always wins; an explicit
+    ``--corr_dtype bfloat16`` (a materialized-storage lever) pins the
+    materialized engine rather than silently losing its meaning; off
+    TPU the jnp on-demand path is slower than the materialized matmul
+    form, so auto keeps the volume there."""
+    if alternate_corr:
+        return True
+    corr_impl = corr_impl or ("auto" if model_family == "raft"
+                              else "fixed")
+    if corr_impl != "auto" or corr_dtype == "bfloat16":
+        return False
+    if spatial_shards > 1:
+        # Mirror the eval path (load_predictor/FlowPredictor): the
+        # spatially-sharded path pins the materialized engine — each
+        # shard holds only its local target rows, which the kernel's
+        # whole-level VMEM residency assumption does not cover.
+        return False
+    import jax as _jax
+
+    from raft_tpu.models.corr import alternate_eval_eligible
+    probe_cfg = RAFTConfig(small=small, mixed_precision=mixed_precision)
+    return (_jax.default_backend() == "tpu"
+            and alternate_eval_eligible(probe_cfg, image_size,
+                                        differentiable=True))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Train RAFT (TPU-native). Flags mirror the reference "
@@ -307,6 +344,17 @@ def main(argv=None):
                              "canonical family only, must divide the "
                              "device count and the image height)")
     parser.add_argument("--val_freq", type=int, default=5000)
+    parser.add_argument("--corr_impl", default=None,
+                        choices=["fixed", "auto"],
+                        help="correlation engine for canonical-RAFT "
+                             "training: 'auto' (default for the raft "
+                             "family) trains through the on-demand "
+                             "banded kernel on TPU when the crop fits "
+                             "its backward VMEM budget — measured +34% "
+                             "samples/s at chairs b4 and +49% at b8 "
+                             "with ~1.4 GB less HBM, numerics "
+                             "identical; 'fixed' honors "
+                             "--alternate_corr as given")
     parser.add_argument("--data_root", default=None)
     parser.add_argument("--ckpt_dir", default="checkpoints")
     parser.add_argument("--log_dir", default="runs")
@@ -321,6 +369,15 @@ def main(argv=None):
                      "(sparse or two_stage)")
     iters = args.iters if args.iters is not None else 12
 
+    if args.corr_impl == "auto" and args.model_family != "raft":
+        parser.error("--corr_impl auto applies to the canonical RAFT "
+                     f"family only (the {args.model_family} family's "
+                     "correlation engine has its own config default)")
+    alternate = resolve_train_corr_engine(
+        args.model_family, args.corr_impl, args.alternate_corr,
+        args.corr_dtype, args.small, args.mixed_precision,
+        tuple(args.image_size), args.spatial_shards)
+
     tcfg = TrainConfig(
         name=args.name, stage=args.stage,
         model_family=args.model_family, sparse_lambda=args.sparse_lambda,
@@ -332,7 +389,7 @@ def main(argv=None):
         val_freq=args.val_freq, scheduler=args.scheduler, seed=args.seed)
     mcfg = RAFTConfig(
         small=args.small, dropout=args.dropout, iters=iters,
-        alternate_corr=args.alternate_corr,
+        alternate_corr=alternate,
         mixed_precision=args.mixed_precision,
         corr_dtype=args.corr_dtype or "auto")
 

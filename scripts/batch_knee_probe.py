@@ -28,7 +28,12 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 import jax
 import jax.numpy as jnp
 
-H, W, ITERS = 440, 1024, 12
+_res = os.environ.get("RAFT_KNEE_RES", "440,1024").split(",")
+if len(_res) != 2:
+    raise SystemExit(f"RAFT_KNEE_RES must be 'H,W', got "
+                     f"{os.environ['RAFT_KNEE_RES']!r}")
+H, W = int(_res[0]), int(_res[1])
+ITERS = int(os.environ.get("RAFT_KNEE_ITERS", "12"))
 WARMUP, REPS = 2, 6
 BATCHES = tuple(int(b) for b in
                 os.environ.get("RAFT_KNEE_BATCHES", "24,32,48,64").split(","))

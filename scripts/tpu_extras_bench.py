@@ -140,6 +140,21 @@ def _train_rates(make_model, tcfg_kwargs, H, W, batches) -> dict:
     return out
 
 
+def _alt_train_arm(out: dict, make_alt_model, tcfg_kwargs, H, W,
+                   batches, name: str) -> None:
+    """Banded-kernel training arm shared by sparse_train/raft_train:
+    measure _train_rates on the on-demand model, merge under an
+    ``alt_`` prefix, band-retry wrapped — the kernel's backward
+    compiling is exactly what the retry ladder protects, and a failure
+    must not discard the base arm's already-measured numbers."""
+    def arm():
+        alt = _train_rates(make_alt_model, tcfg_kwargs, H, W, batches)
+        out.update({f"alt_{k}": v for k, v in alt.items()
+                    if k != "resolution"})
+
+    _run_with_band_retry(arm, out, name, banded=True)
+
+
 def sparse_train() -> dict:
     """SparseRAFT train-step rates at the fork's active resolution
     (352x480, ``train_standard.sh:6``); the ``alt_`` arms run the
@@ -157,33 +172,29 @@ def sparse_train() -> dict:
         make_model,
         dict(model_family="sparse", iters=6, sparse_lambda=0.1),
         352, 480, (2, 4, 8))
-
-    # This is the first on-chip compile of the kernel's BACKWARD (the
-    # eval arms only ever ran the forward), so the band-retry wrapper is
-    # load-bearing: a Mosaic rejection must not discard the base arm's
-    # already-measured numbers above.
-    def alt_arm():
-        alt = _train_rates(
-            lambda: make_model(alternate=True),
-            dict(model_family="sparse", iters=6, sparse_lambda=0.1),
-            352, 480, (4, 8))
-        out.update({f"alt_{k}": v for k, v in alt.items()
-                    if k != "resolution"})
-
-    _run_with_band_retry(alt_arm, out, "alt_train", banded=True)
+    _alt_train_arm(out, lambda: make_model(alternate=True),
+                   dict(model_family="sparse", iters=6, sparse_lambda=0.1),
+                   352, 480, (4, 8), "sparse_alt_train")
     return out
 
 
 def raft_train() -> dict:
     """Canonical RAFT train-step rates at the original chairs-stage
-    resolution (368x496, ``train_mixed.sh:3``), mixed precision."""
+    resolution (368x496, ``train_mixed.sh:3``), mixed precision; the
+    ``alt_`` arms train through the on-demand banded kernel (backward
+    proven on-chip by the sparse A/B) instead of the materialized
+    volume — numerics-identical, f32 accumulation either way."""
     from raft_tpu.config import RAFTConfig
 
-    def make_model():
+    def make_model(alternate=False):
         from raft_tpu.models.raft import RAFT
-        return RAFT(RAFTConfig(iters=12, mixed_precision=True))
+        return RAFT(RAFTConfig(iters=12, mixed_precision=True,
+                               alternate_corr=alternate))
 
-    return _train_rates(make_model, dict(iters=12), 368, 496, (4, 8))
+    out = _train_rates(make_model, dict(iters=12), 368, 496, (4, 8))
+    _alt_train_arm(out, lambda: make_model(alternate=True),
+                   dict(iters=12), 368, 496, (4, 8), "raft_alt_train")
+    return out
 
 
 def kitti_eval() -> dict:

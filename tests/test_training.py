@@ -345,3 +345,37 @@ def test_sparse_family_train_step(rng):
                                params_before),
         0.0)
     assert diff > 0
+
+
+def test_resolve_train_corr_engine():
+    """The training-path corr_impl='auto' resolution: on-demand on TPU
+    when the crop fits the backward budget; explicit --alternate_corr
+    wins; an explicit bf16 volume-storage request pins the materialized
+    engine; off-TPU (this suite) auto keeps the volume."""
+    from unittest import mock
+
+    from raft_tpu.train import resolve_train_corr_engine
+
+    # auto never picks the kernel off-TPU (backend pinned, not assumed
+    # from the host this suite happens to run on)
+    with mock.patch("jax.default_backend", return_value="cpu"):
+        assert resolve_train_corr_engine(
+            "raft", None, False, None, False, True, (368, 496)) is False
+    # on TPU at the benchmarked chairs crop, auto picks the kernel —
+    # and sharded training pins the materialized engine like eval does
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        assert resolve_train_corr_engine(
+            "raft", None, False, None, False, True, (368, 496)) is True
+        assert resolve_train_corr_engine(
+            "raft", None, False, None, False, True, (368, 496),
+            spatial_shards=2) is False
+    # explicit force-on always wins
+    assert resolve_train_corr_engine(
+        "raft", "fixed", True, None, False, True, (368, 496)) is True
+    # explicit bf16 storage pins the materialized engine
+    assert resolve_train_corr_engine(
+        "raft", "auto", False, "bfloat16", False, True,
+        (368, 496)) is False
+    # non-raft families resolve fixed
+    assert resolve_train_corr_engine(
+        "sparse", None, False, None, False, True, (352, 480)) is False
