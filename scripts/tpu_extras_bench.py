@@ -487,11 +487,17 @@ def train_convergence() -> dict:
     from raft_tpu.parallel import create_train_state, make_train_step
 
     steps = int(os.environ.get("RAFT_CONV_STEPS", "500"))
+    # RAFT_CONV_ALT=1 runs the raft family through the on-demand banded
+    # engine (the round-4 train default on TPU); the sparse family
+    # follows its own config default either way.
+    raft_alt = os.environ.get("RAFT_CONV_ALT") == "1"
     every, pool, batch = max(1, steps // 50), 16, 4
-    out = {"steps": steps, "batch": batch, "seed": 0}
+    out = {"steps": steps, "batch": batch, "seed": 0,
+           "raft_engine": "alternate" if raft_alt else "materialized"}
     for family, make_model, (H, W), tkw in (
             ("raft",
-             lambda: RAFT(RAFTConfig(iters=12, mixed_precision=True)),
+             lambda: RAFT(RAFTConfig(iters=12, mixed_precision=True,
+                                     alternate_corr=raft_alt)),
              (368, 496), dict(iters=12)),
             ("sparse",
              lambda: SparseRAFT(OursConfig(mixed_precision=True)),
