@@ -513,12 +513,13 @@ def _sparse_metrics() -> dict:
     Same dispatch/sync discipline as the headline metric."""
     import jax
     import jax.numpy as jnp
-    from raft_tpu.config import OursConfig
+    from raft_tpu.config import OursConfig, sparse_corr_from_env
     from raft_tpu.models import SparseRAFT
 
     platform = jax.devices()[0].platform
     h, w, batch = SPARSE_H, SPARSE_W, SPARSE_BATCH
-    model = SparseRAFT(OursConfig(mixed_precision=(platform == "tpu")))
+    model = SparseRAFT(OursConfig(mixed_precision=(platform == "tpu"),
+                                  alternate_corr=sparse_corr_from_env()))
     rng = jax.random.PRNGKey(0)
     img = jax.random.uniform(rng, (batch, h, w, 3), jnp.float32) * 255.0
     variables = model.init({"params": rng, "dropout": rng}, img, img)

@@ -164,10 +164,11 @@ class OursConfig:
     # at b4). False restores the materialized volume path; the
     # RAFT_SPARSE_CORR=materialized env var does the same on every CLI
     # entry point without a source edit (--alternate_corr stays a
-    # raft-family-only flag), read at config construction.
-    alternate_corr: bool = dataclasses.field(
-        default_factory=lambda: _os.environ.get(
-            "RAFT_SPARSE_CORR", "ondemand") != "materialized")
+    # raft-family-only flag) — applied by the entry points via
+    # sparse_corr_from_env(), NOT here: a frozen config's default must
+    # be deterministic (equality, hashing, jit static-arg identity
+    # must not depend on the environment — ADVICE r4 low-3).
+    alternate_corr: bool = True
     mixed_precision: bool = False
     # >0 enables the ours_07 lineage: that many deformable-encoder layers
     # refine the motion and context token sets (separate stacks) before
@@ -186,6 +187,17 @@ class OursConfig:
         c = self.base_channel
         return [round(c * 1.5), c * 2, round(c * 3), c * 4][
             4 - self.num_feature_levels:]
+
+
+def sparse_corr_from_env() -> bool:
+    """Entry-point-layer default for ``OursConfig.alternate_corr``:
+    ``RAFT_SPARSE_CORR=materialized`` restores the materialized volume
+    path on any CLI without a source edit. Read here — at the CLI layer,
+    like ``RAFT_CORR_BAND`` — rather than in the frozen dataclass's
+    default, so constructed configs stay deterministic (ADVICE r4
+    low-3: env-dependent defaults break config equality/hash/jit
+    static-arg identity across processes and checkpoint reloads)."""
+    return _os.environ.get("RAFT_SPARSE_CORR", "ondemand") != "materialized"
 
 
 # Trainable/evaluable model families: the two live ones plus the rebuilt

@@ -99,6 +99,14 @@ class FlowDataset:
         out.extra_info = v * self.extra_info
         return out
 
+    def reseed(self, seed) -> None:
+        """Reseed the augmentation stream(s). Used by the process-pool
+        loader: forked workers inherit identical ``Generator`` states, so
+        each worker reseeds with its own (seed, epoch, worker_id) tuple
+        to decorrelate augmentation across workers."""
+        if self.augmentor is not None:
+            self.augmentor.rng = np.random.default_rng(seed)
+
     def __add__(self, other: "FlowDataset") -> "FlowDataset":
         return _ConcatDataset([self, other])
 
@@ -131,6 +139,10 @@ class _ConcatDataset(FlowDataset):
 
     def __add__(self, other):
         return _ConcatDataset(self.parts + [other])
+
+    def reseed(self, seed) -> None:
+        for i, p in enumerate(self.parts):
+            p.reseed((*seed, i) if isinstance(seed, tuple) else (seed, i))
 
     def __rmul__(self, v):
         return _ConcatDataset(v * list(self.parts))
@@ -310,46 +322,148 @@ class DataLoader:
         for i in range(0, stop, bs):
             yield order[i:i + bs]
 
-    def __iter__(self):
-        from concurrent.futures import ThreadPoolExecutor
-
+    def _epoch_order(self):
         rng = np.random.default_rng(self.seed + self.epoch)
+        epoch = self.epoch
         self.epoch += 1
         order = np.arange(len(self.dataset))
         if self.shuffle:
             rng.shuffle(order)
+        return order, epoch
+
+    def _prefetch_loop(self, order, submit, result):
+        """Shared pump for both loader kinds: keep ``prefetch`` batches
+        of per-sample futures in flight via ``submit(idx)``, drain in
+        order via ``result(fut)``, yield stacked NHWC batch dicts."""
+        pending = []
+        batches = list(self._batches(order))
+        k = 0
+        while k < len(batches) or pending:
+            while k < len(batches) and len(pending) < self.prefetch:
+                pending.append([submit(i) for i in batches[k]])
+                k += 1
+            samples = [result(f) for f in pending.pop(0)]
+            yield {
+                "image1": np.stack([s[0] for s in samples]),
+                "image2": np.stack([s[1] for s in samples]),
+                "flow": np.stack([s[2] for s in samples]),
+                "valid": np.stack([s[3] for s in samples]),
+            }
+
+    def __iter__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        order, _ = self._epoch_order()
 
         def load(idx):
             img1, img2, flow, valid = self.dataset[int(idx)]
             return img1, img2, flow, valid
 
         with ThreadPoolExecutor(self.num_workers) as pool:
-            pending = []
-            batches = list(self._batches(order))
-            k = 0
-            # Keep `prefetch` batches in flight.
-            while k < len(batches) or pending:
-                while k < len(batches) and len(pending) < self.prefetch:
-                    pending.append([pool.submit(load, i)
-                                    for i in batches[k]])
-                    k += 1
-                futs = pending.pop(0)
-                samples = [f.result() for f in futs]
-                yield {
-                    "image1": np.stack([s[0] for s in samples]),
-                    "image2": np.stack([s[1] for s in samples]),
-                    "flow": np.stack([s[2] for s in samples]),
-                    "valid": np.stack([s[3] for s in samples]),
-                }
+            yield from self._prefetch_loop(
+                order, lambda i: pool.submit(load, i),
+                lambda f: f.result())
+
+
+# Worker-process global: set once per worker by the pool initializer
+# (the dataset is pickled once per worker at pool start — file lists +
+# augmentor params, a few hundred KB — never per task).
+_WORKER_DS = None
+
+
+def _process_worker_init(dataset, seed, epoch, counter):
+    global _WORKER_DS
+    with counter.get_lock():
+        wid = counter.value
+        counter.value += 1
+    _WORKER_DS = dataset
+    _WORKER_DS.reseed((seed, epoch, wid))
+
+
+def _process_worker_load(idx):
+    s = _WORKER_DS[int(idx)]
+    return s[0], s[1], s[2], s[3]
+
+
+class ProcessDataLoader(DataLoader):
+    """Worker-*process* prefetching batch loader — the analogue of torch
+    ``DataLoader(num_workers=24)`` (reference ``core/datasets.py:237``).
+
+    The thread loader overlaps file IO and the GIL-releasing C++
+    augmentation hot path, but the numpy fractions of each sample
+    (decode → float32, remap assembly, batch stacking) hold the GIL —
+    measured ~14 samples/s/core ceiling (LOADER_BENCH.json). On
+    multi-core hosts (real TPU pods: dozens of cores) worker processes
+    are the scaling path: each worker owns a full Python interpreter,
+    samples return via pipe as numpy pickles (zero-copy buffer
+    serialization), and the parent only stacks batches.
+
+    Workers come from a ``forkserver`` context, NOT plain ``fork``: by
+    loader-iteration time the parent has long since initialized JAX's
+    runtime (create_train_state precedes the first batch), so it is
+    multi-threaded, and forking a multi-threaded process can inherit a
+    lock mid-acquisition and deadlock the child. The fork *server* is a
+    clean single-threaded process spawned at first use; workers fork
+    from it, never from the JAX-infested parent. Each worker reseeds
+    its augmentation stream with (seed, epoch, worker_id) so workers
+    don't produce identical crops.
+    """
+
+    def __iter__(self):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("forkserver")
+        order, epoch = self._epoch_order()
+        counter = ctx.Value("i", 0)
+        pool = ctx.Pool(self.num_workers, initializer=_process_worker_init,
+                        initargs=(self.dataset, self.seed, epoch, counter))
+        try:
+            yield from self._prefetch_loop(
+                order,
+                lambda i: pool.apply_async(_process_worker_load, (i,)),
+                lambda f: f.get())
+        finally:
+            pool.terminate()
+            pool.join()
+
+
+def select_loader(loader: str = "auto",
+                  num_workers: Optional[int] = None):
+    """Resolve the input-pipeline kind and worker count for this host.
+
+    ``loader``: ``"thread"`` (GIL-sharing prefetcher — right for 1-2
+    core hosts, where process transfer overhead only subtracts),
+    ``"process"`` (worker processes via forkserver, the torch
+    ``num_workers=24`` analogue — the scaling path on real multi-core
+    TPU-pod hosts), or ``"auto"`` (process iff ≥4 cores).
+    ``num_workers=None`` sizes the pool to the host: ~1 worker per
+    core, capped at 24 (the reference's setting), min 4 — per-core
+    loader rate is ~14-18 samples/s (LOADER_BENCH.json), so the
+    measured 49.3 samples/s device train rate needs ≥4 cores regardless
+    of loader kind. Returns ``(loader_cls, num_workers)``; the bench
+    (``tpu_extras_bench.loader_train``) uses the same resolution so its
+    numbers measure the pipeline training actually runs."""
+    if loader not in ("auto", "thread", "process"):
+        raise ValueError(f"loader must be auto|thread|process: {loader!r}")
+    cores = os.cpu_count() or 1
+    if loader == "auto":
+        loader = "process" if cores >= 4 else "thread"
+    if num_workers is None:
+        num_workers = max(4, min(cores, 24))
+    cls = ProcessDataLoader if loader == "process" else DataLoader
+    return cls, num_workers
 
 
 def fetch_dataloader(stage: str, batch_size: int,
                      image_size: Tuple[int, int],
-                     num_workers: int = 4, seed: int = 0,
+                     num_workers: Optional[int] = None, seed: int = 0,
                      root: Optional[str] = None,
-                     full_mix: bool = True) -> DataLoader:
+                     full_mix: bool = True,
+                     loader: str = "auto") -> DataLoader:
     """Stage-specific dataset mixtures (reference
-    ``core/datasets.py:205-240``)."""
+    ``core/datasets.py:205-240``). ``loader``/``num_workers``: see
+    :func:`select_loader`."""
+    cls, num_workers = select_loader(loader, num_workers)
     crop = {"crop_size": image_size}
     if stage == "chairs":
         aug = dict(crop, min_scale=-0.1, max_scale=1.0, do_flip=True)
@@ -385,5 +499,5 @@ def fetch_dataloader(stage: str, batch_size: int,
     else:
         raise ValueError(f"unknown stage {stage!r}")
 
-    return DataLoader(train_dataset, batch_size=batch_size, shuffle=True,
-                      num_workers=num_workers, drop_last=True, seed=seed)
+    return cls(train_dataset, batch_size=batch_size, shuffle=True,
+               num_workers=num_workers, drop_last=True, seed=seed)

@@ -42,9 +42,11 @@ class FlowPredictor:
         amortizes dispatch and fills the MXU; tail batches are padded by
         repeating the last frame) and 1 elsewhere.
       corr_impl: ``"fixed"`` uses ``model`` as configured. ``"auto"``
-        (canonical RAFT only; rejected for other families and for
-        spatially-sharded eval rather than silently ignored) picks the
-        correlation engine per padded shape: the fused on-demand Pallas
+        (canonical RAFT only; rejected for other families rather than
+        silently ignored) picks the correlation engine per padded
+        shape — including under spatially-sharded eval since round 5,
+        where the kernel runs per-shard via shard_map when the feature
+        rows divide the spatial axis: the fused on-demand Pallas
         kernel wherever its VMEM-resident layout admits the shape on TPU
         (:func:`raft_tpu.models.corr.alternate_eval_eligible` — measured
         1.5x faster than the materialized volume at Sintel eval, BENCH
@@ -72,11 +74,6 @@ class FlowPredictor:
                     "corr_impl='auto' applies to the canonical RAFT "
                     "family only (other families fix their correlation "
                     "semantics architecturally)")
-            if mesh is not None:
-                raise ValueError(
-                    "corr_impl='auto' is incompatible with spatially-"
-                    "sharded eval (the mesh path pins one engine); "
-                    "pass corr_impl='fixed'")
             cfg = model.config
             # Engine siblings share params; per-engine config knobs that
             # the *other* engine's validator rejects are reset to "auto"
@@ -127,8 +124,26 @@ class FlowPredictor:
                         "(InputPadder pads to /8)")
                 from raft_tpu.parallel.spatial import spatial_jit
 
-                def run(variables, image1, image2):
-                    return self.model.apply(
+                model = self.model
+                if self._engines is not None:
+                    # Per-shape engine dispatch under spatial sharding
+                    # (round 5, VERDICT r4 #2): the banded kernel
+                    # composes with the row-sharded forward via
+                    # shard_map (models.corr._sharded_fused_lookup),
+                    # so high-resolution multi-chip eval no longer eats
+                    # the materialized engine's 1.5-1.7x penalty where
+                    # the kernel fits VMEM and rows divide evenly.
+                    from raft_tpu.models.corr import alternate_eval_eligible
+                    allpairs, alternate = self._engines
+                    model = (alternate
+                             if jax.default_backend() == "tpu"
+                             and alternate_eval_eligible(
+                                 self.model.config, shape[1:3],
+                                 spatial_shards=n_sp)
+                             else allpairs)
+
+                def run(variables, image1, image2, model=model):
+                    return model.apply(
                         variables, image1, image2, iters=self.iters,
                         test_mode=True)
 
@@ -503,15 +518,27 @@ def load_predictor(model_path: str, small: bool = False,
     kernel measured faster than the materialized volume at every
     operating point (84.3 vs 56.1 pairs/s Sintel b24, 22.2 vs 18.4
     KITTI b1 — BASELINE.md), so eval picks it wherever the padded shape
-    fits VMEM. Other families and spatially-sharded eval resolve to
-    ``"fixed"``."""
+    fits VMEM — including spatially-sharded eval (round 5: shard_map
+    composition). Other families and explicit engine/storage selections
+    (``alternate_corr``, ``corr_dtype``) resolve to ``"fixed"`` so
+    those levers are honored as passed."""
     from raft_tpu import checkpoint as ckpt_lib
     from raft_tpu.config import RAFTConfig
     from raft_tpu.models.raft import RAFT
 
     if corr_impl is None:
-        corr_impl = ("auto" if model_family == "raft"
-                     and spatial_shards == 1 else "fixed")
+        # Mirror resolve_train_corr_engine: an explicit engine/storage
+        # selection (--alternate_corr, --corr_dtype) pins "fixed" (use
+        # the model exactly as configured) so the lever keeps its
+        # meaning; only the no-selection default auto-dispatches.
+        if alternate_corr or corr_dtype is not None:
+            corr_impl = "fixed"
+        else:
+            # spatially-sharded eval auto-dispatches too since round 5:
+            # the banded kernel composes with row sharding via shard_map
+            # (falls back to the materialized engine per shape when rows
+            # don't divide or VMEM doesn't admit the kernel)
+            corr_impl = "auto" if model_family == "raft" else "fixed"
     if model_family != "raft":
         dropped = [name for name, on in _raft_only_selections(
             small, alternate_corr, corr_dtype) if on]

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops.sampling import (avg_pool2x2, bilinear_sampler,
+                                   corr_precision,
                                    windowed_bilinear_matmul)
 
 
@@ -45,7 +46,8 @@ def all_pairs_correlation(fmap1: jnp.ndarray, fmap2: jnp.ndarray,
     a = fmap1.reshape(B, H * W, C).astype(jnp.float32)
     b = fmap2.reshape(B, H * W, C).astype(jnp.float32)
     corr = jnp.einsum("bnc,bmc->bnm", a, b,
-                      preferred_element_type=jnp.float32)
+                      preferred_element_type=jnp.float32,
+                      precision=corr_precision())
     if scale:
         corr = corr / jnp.sqrt(jnp.float32(C))
     return corr.reshape(B, H, W, H, W)
@@ -225,6 +227,14 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
         backend == "auto" and eligible
         and jax.default_backend() == "tpu")
     if use_pallas:
+        from raft_tpu.parallel.spatial import current_spatial_kernel_mesh
+        mesh = current_spatial_kernel_mesh()
+        if mesh is not None:
+            sharded = _sharded_fused_lookup(
+                fmap1, tuple(pyramid2), coords, mesh, radius, scale,
+                mxu_dtype, rescale, out_dtype)
+            if sharded is not None:
+                return sharded
         # out_dtype emitted from inside the kernel — bit-identical to a
         # post-hoc astype, but skips the convert+copy XLA would place at
         # the custom-call boundary (~2% of the b64 headline step).
@@ -250,8 +260,60 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
     return jnp.concatenate(out, axis=-1).astype(out_dtype)
 
 
+def _sharded_fused_lookup(fmap1, pyramid2, coords, mesh, radius, scale,
+                          mxu_dtype, rescale, out_dtype):
+    """shard_map wrapper composing the fused kernel with spatial
+    sharding (round 5, VERDICT r4 #2).
+
+    Queries, coords and output are row-sharded (``spatial`` axis);
+    the pooled target pyramid is declared replicated, so XLA inserts
+    ONE all-gather per forward — loop-invariant to the refinement
+    scan, and its autodiff transpose is the cross-shard psum the
+    ``fmap2`` gradient needs. Each shard then runs a completely
+    self-contained kernel call: coordinates are global level-0 pixels
+    and each shard stages the FULL target levels, so arbitrary flow
+    magnitudes stay exact (a halo exchange would not be — the memory
+    regime this serves is the reference's
+    ``alt_cuda_corr/correlation_kernel.cu:19-119``).
+
+    The VMEM envelope per shard equals the unsharded kernel's
+    (``fused_eligible`` gates on full levels either way); what spatial
+    sharding buys is the 1/d split of every *activation* and of the
+    query-side work. Returns None when the sharding doesn't divide the
+    operands (caller falls back to the unsharded call, which XLA then
+    runs replicated)."""
+    from raft_tpu.parallel.mesh import (DATA_AXIS, SHARD_MAP_NOCHECK,
+                                        SPATIAL_AXIS, shard_map)
+
+    n_sp = mesh.shape.get(SPATIAL_AXIS, 1)
+    n_dt = mesh.shape.get(DATA_AXIS, 1)
+    B, H = fmap1.shape[0], fmap1.shape[1]
+    if H % max(n_sp, 1) or B % max(n_dt, 1):
+        return None
+    if n_sp <= 1 and n_dt <= 1:
+        return None
+
+    from jax.sharding import PartitionSpec as P
+
+    qspec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
+    pspec = tuple(P(DATA_AXIS, None, None, None) for _ in pyramid2)
+
+    def local(f1, pyr, c):
+        from raft_tpu.ops.corr_pallas import (
+            windowed_correlation_pallas_fused)
+        return windowed_correlation_pallas_fused(
+            f1, pyr, c, radius, scale=scale, mxu_dtype=mxu_dtype,
+            rescale=rescale, out_dtype=out_dtype)
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(qspec, pspec, qspec),
+                     out_specs=qspec, **SHARD_MAP_NOCHECK)(
+        fmap1, pyramid2, coords)
+
+
 def alternate_eval_eligible(cfg, image_hw,
-                            differentiable: bool = False) -> bool:
+                            differentiable: bool = False,
+                            spatial_shards: int = 1) -> bool:
     """Whether the fused on-demand kernel admits a canonical-RAFT run at
     this padded image size (stride-8 features, ``cfg.corr_levels`` pooled
     levels, bf16 features under the mixed-precision policy). Used by the
@@ -260,10 +322,18 @@ def alternate_eval_eligible(cfg, image_hw,
     training path — on-chip measurement made the on-demand kernel the
     preferred engine wherever it fits VMEM (BENCH r4: 93.7 vs 55.9
     pairs/s Sintel eval; train step +34%/+49% at chairs b4/b8,
-    TPU_EXTRAS raft_train alt arms)."""
+    TPU_EXTRAS raft_train alt arms).
+
+    ``spatial_shards > 1``: the sharded composition
+    (``_sharded_fused_lookup``) additionally needs the feature rows
+    divisible by the spatial axis so shard_map can split the query
+    slab evenly; the VMEM envelope itself is unchanged (each shard
+    stages the full pooled target levels)."""
     from raft_tpu.ops.corr_pallas import fused_eligible
     h, w = image_hw
     h8, w8 = h // 8, w // 8
+    if spatial_shards > 1 and h8 % spatial_shards:
+        return False
     shapes = []
     for _ in range(cfg.corr_levels):
         # True pooled shapes, including degenerate 0-size levels (VALID
@@ -295,6 +365,22 @@ class AlternateCorrBlock:
         self.out_dtype = out_dtype
         self.fmap1 = fmap1
         self.pyramid2 = build_feature_pyramid(fmap2, num_levels)
+        from raft_tpu.parallel.spatial import current_spatial_kernel_mesh
+        mesh = current_spatial_kernel_mesh()
+        if mesh is not None:
+            # Hoist the pyramid's spatial replication OUT of the
+            # refinement scan: the per-iteration lookup's shard_map
+            # declares the pooled target levels replicated over the
+            # spatial axis, and constraining them here (trace time,
+            # before the scan) puts the ONE all-gather at pyramid build
+            # instead of a gather per iteration inside the loop.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from raft_tpu.parallel.mesh import DATA_AXIS
+            rep = NamedSharding(mesh, P(DATA_AXIS, None, None, None))
+            self.pyramid2 = tuple(
+                jax.lax.with_sharding_constraint(f2, rep)
+                for f2 in self.pyramid2)
 
     def __call__(self, coords: jnp.ndarray) -> jnp.ndarray:
         return alternate_lookup(self.fmap1, self.pyramid2, coords,

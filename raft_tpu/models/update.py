@@ -27,6 +27,13 @@ def _conv_padding(conv) -> tuple:
     nd = len(conv.kernel_size)
     if isinstance(p, int):
         return tuple((p, p) for _ in range(nd))
+    if not isinstance(p, (tuple, list)):
+        # flax also accepts 'SAME'/'VALID'/'CIRCULAR' strings; iterating
+        # one here would silently produce per-character garbage geometry.
+        raise ValueError(
+            "_concat_conv supports int or per-dim int/tuple padding "
+            f"only; got {p!r} — pass explicit ints so the fused-concat "
+            "geometry check stays meaningful")
     return tuple((e, e) if isinstance(e, int) else tuple(e) for e in p)
 
 

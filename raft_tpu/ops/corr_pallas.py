@@ -121,6 +121,19 @@ def _mxu(mxu_dtype: str):
     return jnp.bfloat16 if mxu_dtype == "bfloat16" else jnp.float32
 
 
+def _dot_precision(mdt):
+    """Trace-time MXU pass-count lever (see sampling.corr_precision):
+    ``RAFT_CORR_PRECISION=highest`` makes the kernel's f32 dots
+    f32-faithful (multi-pass) instead of the TPU default bf16-operand
+    passes. Gated to f32 operands: Mosaic rejects HIGHEST on bf16 dots
+    (measured on-chip round 5 — MosaicError INTERNAL on every band
+    mode), and multi-pass is meaningless for bf16 anyway."""
+    if mdt != jnp.float32:
+        return jax.lax.Precision.DEFAULT
+    from raft_tpu.ops.sampling import corr_precision
+    return corr_precision()
+
+
 def _hat(dist: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(0.0, 1.0 - jnp.abs(dist))
 
@@ -208,7 +221,8 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
             f2c = f2_refs[l][0, pl.ds(yc * (_CHUNK * w2pl), _CHUNK * w2pl), :]
             corr = jax.lax.dot_general(
                 f2c.astype(mdt), f1, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)      # (CHUNK*W2PL, TQ)
+                preferred_element_type=jnp.float32,
+                precision=_dot_precision(mdt))              # (CHUNK*W2PL, TQ)
             y0f = (yc * _CHUNK).astype(jnp.float32)
             for r_i in range(_CHUNK):
                 row = corr[r_i * w2pl:(r_i + 1) * w2pl, :]
@@ -307,10 +321,12 @@ def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
             f2c = f2_refs[l][0, pl.ds(base, _CHUNK * w2pl), :]
             df1_acc_ref[...] += jax.lax.dot_general(
                 g2.astype(mdt), f2c.astype(mdt), (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # (TQ, C)
+                preferred_element_type=jnp.float32,
+                precision=_dot_precision(mdt))              # (TQ, C)
             contrib = jax.lax.dot_general(
                 g2.astype(mdt), f1m, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # (CHUNK*W2PL, C)
+                preferred_element_type=jnp.float32,
+                precision=_dot_precision(mdt))              # (CHUNK*W2PL, C)
             df2_refs[l][0, pl.ds(base, _CHUNK * w2pl), :] += contrib
 
         _chunk_loop(band, cy, radius, h2l, nchunks, body)

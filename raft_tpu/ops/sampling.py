@@ -15,9 +15,29 @@ reference's ``coords_grid`` (reference ``core/utils/utils.py:74-77``).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+
+
+def corr_precision():
+    """MXU pass-count lever for the correlation matmuls (VERDICT r4 #1).
+
+    TPU f32 matmuls run at ``Precision.DEFAULT`` — bf16-operand passes
+    with f32 accumulation — which is the suspected source of the 0.031 px
+    on-chip golden parity drift (the bf16-*input* arms pass, so the
+    accumulation is fine; the operand rounding is the open lever).
+    ``RAFT_CORR_PRECISION=highest`` requests ``Precision.HIGHEST``
+    (multi-pass, f32-faithful) on every correlation contraction: the
+    all-pairs volume einsum, the windowed-lookup hat matmuls, and the
+    Pallas kernel's f32 dots. Read at trace time, like ``RAFT_CORR_BAND``
+    — construct a fresh jit/predictor after changing it.
+    """
+    return (jax.lax.Precision.HIGHEST
+            if os.environ.get("RAFT_CORR_PRECISION", "").lower()
+            in ("highest", "high", "f32")
+            else jax.lax.Precision.DEFAULT)
 
 
 def coords_grid(batch: int, ht: int, wd: int, normalized: bool = False) -> jnp.ndarray:
@@ -133,9 +153,11 @@ def windowed_bilinear_matmul(img: jnp.ndarray, cx: jnp.ndarray,
         wx = interp_axis_weights(cx[:, None] + off, W)   # (Q, win, W)
         wy = interp_axis_weights(cy[:, None] + off, H)   # (Q, win, H)
         tmp = jnp.einsum("qyx,qix->qiy", img.astype(jnp.float32), wx,
-                         preferred_element_type=jnp.float32)
+                         preferred_element_type=jnp.float32,
+                         precision=corr_precision())
         return jnp.einsum("qiy,qjy->qij", tmp, wy,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+                          precision=corr_precision())
 
     return _lookup(img, cx, cy)
 
@@ -229,7 +251,28 @@ def avg_pool2x2(x: jnp.ndarray, spatial_axes=(1, 2)) -> jnp.ndarray:
     """2x2 stride-2 average pool over ``spatial_axes`` of an arbitrary-rank
     array, the pyramid builder of ``CorrBlock`` (reference
     ``core/corr.py:24-27``). Default axes fit NHWC; 3D ``(Q, H, W)``
-    correlation volumes pass ``spatial_axes=(1, 2)`` too."""
-    window = tuple(2 if i in spatial_axes else 1 for i in range(x.ndim))
-    return jax.lax.reduce_window(
-        x, 0.0, jax.lax.add, window, window, "VALID") * 0.25
+    correlation volumes pass ``spatial_axes=(1, 2)`` too.
+
+    Expressed as slice-to-even + strided-slice adds, NOT
+    ``lax.reduce_window``: the round-5 b2 headline profile caught XLA
+    materializing the pyramid's reduce-windows as standalone ops with
+    odd, half-empty lane tilings ([14080,27,64], [14080,13,32] — 14.6
+    ms/step at batch 2, invisible at batch 1 where they fused). Four
+    strided slices + adds are elementwise ops XLA fuses into the
+    surrounding cast/scale chain at every batch size. VALID semantics
+    (odd trailing row/col dropped) preserved exactly."""
+    sizes = [x.shape[a] - (x.shape[a] % 2) for a in spatial_axes]
+    idx = [slice(None)] * x.ndim
+    for a, s in zip(spatial_axes, sizes):
+        idx[a] = slice(0, s)
+    x = x[tuple(idx)]
+
+    def half(arr, axis, offset):
+        sl = [slice(None)] * arr.ndim
+        sl[axis] = slice(offset, None, 2)
+        return arr[tuple(sl)]
+
+    a0, a1 = spatial_axes
+    return (half(half(x, a0, 0), a1, 0) + half(half(x, a0, 0), a1, 1)
+            + half(half(x, a0, 1), a1, 0)
+            + half(half(x, a0, 1), a1, 1)) * 0.25

@@ -31,15 +31,11 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-try:                                    # jax>=0.4.35 top-level export
-    from jax import shard_map
-except ImportError:                     # older: experimental location
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.models.corr import pyramid_lookup
 from raft_tpu.ops.sampling import avg_pool2x2
-from raft_tpu.parallel.mesh import SPATIAL_AXIS
+from raft_tpu.parallel.mesh import SPATIAL_AXIS, shard_map
 
 
 def _ring_volume(fmap1: jnp.ndarray, fmap2: jnp.ndarray, n_shards: int,

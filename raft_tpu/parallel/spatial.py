@@ -18,6 +18,8 @@ Both shard rows of the image; they interoperate (same mesh, same specs).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Any, Callable, Optional
 
 import jax
@@ -25,6 +27,38 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+# Trace-time spatial-mesh context (round 5, VERDICT r4 #2): XLA's SPMD
+# partitioner cannot split a Pallas custom call, so under compiler-
+# partitioned spatial execution the on-demand correlation kernel needs
+# an explicit shard_map wrapper — but the model is jitted UNMODIFIED
+# and has no mesh argument. The spatial entry points (spatial_jit, the
+# mesh arm of make_train_step) set this context around tracing;
+# models.corr.alternate_lookup reads it and, when set, runs the fused
+# kernel per-shard: queries/coords/output row-sharded, pooled target
+# pyramid replicated (XLA inserts ONE all-gather, loop-invariant to
+# the refinement scan; its transpose is the correct cross-shard psum
+# for the fmap2 gradient). Exact for arbitrary flow magnitude — unlike
+# a halo exchange, whose correctness would depend on flow staying
+# within the halo.
+_SPATIAL_KERNEL_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "spatial_kernel_mesh", default=None)
+
+
+@contextlib.contextmanager
+def spatial_kernel_mesh(mesh: Optional[Mesh]):
+    """Declare (at trace time) that model code runs spatially sharded
+    over ``mesh`` — lets mesh-less model internals (the correlation
+    engine) wrap their Pallas calls in shard_map."""
+    token = _SPATIAL_KERNEL_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _SPATIAL_KERNEL_MESH.reset(token)
+
+
+def current_spatial_kernel_mesh() -> Optional[Mesh]:
+    return _SPATIAL_KERNEL_MESH.get()
 
 
 def image_spec(shard_batch: bool = True) -> P:
@@ -50,8 +84,16 @@ def spatial_jit(apply_fn: Callable, mesh: Mesh,
     """
     ispec = NamedSharding(mesh, image_spec(shard_batch))
     rep = NamedSharding(mesh, P())
+
+    def traced(variables, image1, image2):
+        # context active during TRACING (the body runs inside jit), so
+        # the correlation engine can see the mesh — see
+        # spatial_kernel_mesh above
+        with spatial_kernel_mesh(mesh):
+            return apply_fn(variables, image1, image2)
+
     return jax.jit(
-        apply_fn,
+        traced,
         in_shardings=(rep, ispec, ispec),
         donate_argnums=(1, 2) if donate else (),
     )

@@ -181,9 +181,18 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
         # data x sequence-parallel mesh). Let jit adopt those input
         # shardings rather than pinning (which would reject the
         # sequence-parallel layout); params/rng are replicated.
+        from raft_tpu.parallel.spatial import spatial_kernel_mesh
+
+        def traced_step(state, batch, rng):
+            # trace-time mesh context: lets the correlation engine wrap
+            # its Pallas kernel in shard_map when the spatial axis is
+            # active (see parallel.spatial.spatial_kernel_mesh)
+            with spatial_kernel_mesh(mesh):
+                return step_fn(state, batch, rng)
+
         repl = NamedSharding(mesh, P())
         return jax.jit(
-            step_fn,
+            traced_step,
             in_shardings=(None, None, repl),
             donate_argnums=(0,) if donate else ())
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())

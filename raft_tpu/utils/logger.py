@@ -9,10 +9,11 @@ Equivalents of the reference's observability stack:
 * :class:`TrainLogger` — the trainer's ``Logger`` (reference
   ``train.py:127-168``): running means printed every ``SUM_FREQ`` steps with
   the current LR, plus scalar time-series sinks. Scalars always stream to a
-  JSONL file (greppable, dependency-free); TensorBoard event files are
-  written too when ``torch.utils.tensorboard`` is importable (torch-cpu is
-  an allowed host-side dependency, used exactly like the reference uses
-  ``SummaryWriter``).
+  JSONL file (greppable, dependency-free) AND to TensorBoard event files —
+  via ``torch.utils.tensorboard`` when torch is importable (used exactly
+  like the reference uses ``SummaryWriter``), else via the self-contained
+  ``raft_tpu.utils.tb_events.EventWriter`` (same on-disk format, zero
+  dependencies), so the reference's artifact format is always produced.
 """
 
 from __future__ import annotations
@@ -168,7 +169,12 @@ class TrainLogger:
                 from torch.utils.tensorboard import SummaryWriter
                 self._tb = SummaryWriter(log_dir=log_dir)
             except Exception:
-                self._tb = None
+                # torch-free hosts still get the reference's artifact
+                # format: a self-contained events.out.tfevents writer
+                # (raft_tpu/utils/tb_events.py) with the add_scalar/
+                # add_image subset the logger uses.
+                from raft_tpu.utils.tb_events import EventWriter
+                self._tb = EventWriter(log_dir)
         self._t0 = time.time()
 
     def _status(self, lr: Optional[float]) -> str:

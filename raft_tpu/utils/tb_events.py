@@ -1,0 +1,154 @@
+"""Dependency-free TensorBoard event-file writer.
+
+The reference's trainer logs through ``torch.utils.tensorboard``
+(reference ``train.py:127-168`` — ``SummaryWriter.add_scalar`` /
+``add_image``). :class:`TrainLogger` uses torch's writer when torch is
+importable; this module is the fallback that keeps the *artifact
+format* (``events.out.tfevents.*`` files any TensorBoard install can
+load) available with zero dependencies — a tfevents file is just
+TFRecord-framed ``tensorflow.Event`` protos, and the two messages the
+trainer needs (scalar + PNG image summaries) are small enough to encode
+by hand:
+
+* TFRecord frame: ``uint64 length ·  uint32 maskedcrc32c(length) ·
+  bytes data · uint32 maskedcrc32c(data)`` (crc32c = Castagnoli,
+  masked per the TFRecord spec).
+* ``Event``: field 1 ``wall_time`` (double), 2 ``step`` (int64),
+  5 ``summary``. ``Summary.Value``: field 1 ``tag``, 2 ``simple_value``
+  (float), 4 ``image`` (``height``/``width``/``colorspace``/
+  ``encoded_image_string``).
+
+Verified round-trippable by TensorBoard's own reader in
+``tests/test_aux_components.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+# -- crc32c (Castagnoli), table-driven ---------------------------------
+
+_CRC_TABLE = []
+_POLY = 0x82F63B78
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (_POLY if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# -- minimal proto encoding --------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _bytes_field(field: int, data: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def _double_field(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float_field(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _int_field(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _scalar_value(tag: str, value: float) -> bytes:
+    return _bytes_field(1, tag.encode()) + _float_field(2, float(value))
+
+
+def _image_value(tag: str, png: bytes, h: int, w: int,
+                 channels: int) -> bytes:
+    img = (_int_field(1, h) + _int_field(2, w)
+           + _int_field(3, channels) + _bytes_field(4, png))
+    return _bytes_field(1, tag.encode()) + _bytes_field(4, img)
+
+
+def _event(step: int, summary: bytes) -> bytes:
+    return (_double_field(1, time.time()) + _int_field(2, step)
+            + _bytes_field(5, summary))
+
+
+class EventWriter:
+    """Append-only ``events.out.tfevents`` writer with the torch
+    ``SummaryWriter`` method subset :class:`TrainLogger` uses."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = "events.out.tfevents.%010d.%s" % (
+            int(time.time()), socket.gethostname())
+        self._f = open(os.path.join(log_dir, fname), "ab")
+        # file-version header event (what TB expects first)
+        ver = _double_field(1, time.time()) + _bytes_field(
+            3, b"brain.Event:2")
+        self._write_record(ver)
+        self._f.flush()
+
+    def _write_record(self, data: bytes) -> None:
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        summary = _bytes_field(1, _scalar_value(tag, value))
+        self._write_record(_event(step, summary))
+        self._f.flush()
+
+    def add_image(self, tag: str, img, step: int,
+                  dataformats: str = "HWC") -> None:
+        """``img``: HWC uint8 numpy array (panel layout used by
+        ``TrainLogger.write_images``)."""
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        arr = np.asarray(img)
+        if dataformats == "CHW":
+            arr = np.transpose(arr, (1, 2, 0))
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        h, w = arr.shape[:2]
+        c = arr.shape[2] if arr.ndim == 3 else 1
+        summary = _bytes_field(1, _image_value(tag, buf.getvalue(),
+                                               h, w, c))
+        self._write_record(_event(step, summary))
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()

@@ -55,12 +55,17 @@ def main():
         model = RAFT(RAFTConfig(iters=ITERS, mixed_precision=True,
                                 alternate_corr=alt))
 
-        fwd = jax.jit(lambda a, b, m=model: (
-            lambda f: (f, jnp.sum(f)))(m.apply(variables, a, b,
-                                               test_mode=True)[1]))
-
         for batch in BATCHES:
-            def arm(batch=batch, fwd=fwd, name=name):
+            def arm(batch=batch, model=model, name=name):
+                # jit constructed per attempt (not hoisted): after a
+                # *runtime* failure the band-retry ladder changes
+                # RAFT_CORR_BAND, and a hoisted jit would replay the
+                # cached failing executable on every rung instead of
+                # re-tracing under the new env (ADVICE r4 low-1;
+                # bench.py's alternate_arm does the same).
+                fwd = jax.jit(lambda a, b, m=model: (
+                    lambda f: (f, jnp.sum(f)))(m.apply(variables, a, b,
+                                                       test_mode=True)[1]))
                 img = jnp.broadcast_to(img1, (batch, H, W, 3))
                 for _ in range(WARMUP):
                     float(fwd(img, img)[1])

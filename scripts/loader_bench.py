@@ -1,0 +1,154 @@
+"""Input-pipeline steady-state throughput bench (VERDICT r4 #3).
+
+Answers the question every train bench to date has skipped: can the
+host-side loader (read + decode + augment + batch-stack,
+``raft_tpu/data/datasets.py::DataLoader``) actually feed the measured
+device train rate (49.3 samples/s at chairs b8, ``TPU_EXTRAS.json``
+raft_train alt arms)?
+
+Method: a synthetic-but-real-shaped FlyingChairs stand-in — real .ppm /
+.flo files on disk at chairs native resolution (384x512), read through
+the real ``frame_utils`` decoders and the real ``FlowAugmentor`` with
+the chairs stage's aug params (crop 368x496, the raft_train operating
+shape) — so the measured rate includes file IO, decode, photometric +
+spatial aug, and batch stacking. No GPU/TPU involvement: this is pure
+host work, runnable anywhere.
+
+Output: one JSON line with samples/s per (loader, num_workers) arm and
+the device-rate comparison. Writes ``LOADER_BENCH.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# chairs b8 on-demand-engine device rate, TPU_EXTRAS raft_train alt arms
+DEVICE_RATE = 49.3
+N_FILES = 48            # distinct samples on disk (loops as needed)
+H, W = 384, 512         # chairs native resolution
+CROP = (368, 496)       # chairs training crop (train_standard.sh stage 1)
+BATCH = 8
+MEASURE_BATCHES = 40    # per arm, after warmup
+WARMUP_BATCHES = 6
+
+
+def _write_ppm(path: str, img: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(b"P6\n%d %d\n255\n" % (img.shape[1], img.shape[0]))
+        f.write(img.astype(np.uint8).tobytes())
+
+
+def make_fixture(root: str) -> None:
+    from raft_tpu.data import frame_utils
+    rng = np.random.default_rng(0)
+    for i in range(N_FILES):
+        # low-frequency patterns (compressible like real frames, and the
+        # augmentor's float math sees realistic value ranges)
+        low = rng.uniform(0, 255, (H // 8, W // 8, 3))
+        img = np.kron(low, np.ones((8, 8, 1)))[:H, :W]
+        _write_ppm(os.path.join(root, f"{i:05d}_img1.ppm"), img)
+        _write_ppm(os.path.join(root, f"{i:05d}_img2.ppm"),
+                   np.roll(img, (3, 5), axis=(0, 1)))
+        flow = rng.uniform(-10, 10, (H, W, 2)).astype(np.float32)
+        frame_utils.write_flo(os.path.join(root, f"{i:05d}_flow.flo"),
+                              flow)
+
+
+def make_dataset(root: str):
+    from raft_tpu.data.datasets import FlowDataset
+    ds = FlowDataset(aug_params=dict(
+        crop_size=CROP, min_scale=-0.1, max_scale=1.0, do_flip=True),
+        seed=0)
+    for i in range(N_FILES):
+        ds.image_list.append((os.path.join(root, f"{i:05d}_img1.ppm"),
+                              os.path.join(root, f"{i:05d}_img2.ppm")))
+        ds.flow_list.append(os.path.join(root, f"{i:05d}_flow.flo"))
+    return ds
+
+
+def run_arm(loader) -> float:
+    """Steady-state samples/s over MEASURE_BATCHES after warmup,
+    re-iterating (fresh epochs) as needed."""
+    it = iter(loader)
+    n = 0
+    t0 = None
+    while n < WARMUP_BATCHES + MEASURE_BATCHES:
+        try:
+            batch = next(it)
+        except StopIteration:
+            it = iter(loader)
+            continue
+        assert batch["image1"].shape == (BATCH, *CROP, 3)
+        n += 1
+        if n == WARMUP_BATCHES:
+            t0 = time.perf_counter()
+    return MEASURE_BATCHES * BATCH / (time.perf_counter() - t0)
+
+
+def main():
+    from raft_tpu import native
+    from raft_tpu.data.datasets import DataLoader
+
+    root = tempfile.mkdtemp(prefix="loader_bench_")
+    out = {"resolution": [H, W], "crop": list(CROP), "batch": BATCH,
+           "device_rate_samples_per_sec": DEVICE_RATE,
+           "native_augment": bool(native.available()),
+           "cpu_count": os.cpu_count()}
+    try:
+        make_fixture(root)
+        # replicate so one epoch covers warmup+measurement — re-iterating
+        # mid-arm would re-fork the process pool and charge pool startup
+        # to the steady-state number
+        ds = 20 * make_dataset(root)
+
+        # single-sample cost breakdown (sequential, no loader overhead)
+        t0 = time.perf_counter()
+        for i in range(32):
+            ds[i % N_FILES]
+        out["sequential_samples_per_sec"] = round(
+            32 / (time.perf_counter() - t0), 2)
+
+        for workers in (1, 4, 8, 16):
+            loader = DataLoader(ds, batch_size=BATCH, shuffle=True,
+                                num_workers=workers, prefetch=4)
+            rate = run_arm(loader)
+            out[f"thread_w{workers}_samples_per_sec"] = round(rate, 2)
+
+        try:
+            from raft_tpu.data.datasets import ProcessDataLoader
+        except ImportError:
+            ProcessDataLoader = None
+        if ProcessDataLoader is not None:
+            arm_counts = (4, 8, 16) if (os.cpu_count() or 1) >= 4 else (2,)
+            for workers in arm_counts:
+                loader = ProcessDataLoader(ds, batch_size=BATCH,
+                                           shuffle=True,
+                                           num_workers=workers,
+                                           prefetch=4)
+                rate = run_arm(loader)
+                out[f"process_w{workers}_samples_per_sec"] = round(rate, 2)
+
+        best = max(v for k, v in out.items()
+                   if k.endswith("_samples_per_sec")
+                   and not k.startswith("device"))
+        out["best_samples_per_sec"] = best
+        out["feeds_device"] = bool(best >= DEVICE_RATE)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps(out))
+    with open("LOADER_BENCH.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

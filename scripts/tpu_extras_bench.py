@@ -272,6 +272,91 @@ def volume_memory() -> dict:
     return out
 
 
+def loader_train() -> dict:
+    """End-to-end train rate WITH the real input pipeline (round 5,
+    VERDICT r4 #3): synthetic-but-real-shaped .ppm/.flo files on disk,
+    read+decoded+augmented through the actual loader
+    (``fetch_dataloader``-equivalent construction) feeding the jitted
+    canonical-RAFT train step at the chairs operating point. Compares
+    the loader-fed steady state against the synthetic-tensor-fed rate
+    of the SAME compiled step, and records the host's core count — the
+    capacity model is per-core loader rate x cores vs device rate
+    (LOADER_BENCH.json: ~14-18 samples/s/core; a 1-core host is
+    loader-bound by construction, a >=4-core pod host is not)."""
+    import shutil
+    import tempfile
+
+    from raft_tpu.config import RAFTConfig, TrainConfig
+    from raft_tpu.models.raft import RAFT
+    from raft_tpu.parallel import create_train_state, make_train_step
+    from scripts.loader_bench import make_dataset, make_fixture
+
+    H, W = 368, 496                      # chairs crop
+    batch = 4
+    out = {"resolution": [H, W], "batch": batch,
+           "cpu_count": os.cpu_count()}
+    root = tempfile.mkdtemp(prefix="loader_train_")
+    try:
+        make_fixture(root)
+        ds = 20 * make_dataset(root)
+        # the SAME loader-kind/worker resolution training uses
+        # (select_loader: process pool on >=4-core hosts, thread
+        # prefetcher on small hosts) so this measures the default path
+        from raft_tpu.data.datasets import select_loader
+        cls, workers = select_loader()
+        out["loader_kind"] = cls.__name__
+        out["loader_workers"] = workers
+        loader = cls(ds, batch_size=batch, shuffle=True,
+                     num_workers=workers, prefetch=4)
+
+        tcfg = TrainConfig(batch_size=batch, image_size=(H, W),
+                           num_steps=100, iters=12)
+        model = RAFT(RAFTConfig(iters=12, mixed_precision=True,
+                                alternate_corr=True))
+        rng = jax.random.PRNGKey(0)
+        state = create_train_state(rng, model, tcfg, (H, W))
+        step_fn = make_train_step(tcfg, donate=False)
+
+        it = iter(loader)
+        b0 = next(it)
+        b0 = {k: jnp.asarray(v) for k, v in b0.items()}
+        compiled = _compile(step_fn, state, b0, rng)
+
+        # synthetic-fed reference rate (device-bound ceiling)
+        def synth(state_in):
+            _, m = compiled(state_in, b0, rng)
+            return m["loss"]
+        dt = _time(synth, state, reps=5)
+        out["synthetic_fed_samples_per_sec"] = round(batch / dt, 2)
+
+        # loader-fed steady state: overlapped (loader prefetches while
+        # the device steps), 20 steps after 3 warmup
+        n_warm, n_meas = 3, 20
+        k = 0
+        t0 = None
+        cur = state
+        while k < n_warm + n_meas:
+            try:
+                nb = next(it)
+            except StopIteration:
+                it = iter(loader)
+                continue
+            nb = {kk: jnp.asarray(v) for kk, v in nb.items()}
+            cur, metrics = compiled(cur, nb, rng)
+            k += 1
+            if k == n_warm:
+                float(metrics["loss"])
+                t0 = time.perf_counter()
+        float(metrics["loss"])
+        rate = n_meas * batch / (time.perf_counter() - t0)
+        out["loader_fed_samples_per_sec"] = round(rate, 2)
+        out["loader_efficiency"] = round(
+            rate / out["synthetic_fed_samples_per_sec"], 3)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def batch1() -> dict:
     """The batch-1 latency question (VERDICT r1 #9): is a doubled batch
     free (pipeline slack) or proportional (compute-bound)?"""
@@ -296,6 +381,26 @@ def batch1() -> dict:
         dt = _time(fwd, img, img)
         out[f"ms_b{batch}"] = round(dt * 1e3, 2)
         out[f"pairs_per_sec_b{batch}"] = round(batch / dt, 2)
+
+    # banded-engine arm (round 5, VERDICT r4 #4): does the b2/b3
+    # superlinear-cost anomaly reproduce on the on-demand kernel, or is
+    # it a materialized-pipeline (volume/lookup layout) artifact?
+    amodel = RAFT(RAFTConfig(iters=12, mixed_precision=True,
+                             alternate_corr=True))
+
+    def alt_arm(batch):
+        afwd = jax.jit(lambda i1, i2: jnp.sum(
+            amodel.apply(variables, i1, i2, test_mode=True)[1]))
+        img = jnp.broadcast_to(img1, (batch, H, W, 3))
+        dt = _time(afwd, img, img)
+        out[f"alt_ms_b{batch}"] = round(dt * 1e3, 2)
+        out[f"alt_pairs_per_sec_b{batch}"] = round(batch / dt, 2)
+
+    from raft_tpu.ops.corr_pallas import run_with_band_retry
+    for batch in (1, 2, 3, 4):
+        if not run_with_band_retry(lambda b=batch: alt_arm(b), out,
+                                   f"alt_b{batch}"):
+            break
     return out
 
 
@@ -424,29 +529,69 @@ def golden_on_chip() -> dict:
     (bf16 encoders/update + bf16 MXU operands + bf16 volume; the parity
     number then reads the whole bf16 compute-policy deviation against
     the f32-recorded golden — ~0.065 px on CPU, where the kernel/volume
-    levers are inactive; the on-chip value bounds the full policy)."""
+    levers are inactive; the on-chip value bounds the full policy).
+
+    Round 5 (VERDICT r4 #1): also records the *aggregate* EPE-vs-GT per
+    arm and its drift against the torch-oracle manifest mean — the
+    quantity the north star's 0.02 band actually constrains (per-pixel
+    parity drift can exceed it while unbiased rounding leaves the
+    aggregate untouched). ``*_hi`` arms re-run with
+    ``RAFT_CORR_PRECISION=highest`` (3-pass f32-faithful MXU passes on
+    the correlation matmuls) to isolate the MXU default-precision
+    contribution and price the fix."""
+    import json as _json
+
     from raft_tpu.evaluate import (ASSETS_DIR, load_predictor,
                                    validate_golden)
 
     weights = os.path.join(ASSETS_DIR, "golden", "weights.npz")
-    out = {}
-    for name, kw in (
-            ("all_pairs_f32", {}),
-            ("alternate_f32", dict(alternate_corr=True)),
-            ("policy_mixed", dict(mixed_precision=True)),
+    with open(os.path.join(ASSETS_DIR, "golden", "manifest.json")) as f:
+        manifest = _json.load(f)
+    manifest_gt = float(sum(p["epe_vs_gt"] for p in manifest["pairs"])
+                        / len(manifest["pairs"]))
+    # Same-build CPU aggregates (scripts/golden_cpu_reference.py): the
+    # matched-policy anchor — |EPE_tpu - EPE_cpu| at the SAME compute
+    # policy is the chip-induced drift the 0.02 band constrains (the
+    # bf16 policy's own ~+0.028 aggregate shift exists on CPU too).
+    with open(os.path.join(ASSETS_DIR, "golden",
+                           "cpu_reference.json")) as f:
+        cpu_ref = _json.load(f)
+    out = {"manifest_gt_epe": manifest_gt}
+    for name, kw, precision in (
+            ("all_pairs_f32", {}, None),
+            ("alternate_f32", dict(alternate_corr=True), None),
+            ("policy_mixed", dict(mixed_precision=True), None),
             ("policy_mixed_alt", dict(alternate_corr=True,
-                                      mixed_precision=True))):
+                                      mixed_precision=True), None),
+            ("all_pairs_f32_hi", {}, "highest"),
+            ("alternate_f32_hi", dict(alternate_corr=True), "highest"),
+            ("policy_mixed_hi", dict(mixed_precision=True), "highest"),
+            ("policy_mixed_alt_hi", dict(alternate_corr=True,
+                                         mixed_precision=True),
+             "highest")):
 
-        def run(name=name, kw=kw):
+        def run(name=name, kw=kw, precision=precision):
             # corr_impl="fixed": each arm measures ITS engine — the
             # round-4 "auto" eval default would re-dispatch the
             # all-pairs arms onto the on-demand kernel on TPU.
-            pred = load_predictor(weights, iters=12, corr_impl="fixed",
-                                  **kw)
-            res = validate_golden(pred)
+            if precision:
+                os.environ["RAFT_CORR_PRECISION"] = precision
+            try:
+                pred = load_predictor(weights, iters=12,
+                                      corr_impl="fixed", **kw)
+                res = validate_golden(pred)
+            finally:
+                os.environ.pop("RAFT_CORR_PRECISION", None)
             # raw float: the f32 arms measure float-noise-scale parity
             # that sub-1e-6 rounding would erase
             out[f"{name}_parity_epe"] = res["golden_parity_epe"]
+            out[f"{name}_gt_epe"] = res["golden_gt_epe"]
+            out[f"{name}_gt_drift"] = abs(res["golden_gt_epe"]
+                                          - manifest_gt)
+            policy = ("policy_mixed" if kw.get("mixed_precision")
+                      else "all_pairs_f32")
+            out[f"{name}_gt_drift_vs_cpu"] = abs(
+                res["golden_gt_epe"] - cpu_ref[f"{policy}_gt_epe_cpu"])
 
         _run_with_band_retry(run, out, name,
                              banded=kw.get("alternate_corr", False))
@@ -481,7 +626,8 @@ def train_convergence() -> dict:
     ``train_standard.sh:6``), fixed seed, batches cycling a small pool
     of synthetic warped pairs (overfit-able by construction). Commits
     the every-10-steps loss curve plus steps/sec."""
-    from raft_tpu.config import OursConfig, RAFTConfig, TrainConfig
+    from raft_tpu.config import (OursConfig, RAFTConfig, TrainConfig,
+                                 sparse_corr_from_env)
     from raft_tpu.models import SparseRAFT
     from raft_tpu.models.raft import RAFT
     from raft_tpu.parallel import create_train_state, make_train_step
@@ -500,7 +646,9 @@ def train_convergence() -> dict:
                                      alternate_corr=raft_alt)),
              (368, 496), dict(iters=12)),
             ("sparse",
-             lambda: SparseRAFT(OursConfig(mixed_precision=True)),
+             lambda: SparseRAFT(OursConfig(
+                 mixed_precision=True,
+                 alternate_corr=sparse_corr_from_env())),
              (352, 480), dict(model_family="sparse", iters=6,
                               sparse_lambda=0.1))):
         tcfg = TrainConfig(batch_size=batch, image_size=(H, W),
@@ -539,6 +687,7 @@ SECTIONS = {"sparse_train": sparse_train, "raft_train": raft_train,
             "encoder_family": encoder_family,
             "msda_threshold": msda_threshold,
             "golden_on_chip": golden_on_chip,
+            "loader_train": loader_train,
             "train_convergence": train_convergence}
 
 

@@ -260,6 +260,24 @@ def test_corr_dtype_explicit_selection_convention():
     assert predictor is not None
 
 
+def test_explicit_selection_pins_fixed_engine():
+    """An explicit --corr_dtype or --alternate_corr must pin
+    corr_impl='fixed' on the default path, mirroring the train-side
+    resolve_train_corr_engine rule — otherwise auto-dispatch silently
+    swaps engines and discards the requested lever (ADVICE r4 medium)."""
+    p = evaluate.load_predictor("random", small=True, iters=2,
+                                corr_dtype="bfloat16")
+    assert p._engines is None          # fixed: no auto-dispatch siblings
+    assert p.model.config.corr_dtype == "bfloat16"
+    p = evaluate.load_predictor("random", small=True, iters=2,
+                                alternate_corr=True)
+    assert p._engines is None
+    assert p.model.config.alternate_corr
+    # the no-selection default still auto-dispatches
+    p = evaluate.load_predictor("random", small=True, iters=2)
+    assert p._engines is not None
+
+
 def test_flow_predictor_corr_impl_auto():
     """corr_impl='auto' builds the alternate-engine sibling (shared
     params) for canonical RAFT; off-TPU the dispatch keeps the

@@ -362,13 +362,20 @@ def test_resolve_train_corr_engine():
         assert resolve_train_corr_engine(
             "raft", None, False, None, False, True, (368, 496)) is False
     # on TPU at the benchmarked chairs crop, auto picks the kernel —
-    # and sharded training pins the materialized engine like eval does
+    # including under spatial sharding since round 5 (shard_map
+    # composition), gated on the feature rows dividing the spatial axis
     with mock.patch("jax.default_backend", return_value="tpu"):
         assert resolve_train_corr_engine(
             "raft", None, False, None, False, True, (368, 496)) is True
+        # 368/8 = 46 feature rows: divisible by 2 → kernel composes
         assert resolve_train_corr_engine(
             "raft", None, False, None, False, True, (368, 496),
-            spatial_shards=2) is False
+            spatial_shards=2) is True
+        # 46 rows NOT divisible by 4 → shard_map can't split evenly,
+        # materialized engine pins
+        assert resolve_train_corr_engine(
+            "raft", None, False, None, False, True, (368, 496),
+            spatial_shards=4) is False
     # explicit force-on always wins
     assert resolve_train_corr_engine(
         "raft", "fixed", True, None, False, True, (368, 496)) is True
