@@ -113,8 +113,19 @@ def _choose_tile(n: int) -> int:
     """Query-tile (lane-axis) size. The banded pipeline's per-tile VMEM is
     small (chunked matmuls, no full-level scratch), so the tile is sized
     for grid-overhead amortization; lane-dim blocks must stay
-    128-divisible once the grid has more than one tile."""
-    return 256 if n >= 256 else 128
+    128-divisible once the grid has more than one tile.
+    ``RAFT_CORR_TILE`` overrides for measurement (trace-time read, like
+    ``RAFT_CORR_BAND``), capped at 256: ``fused_eligible`` budgets the
+    per-tile scratch at tq=256, and 512 measured a Mosaic scoped-VMEM
+    stack OOM (17.4 MB vs the 16 MB limit) at Sintel resolution —
+    larger tiles cannot be admitted without also shrinking the resident
+    pyramid the kernel depends on."""
+    tile = int(os.environ.get("RAFT_CORR_TILE", "0")) or (
+        256 if n >= 256 else 128)
+    if tile % 128:
+        raise ValueError(f"RAFT_CORR_TILE must be a multiple of 128, "
+                         f"got {tile}")
+    return min(tile, 256, _round_up(n, 128))
 
 
 def _mxu(mxu_dtype: str):
@@ -190,10 +201,13 @@ def _chunk_loop(band: str, cy, radius, h2l, nchunks, body):
 
 def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
                 levels: tuple, mxu_dtype: str, band: str,
-                rescale: bool):
+                rescale: bool, tout: bool = False):
     """refs = (f2_l0..f2_lN, out, t1_scratch); levels = ((h2l, h2lp, w2pl),…)
     with h2lp the CHUNK-padded row count (padded rows are zero features →
-    zero contribution)."""
+    zero contribution). ``tout``: store the output block transposed —
+    (TQ, L*win*win) instead of (L*win*win, TQ) — so the wrapper's
+    swapaxes disappears (the b64 profile measured the XLA transpose
+    copy at ~12 ms/step); one in-VMEM transpose per tile instead."""
     nl = len(levels)
     f2_refs, out_ref, t1_ref = refs[:nl], refs[nl], refs[nl + 1]
     win = 2 * radius + 1
@@ -251,7 +265,10 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
     # float32 result outside the kernel, but saves the XLA-level
     # convert+copy at the custom-call boundary (measured ~2% of the b64
     # headline step as pure layout tax).
-    out_ref[0] = out.astype(out_ref.dtype)
+    if tout:
+        out_ref[0] = out.T.astype(out_ref.dtype)         # (TQ, L*win*win)
+    else:
+        out_ref[0] = out.astype(out_ref.dtype)
 
 
 def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
@@ -352,10 +369,12 @@ def _pad_level(f2, h2p, w2p):
 
 
 def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                mxu_dtype, band, rescale, out_dtype):
+                mxu_dtype, band, rescale, out_dtype, tout=False):
     """f1: (B, Np, C); f2s: per-level (B, H2lp*W2lp, C); cx/cy: (B, 1, Np)
     at level-0 scale; Np % tq == 0. Returns (B, L*win*win, Np) —
-    query-minor; transposed by the wrapper."""
+    query-minor; transposed by the wrapper — or, with ``tout``,
+    (B, Np, L*win*win) already in the consumer's order (kernel-side
+    per-tile transpose; see RAFT_CORR_TOUT)."""
     b, np_, c = f1.shape
     win = 2 * radius + 1
     nl = len(levels)
@@ -364,7 +383,17 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
 
     kernel = functools.partial(_fwd_kernel, radius=radius, scale=scale,
                                levels=levels, mxu_dtype=mxu_dtype,
-                               band=band, rescale=rescale)
+                               band=band, rescale=rescale, tout=tout)
+    if tout:
+        out_specs = pl.BlockSpec((1, tq, nl * win * win),
+                                 lambda bi, ti: (bi, ti, 0))
+        out_shape = jax.ShapeDtypeStruct((b, np_, nl * win * win),
+                                         out_dtype)
+    else:
+        out_specs = pl.BlockSpec((1, nl * win * win, tq),
+                                 lambda bi, ti: (bi, 0, ti))
+        out_shape = jax.ShapeDtypeStruct((b, nl * win * win, np_),
+                                         out_dtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -376,10 +405,8 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
             pl.BlockSpec((1, f2.shape[1], c), lambda bi, ti: (bi, 0, 0))
             for f2 in f2s
         ],
-        out_specs=pl.BlockSpec((1, nl * win * win, tq),
-                               lambda bi, ti: (bi, 0, ti)),
-        out_shape=jax.ShapeDtypeStruct((b, nl * win * win, np_),
-                                       out_dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((win * w2p_max, tq), jnp.float32)],
         interpret=interpret,
     )(cx, cy, f1, *f2s)
@@ -428,23 +455,27 @@ def _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret, levels, tq,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-              mxu_dtype, band, rescale, out_dtype):
+              mxu_dtype, band, rescale, out_dtype, tout=False):
     return _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                       tq, mxu_dtype, band, rescale, out_dtype)
+                       tq, mxu_dtype, band, rescale, out_dtype, tout)
 
 
 def _windowed_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                  mxu_dtype, band, rescale, out_dtype):
+                  mxu_dtype, band, rescale, out_dtype, tout=False):
     out = _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels,
-                      tq, mxu_dtype, band, rescale, out_dtype)
+                      tq, mxu_dtype, band, rescale, out_dtype, tout)
     return out, (f1, f2s, cx, cy)
 
 
 def _windowed_bwd(radius, scale, interpret, levels, tq, mxu_dtype, band,
-                  rescale, out_dtype, res, g):
+                  rescale, out_dtype, tout, res, g):
     f1, f2s, cx, cy = res
+    if tout:
+        # backward kernel consumes the query-minor cotangent; one XLA
+        # transpose here (training only — eval never differentiates)
+        g = jnp.swapaxes(g, 1, 2)
     # out_dtype shapes only the forward output; the cotangent g already
     # arrives in it, and gradient outputs are always float32.
     grads = _pallas_bwd(f1, f2s, cx, cy, g, radius, scale, interpret,
@@ -573,7 +604,7 @@ def windowed_correlation_pallas_fused(
                 for f2, (_, h2p, w2p) in zip(pyramid2, levels))
 
     n = h * w
-    tq = min(_choose_tile(n), _round_up(n, 128))
+    tq = _choose_tile(n)            # already clamped to ceil(n, 128)
     np_ = _round_up(n, tq)
     f1 = fmap1.reshape(b, n, c)
     f1 = jnp.pad(f1, ((0, 0), (0, np_ - n), (0, 0)))
@@ -586,9 +617,22 @@ def windowed_correlation_pallas_fused(
     cx = cf[..., 0][:, None, :]                          # (B, 1, Np)
     cy = cf[..., 1][:, None, :]
 
+    # Transposed output store (default ON): the kernel emits each output
+    # tile query-major — (TQ, L*win*win) — deleting the XLA swapaxes
+    # copy at the custom-call boundary for one in-VMEM per-tile
+    # transpose. Bit-exact (test_tout_bitexact); measured +1.4% on the
+    # b64 headline (93.4 → 94.8 pairs/s, the copy.257 row of the
+    # round-5 profile). RAFT_CORR_TOUT=0 restores the query-minor
+    # store; trace-time read, like RAFT_CORR_BAND.
+    tout_env = os.environ.get("RAFT_CORR_TOUT", "1")
+    if tout_env not in ("0", "1"):
+        raise ValueError(f"RAFT_CORR_TOUT must be '0' or '1', got "
+                         f"{tout_env!r}")
+    tout = tout_env == "1"
     out = _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
-                    mxu_dtype, band, rescale, jnp.dtype(out_dtype))
-    out = jnp.swapaxes(out, 1, 2)                        # (B, Np, L*win*win)
+                    mxu_dtype, band, rescale, jnp.dtype(out_dtype), tout)
+    if not tout:
+        out = jnp.swapaxes(out, 1, 2)                    # (B, Np, L*win*win)
     return out[:, :n].reshape(b, h, w, len(levels) * win * win)
 
 

@@ -253,26 +253,16 @@ def avg_pool2x2(x: jnp.ndarray, spatial_axes=(1, 2)) -> jnp.ndarray:
     ``core/corr.py:24-27``). Default axes fit NHWC; 3D ``(Q, H, W)``
     correlation volumes pass ``spatial_axes=(1, 2)`` too.
 
-    Expressed as slice-to-even + strided-slice adds, NOT
-    ``lax.reduce_window``: the round-5 b2 headline profile caught XLA
-    materializing the pyramid's reduce-windows as standalone ops with
-    odd, half-empty lane tilings ([14080,27,64], [14080,13,32] — 14.6
-    ms/step at batch 2, invisible at batch 1 where they fused). Four
-    strided slices + adds are elementwise ops XLA fuses into the
-    surrounding cast/scale chain at every batch size. VALID semantics
-    (odd trailing row/col dropped) preserved exactly."""
-    sizes = [x.shape[a] - (x.shape[a] % 2) for a in spatial_axes]
-    idx = [slice(None)] * x.ndim
-    for a, s in zip(spatial_axes, sizes):
-        idx[a] = slice(0, s)
-    x = x[tuple(idx)]
-
-    def half(arr, axis, offset):
-        sl = [slice(None)] * arr.ndim
-        sl[axis] = slice(offset, None, 2)
-        return arr[tuple(sl)]
-
-    a0, a1 = spatial_axes
-    return (half(half(x, a0, 0), a1, 0) + half(half(x, a0, 0), a1, 1)
-            + half(half(x, a0, 1), a1, 0)
-            + half(half(x, a0, 1), a1, 1)) * 0.25
+    Formulation note (round 5, measured): ``lax.reduce_window`` as
+    written. At batch 2-3 of the materialized Sintel eval XLA
+    materializes these as standalone reduce-windows with half-empty
+    lane tilings (~14.6 ms/step — the b2 profile); a strided-slice+add
+    rewrite fixed that context but measured intrinsically 2-3.4x
+    SLOWER in isolation (b24-scale chain: 40 vs 136 ms) and cost the
+    b24 all-pairs bench arm 20%, so it was reverted — the de-fusion is
+    a small-batch materialized-engine artifact (per-pair b2/b1 = 1.04,
+    inside the ≤1.1 band), and the banded default engine doesn't pool
+    volumes at all."""
+    window = tuple(2 if i in spatial_axes else 1 for i in range(x.ndim))
+    return jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, window, window, "VALID") * 0.25

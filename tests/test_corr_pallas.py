@@ -383,6 +383,36 @@ def test_degenerate_pooled_level_matches_materialized(rng):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_tout_bitexact(rng, monkeypatch):
+    """The transposed output store (RAFT_CORR_TOUT, default on) must be
+    BIT-identical to the query-minor store + external swapaxes, forward
+    and gradients — it only moves the transpose from an XLA copy at the
+    custom-call boundary into the kernel's final store."""
+    from raft_tpu.ops.corr_pallas import windowed_correlation_pallas_fused
+    B, C, H, W, r = 2, 16, 8, 12, 3
+    f1 = _rand(rng, B, H, W, C)
+    f2 = _rand(rng, B, H, W, C)
+    coords = jnp.asarray(rng.uniform(-2, 10, (B, H, W, 2)), jnp.float32)
+
+    def run():
+        def loss(a, b):
+            out = windowed_correlation_pallas_fused(
+                a, build_feature_pyramid(b, 2), coords, r,
+                interpret=True)
+            return jnp.sum(out * out), out
+        (l, out), g = jax.value_and_grad(
+            loss, argnums=(0, 1), has_aux=True)(f1, f2)
+        return out, g
+
+    monkeypatch.setenv("RAFT_CORR_TOUT", "1")
+    out_t, g_t = run()
+    monkeypatch.setenv("RAFT_CORR_TOUT", "0")
+    out_q, g_q = run()
+    np.testing.assert_array_equal(np.asarray(out_t), np.asarray(out_q))
+    np.testing.assert_array_equal(np.asarray(g_t[0]), np.asarray(g_q[0]))
+    np.testing.assert_array_equal(np.asarray(g_t[1]), np.asarray(g_q[1]))
+
+
 def test_out_dtype_bitexact_vs_external_cast(rng):
     # out_dtype=bfloat16 emitted from inside the kernel must be
     # BIT-identical to casting the float32 kernel output afterwards
