@@ -120,12 +120,18 @@ def _choose_tile(n: int) -> int:
     stack OOM (17.4 MB vs the 16 MB limit) at Sintel resolution —
     larger tiles cannot be admitted without also shrinking the resident
     pyramid the kernel depends on."""
-    tile = int(os.environ.get("RAFT_CORR_TILE", "0")) or (
-        256 if n >= 256 else 128)
-    if tile % 128:
-        raise ValueError(f"RAFT_CORR_TILE must be a multiple of 128, "
-                         f"got {tile}")
-    return min(tile, 256, _round_up(n, 128))
+    env = os.environ.get("RAFT_CORR_TILE", "0")
+    try:
+        tile = int(env)
+    except ValueError:
+        raise ValueError(f"RAFT_CORR_TILE must be an integer multiple "
+                         f"of 128, got {env!r}") from None
+    if tile < 0 or tile % 128 or tile > 256:
+        raise ValueError(f"RAFT_CORR_TILE must be 128 or 256 (0/unset "
+                         f"= auto; larger tiles measured a Mosaic "
+                         f"scoped-VMEM OOM), got {env!r}")
+    tile = tile or (256 if n >= 256 else 128)
+    return min(tile, _round_up(n, 128))
 
 
 def _mxu(mxu_dtype: str):
