@@ -24,8 +24,7 @@ import jax.numpy as jnp
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models import corr
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
-from raft_tpu.models.update import (UPSAMPLE_MASK_CHANNELS,
-                                    BasicUpdateBlock, SmallUpdateBlock)
+from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
 from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
 
 
@@ -43,11 +42,18 @@ class _UpdateStep(nn.Module):
         else:
             self.update_block = BasicUpdateBlock(self.config.hdim, dtype)
 
-    def __call__(self, carry, compute_up, corr_state, inp, coords0):
-        """``compute_up``: Python ``True`` (every iteration upsamples —
-        training) or a traced per-iteration bool (``test_mode``: only the
-        final iteration pays for the mask head + convex upsampling)."""
-        net, coords1 = carry[0], carry[1]
+    def __call__(self, carry, _tick, compute_up, corr_state, inp,
+                 coords0):
+        """``compute_up``: Python ``True`` (upsample this iteration —
+        training, and the single final test_mode call) or ``None``
+        (test_mode non-final iterations: the mask head and upsampling
+        are statically ABSENT from the loop body — no ``nn.cond``, no
+        mask in the carry; the round-5 two-call scan structure, see
+        ``RAFT.__call__``). ``_tick`` is a dummy scanned input that
+        sets the trip count (``nn.scan(length=None)``), letting ONE
+        lifted scan instance — one parameter scope — serve both call
+        lengths."""
+        net, coords1 = carry
         coords1 = jax.lax.stop_gradient(coords1)
         corr = _lookup(self.config, corr_state, coords1)
         corr = corr.astype(net.dtype)
@@ -57,26 +63,19 @@ class _UpdateStep(nn.Module):
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         new_flow = coords1 - coords0
 
-        if isinstance(compute_up, bool) or self.is_initializing():
-            # Training / init: every iteration's upsampled flow is a scan
-            # output (the sequence loss consumes all of them).
-            if up_mask is None:
-                flow_up = upflow8(new_flow)
-            else:
-                flow_up = convex_upsample(new_flow,
-                                          up_mask.astype(jnp.float32))
-            return (net, coords1), flow_up
-
-        # test_mode: the mask head runs (under cond) only on the flagged
-        # last iteration; the mask rides in the carry (zeros until then)
-        # and the single convex upsample runs after the scan. This moves
-        # the full-resolution upsample einsum and its (B, 8H, 8W, 2)
-        # buffer out of the loop body entirely — measured ~5% faster than
-        # carrying the upsampled flow through a per-iteration cond, even
-        # though the mask itself is the larger buffer.
-        if up_mask is None:
+        if compute_up is None and not self.is_initializing():
+            # test_mode non-final: no mask, no upsample, no per-
+            # iteration outputs.
             return (net, coords1), ()
-        return (net, coords1, up_mask), ()
+        # Training / init / final test_mode iteration: upsampled flow
+        # is a scan output (the sequence loss consumes all of them; the
+        # test_mode caller takes the single stacked entry).
+        if up_mask is None:
+            flow_up = upflow8(new_flow)
+        else:
+            flow_up = convex_upsample(new_flow,
+                                      up_mask.astype(jnp.float32))
+        return (net, coords1), flow_up
 
 
 def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2, inference: bool):
@@ -159,6 +158,12 @@ class RAFT(nn.Module):
         cfg = self.config
         norm_train = train and not freeze_bn
         iters = iters if iters is not None else cfg.iters
+        if iters < 1:
+            # the two-call test_mode scan always runs the final
+            # mask-computing iteration; iters=0 has no meaning in the
+            # reference either (its range(iters) loop just never ran,
+            # returning the uninitialized flow)
+            raise ValueError(f"iters must be >= 1, got {iters}")
         if cfg.normalized_coords:
             # [0,1]-normalized grids serve the sparse-keypoint ("ours")
             # family; RAFT's correlation lookup and upsampling are
@@ -195,39 +200,36 @@ class RAFT(nn.Module):
         # upsampling-mask head and convex upsampling; training needs every
         # intermediate upsampled flow for the sequence loss.
         last_only = test_mode and not self.is_initializing()
-        if last_only:
-            flags = jnp.arange(iters) == iters - 1
-            flags_axis = 0
-            if cfg.small:
-                carry = (net, coords1)
-            else:
-                carry = (net, coords1,
-                         jnp.zeros((B, H8, W8, UPSAMPLE_MASK_CHANNELS),
-                                   net.dtype))
-        else:
-            flags = True
-            flags_axis = nn.broadcast
-            carry = (net, coords1)
+        carry = (net, coords1)
+        # length=None: the trip count comes from the scanned dummy
+        # tick, so the SAME lifted instance (one "update" parameter
+        # scope) runs both the (iters-1)-long mask-free loop and the
+        # single mask-computing final call in test_mode — statically,
+        # with no nn.cond and no mask buffer in the carry (the round-4
+        # structure cost ~1 ms/iteration of conditional plumbing at
+        # b64, the round-5 profile's cond.2 row).
         scan = nn.scan(
             _UpdateStep,
             variable_broadcast="params",
             split_rngs={"params": False},
-            in_axes=(flags_axis, nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast),
             out_axes=0,
-            length=iters,
+            length=None,
         )(cfg, name="update")
-        carry, flow_predictions = scan(
-            carry, flags, corr_state, inp, coords0)
 
         if last_only:
-            if cfg.small:
-                net, coords1 = carry
-                flow_low = coords1 - coords0
-                return flow_low, upflow8(flow_low)
-            net, coords1, up_mask = carry
+            if iters > 1:
+                carry, _ = scan(carry, jnp.zeros(iters - 1), None,
+                                corr_state, inp, coords0)
+            carry, flow_up = scan(carry, jnp.zeros(1), True,
+                                  corr_state, inp, coords0)
+            net, coords1 = carry
             flow_low = coords1 - coords0
-            return flow_low, convex_upsample(flow_low,
-                                             up_mask.astype(jnp.float32))
+            return flow_low, flow_up[0]
+
+        carry, flow_predictions = scan(
+            carry, jnp.zeros(iters), True, corr_state, inp, coords0)
         net, coords1 = carry
         if test_mode:
             # init-time test_mode (static path): all iterations upsample.

@@ -229,10 +229,12 @@ class BasicUpdateBlock(nn.Module):
                                   dtype=self.dtype)
 
     def __call__(self, net, inp, corr, flow, compute_mask=True):
-        """``compute_mask`` may be a traced scalar bool: the mask head then
-        runs under ``nn.cond`` so iterations that don't need the convex-
-        upsampling mask (all but the last in ``test_mode``) skip its two
-        convolutions — they are ~40% of the per-iteration FLOPs."""
+        """``compute_mask``: Python ``True`` computes the mask head
+        statically (training, and the final test_mode iteration);
+        ``None`` statically SKIPS it (test_mode non-final iterations —
+        zero mask-head ops, no cond; the round-5 two-call scan
+        structure); a traced scalar bool still runs it under ``nn.cond``
+        (legacy path, kept for np.bool_ flags)."""
         motion_features = self.encoder(flow, corr)
         inp = jnp.concatenate([inp, motion_features], axis=-1)
         net = self.gru(net, inp)
@@ -241,14 +243,14 @@ class BasicUpdateBlock(nn.Module):
         def _mask(mdl, n):
             return 0.25 * mdl.mask_conv2(nn.relu(mdl.mask_conv1(n)))
 
+        if compute_mask is None and not self.is_initializing():
+            return net, None, self.flow_head(net)
+
         if self.is_initializing():
             delta_flow = self.flow_head(net)
             mask = _mask(self, net)
         elif isinstance(compute_mask, bool):
-            # Static flag (training): the pre-existing contract is that a
-            # Python bool — True OR False — computes the real mask head.
-            # (Plain bool only, matching _UpdateStep's check in raft.py —
-            # np.bool_ flags go through nn.cond: correct, just unfused.)
+            # Static flag: a Python bool computes the real mask head.
             # Flow head and mask head share their input, so merge their
             # first 3x3 convs (both 256-out) into one launch
             # (see _concat_conv).
@@ -257,10 +259,10 @@ class BasicUpdateBlock(nn.Module):
             delta_flow = self.flow_head.conv2(nn.relu(f_hid))
             mask = 0.25 * self.mask_conv2(nn.relu(m_hid))
         else:
-            delta_flow = self.flow_head(net)
-            mask = nn.cond(compute_mask, _mask,
-                           lambda mdl, n: jnp.zeros(
-                               n.shape[:3] + (UPSAMPLE_MASK_CHANNELS,),
-                               n.dtype),
-                           self, net)
+            # Traced flags were the round-4 nn.cond path; the two-call
+            # scan structure made it unreachable, so it was deleted
+            # rather than kept untested.
+            raise ValueError(
+                "compute_mask must be True/False (static compute) or "
+                f"None (static skip); got {compute_mask!r}")
         return net, mask, delta_flow

@@ -92,8 +92,9 @@ def test_gradients_flow(small_model):
 
 def test_large_model_gated_test_mode_matches_training_path():
     """test_mode runs the mask head + convex upsampling only on the last
-    iteration (traced nn.cond/lax.cond path); its output must equal the
-    ungated training path's final prediction exactly."""
+    iteration (round 5: two-call scan structure — (iters-1) statically
+    mask-free iterations, then one mask-computing call); its output must
+    equal the ungated training path's final prediction exactly."""
     cfg = RAFTConfig(iters=4)      # large model: mask head present
     model = RAFT(cfg)
     rng = jax.random.PRNGKey(3)
