@@ -1,4 +1,4 @@
-"""Checkpointing: full-state save/restore with true resume.
+"""Checkpointing: full-state save/restore with true resume, hardened.
 
 The reference checkpoints only ``model.state_dict()`` every 5000 steps and
 "resumes" with ``load_state_dict(strict=False)`` — optimizer, scheduler and
@@ -9,6 +9,19 @@ and exact resume work; the curriculum use-case (chairs → things → sintel →
 kitti, ``train_mixed.sh:3-6``) is served by :func:`load_params`, and
 published torch ``.pth`` weights load through
 :mod:`raft_tpu.utils.torch_convert`.
+
+Fault tolerance (multi-day preemptible-pod runs):
+
+* :class:`RunCheckpointer` holds ONE orbax ``CheckpointManager`` per run
+  directory — saves stop re-scanning the directory every call and the
+  ``max_to_keep`` policy is applied consistently across a run.
+* Saves retry transient I/O errors with exponential backoff
+  (:func:`raft_tpu.resilience.retry_with_backoff`).
+* ``restore``/``latest_step`` fall back to the newest *intact* step when
+  the latest checkpoint is truncated or corrupt (a preemption landing
+  mid-save): obviously-truncated step dirs (zero-byte files, missing
+  metadata) are skipped up front, and any step whose actual restore
+  raises falls back to the next-older one.
 """
 
 from __future__ import annotations
@@ -18,6 +31,8 @@ from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
+
+from raft_tpu.resilience import active_injector, retry_with_backoff
 
 
 def _manager(ckpt_dir: str, max_to_keep: Optional[int] = None):
@@ -33,19 +48,165 @@ def _arrays_of(state) -> dict:
             "batch_stats": state.batch_stats, "opt_state": state.opt_state}
 
 
+def _step_intact(ckpt_dir: str, step: int) -> bool:
+    """Cheap structural screen for a truncated step directory.
+
+    Orbax finalizes each step with an atomic rename, but a preemption
+    landing mid-write (or a flaky filesystem) can still leave zero-byte
+    files or a missing metadata marker behind a committed-looking name.
+    This catches the obvious cases without reading array data; deeper
+    corruption is caught by the restore-time fallback in
+    :meth:`RunCheckpointer.restore`.
+    """
+    step_dir = os.path.join(os.path.abspath(ckpt_dir), str(step))
+    if not os.path.isdir(step_dir):
+        return False
+    saw_file = False
+    for root, _, files in os.walk(step_dir):
+        for f in files:
+            saw_file = True
+            try:
+                if os.path.getsize(os.path.join(root, f)) == 0:
+                    return False
+            except OSError:
+                return False
+    return saw_file
+
+
+class RunCheckpointer:
+    """One hardened checkpoint manager for one run directory.
+
+    Thread this through a training run (``train()`` owns one) instead of
+    calling the module-level helpers per save: directory scans happen
+    once, the keep policy sees every save, and the manager's async
+    machinery is reused. Also usable as a context manager.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 5,
+                 save_retries: int = 3, retry_delay: float = 0.5):
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        self.save_retries = save_retries
+        self.retry_delay = retry_delay
+        self._mngr = _manager(self.ckpt_dir, keep)
+
+    # -- save ------------------------------------------------------------
+
+    def _save_once(self, step: int, arrays: dict):
+        # Fault-injection hook first: an injected failure must not leave
+        # partial state inside the real manager.
+        active_injector().maybe_fail_ckpt_save()
+        self._mngr.save(step, args=ocp.args.StandardSave(arrays))
+        self._mngr.wait_until_finished()
+
+    def save(self, state) -> None:
+        """Save ``state`` under its current step number, retrying
+        transient I/O errors with exponential backoff."""
+        step = int(jax.device_get(state.step))
+        arrays = _arrays_of(state)
+
+        def _cleanup(attempt, exc):
+            # A failed attempt may have left a half-written tmp dir or a
+            # stale in-memory directory view; reload is best-effort.
+            try:
+                self._mngr.reload()
+            except Exception:
+                pass
+
+        retry_with_backoff(
+            lambda: self._save_once(step, arrays),
+            retries=self.save_retries, base_delay=self.retry_delay,
+            retry_on=(OSError, IOError), on_retry=_cleanup,
+            describe=f"checkpoint save (step {step}, {self.ckpt_dir})")
+
+    # -- inspect ---------------------------------------------------------
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def latest_step(self) -> Optional[int]:
+        """Newest step that passes the structural intactness screen."""
+        for step in sorted(self._mngr.all_steps(), reverse=True):
+            if _step_intact(self.ckpt_dir, step):
+                return int(step)
+            print(f"WARNING: checkpoint step {step} in {self.ckpt_dir} "
+                  "looks truncated; falling back to an older step",
+                  flush=True)
+        return None
+
+    # -- restore ---------------------------------------------------------
+
+    def _restore_step(self, step: int, state):
+        target = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                              _arrays_of(state))
+        restored = self._mngr.restore(step,
+                                      args=ocp.args.StandardRestore(target))
+        return state.replace(step=restored["step"],
+                             params=restored["params"],
+                             batch_stats=restored["batch_stats"],
+                             opt_state=restored["opt_state"])
+
+    def restore(self, state, step: Optional[int] = None):
+        """Restore a full train state; falls back to older intact steps.
+
+        With an explicit ``step`` the restore is exact (corruption
+        raises). Otherwise candidates are tried newest-first: a step
+        that fails its structural screen or whose actual restore raises
+        is skipped with a warning, and the next-older one is tried —
+        the recovery for a preemption that landed mid-save. Returns
+        ``state`` unchanged when the directory holds no checkpoint;
+        raises the last error when every candidate is corrupt.
+        """
+        if step is not None:
+            return self._restore_step(step, state)
+        candidates = sorted(self._mngr.all_steps(), reverse=True)
+        if not candidates:
+            return state
+        last_err: Optional[Exception] = None
+        for cand in candidates:
+            if not _step_intact(self.ckpt_dir, cand):
+                print(f"WARNING: skipping truncated checkpoint step "
+                      f"{cand} in {self.ckpt_dir}", flush=True)
+                continue
+            try:
+                return self._restore_step(cand, state)
+            except Exception as e:   # corrupt beyond the cheap screen
+                last_err = e
+                print(f"WARNING: restore of checkpoint step {cand} "
+                      f"failed ({type(e).__name__}: {e}); falling back "
+                      "to an older step", flush=True)
+        if last_err is not None:
+            raise last_err
+        raise FileNotFoundError(
+            f"no intact checkpoint under {self.ckpt_dir} "
+            f"(steps present but truncated: {candidates})")
+
+    def close(self):
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 def save_checkpoint(ckpt_dir: str, state, keep: int = 5) -> None:
-    """Save ``state`` under its current step number."""
-    with _manager(ckpt_dir, keep) as mngr:
-        mngr.save(int(jax.device_get(state.step)),
-                  args=ocp.args.StandardSave(_arrays_of(state)))
-        mngr.wait_until_finished()
+    """Save ``state`` under its current step number.
+
+    One-shot convenience (tests, scripts). A training run should hold a
+    single :class:`RunCheckpointer` instead of paying a directory scan
+    per save.
+    """
+    with RunCheckpointer(ckpt_dir, keep=keep) as ckptr:
+        ckptr.save(state)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
-    with _manager(ckpt_dir) as mngr:
-        return mngr.latest_step()
+    with RunCheckpointer(ckpt_dir) as ckptr:
+        return ckptr.latest_step()
 
 
 def restore_checkpoint(ckpt_dir: str, state,
@@ -54,19 +215,12 @@ def restore_checkpoint(ckpt_dir: str, state,
 
     ``state`` provides the target structure (and sharding, when its arrays
     carry shardings); returns the restored state or ``state`` unchanged when
-    the directory holds no checkpoint.
+    the directory holds no checkpoint. When the newest checkpoint is
+    truncated or corrupt, falls back to the newest intact one (see
+    :meth:`RunCheckpointer.restore`).
     """
-    with _manager(ckpt_dir) as mngr:
-        step = step if step is not None else mngr.latest_step()
-        if step is None:
-            return state
-        target = jax.tree.map(ocp.utils.to_shape_dtype_struct,
-                              _arrays_of(state))
-        restored = mngr.restore(step,
-                                args=ocp.args.StandardRestore(target))
-    return state.replace(step=restored["step"], params=restored["params"],
-                         batch_stats=restored["batch_stats"],
-                         opt_state=restored["opt_state"])
+    with RunCheckpointer(ckpt_dir) as ckptr:
+        return ckptr.restore(state, step=step)
 
 
 def load_params(path: str, step: Optional[int] = None) -> Any:
@@ -87,5 +241,8 @@ def load_params(path: str, step: Optional[int] = None) -> Any:
         step = step if step is not None else mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {path}")
-        restored = mngr.restore(step)
+        # Explicit StandardRestore: a fresh manager has no handler
+        # registry for the saved item, so an arg-less restore raises
+        # KeyError on any cross-process load (the curriculum use-case).
+        restored = mngr.restore(step, args=ocp.args.StandardRestore())
     return restored["params"], restored["batch_stats"]

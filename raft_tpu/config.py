@@ -239,3 +239,10 @@ class TrainConfig:
     # for the first 20k steps (reference train.py:379-383).
     sparse_lambda: float = 0.0
     sparse_lambda_steps: int = 20000
+    # Non-finite step guard: a batch with NaN/Inf loss or grads has its
+    # update suppressed in-graph (params unchanged, skipped_steps
+    # counted); after this many CONSECUTIVE skips the run checkpoints
+    # its (still finite) state and aborts — persistent divergence is an
+    # operator problem, not something to grind through. 0 disables the
+    # abort (skipping still applies).
+    max_consecutive_skips: int = 20

@@ -25,6 +25,56 @@ import numpy as np
 
 from raft_tpu.data import frame_utils
 from raft_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+from raft_tpu.resilience import (ResilienceStats, StallWatchdog,
+                                 active_injector, retry_with_backoff)
+
+# Failure modes a single sample read can hit on a long run: a vanished
+# or unreadable file (OSError covers FileNotFoundError / EIO from a
+# flaky NFS mount) and a corrupt image/flow payload (decoders raise
+# ValueError on truncated PNG/PFM/flo data).
+_TRANSIENT_READ_ERRORS = (OSError, ValueError)
+
+
+def _read_sample(dataset, index: int, retries: int = 2,
+                 base_delay: float = 0.05,
+                 max_substitutions: int = 8):
+    """Fault-tolerant single-sample read.
+
+    Retries transient errors with exponential backoff (a blip on the
+    storage layer), then substitutes the next index — deterministically
+    ``(index + k) % len`` for ``k = 1, 2, ...`` — when the sample is
+    truly unreadable (one corrupt PNG must cost one logged substitution,
+    not the epoch: the reference's ``f.result()`` re-raise would kill
+    the run). Returns ``(sample, n_substituted)`` where
+    ``n_substituted`` is how many indices were skipped (0 on the normal
+    path). Raises only when ``max_substitutions + 1`` consecutive
+    indices are all unreadable — at that point the dataset, not a
+    sample, is broken.
+    """
+    n = len(dataset)
+    idx = int(index)
+    last_err = None
+    for k in range(max_substitutions + 1):
+        cand = (idx + k) % n
+
+        def _once(cand=cand):
+            active_injector().maybe_fail_sample(cand)
+            return dataset[cand]
+
+        try:
+            sample = retry_with_backoff(
+                _once, retries=retries, base_delay=base_delay,
+                retry_on=_TRANSIENT_READ_ERRORS,
+                describe=f"sample read (index {cand})")
+            if k:
+                print(f"WARNING: sample {idx} unreadable; substituted "
+                      f"index {cand} ({last_err})", flush=True)
+            return sample, k
+        except _TRANSIENT_READ_ERRORS as e:
+            last_err = e
+    raise RuntimeError(
+        f"{max_substitutions + 1} consecutive samples starting at index "
+        f"{idx} are unreadable; giving up") from last_err
 
 
 class FlowDataset:
@@ -301,7 +351,8 @@ class DataLoader:
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
                  num_workers: int = 4, drop_last: bool = True,
-                 seed: int = 0, prefetch: int = 2):
+                 seed: int = 0, prefetch: int = 2,
+                 stall_timeout: Optional[float] = None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -310,6 +361,16 @@ class DataLoader:
         self.seed = seed
         self.prefetch = prefetch
         self.epoch = 0
+        # Degradation counters for this loader (substituted samples);
+        # the train loop streams them to the scalar sinks.
+        self.stats = ResilienceStats()
+        # Stall watchdog period (seconds; 0 disables). A pump that stops
+        # producing — hung NFS, deadlocked worker — gets a diagnostic
+        # instead of a silently wedged run.
+        if stall_timeout is None:
+            stall_timeout = float(
+                os.environ.get("RAFT_LOADER_STALL_TIMEOUT", "300"))
+        self.stall_timeout = stall_timeout
 
     def __len__(self):
         n = len(self.dataset)
@@ -334,21 +395,53 @@ class DataLoader:
     def _prefetch_loop(self, order, submit, result):
         """Shared pump for both loader kinds: keep ``prefetch`` batches
         of per-sample futures in flight via ``submit(idx)``, drain in
-        order via ``result(fut)``, yield stacked NHWC batch dicts."""
+        order via ``result(fut)``, yield stacked NHWC batch dicts.
+
+        ``result(fut)`` resolves to ``(sample, n_substituted)`` (see
+        :func:`_read_sample`); substitutions are accumulated into
+        ``self.stats``. A :class:`StallWatchdog` (``stall_timeout`` > 0)
+        is petted per yielded batch and prints a pump diagnostic when
+        production stops.
+        """
         pending = []
         batches = list(self._batches(order))
         k = 0
-        while k < len(batches) or pending:
-            while k < len(batches) and len(pending) < self.prefetch:
-                pending.append([submit(i) for i in batches[k]])
-                k += 1
-            samples = [result(f) for f in pending.pop(0)]
-            yield {
-                "image1": np.stack([s[0] for s in samples]),
-                "image2": np.stack([s[1] for s in samples]),
-                "flow": np.stack([s[2] for s in samples]),
-                "valid": np.stack([s[3] for s in samples]),
-            }
+        yielded = 0
+
+        def _diagnose():
+            return (f"{yielded}/{len(batches)} batches yielded, "
+                    f"{len(pending)} batch(es) of futures in flight, "
+                    f"{self.num_workers} workers "
+                    f"({type(self).__name__})")
+
+        watchdog = (StallWatchdog(self.stall_timeout, _diagnose)
+                    if self.stall_timeout and self.stall_timeout > 0
+                    else None)
+        try:
+            if watchdog is not None:
+                watchdog.pet()
+            while k < len(batches) or pending:
+                while k < len(batches) and len(pending) < self.prefetch:
+                    pending.append([submit(i) for i in batches[k]])
+                    k += 1
+                samples = []
+                for f in pending.pop(0):
+                    sample, subs = result(f)
+                    if subs:
+                        self.stats.count_substitution(subs)
+                    samples.append(sample)
+                yield {
+                    "image1": np.stack([s[0] for s in samples]),
+                    "image2": np.stack([s[1] for s in samples]),
+                    "flow": np.stack([s[2] for s in samples]),
+                    "valid": np.stack([s[3] for s in samples]),
+                }
+                yielded += 1
+                if watchdog is not None:
+                    watchdog.pet()
+        finally:
+            if watchdog is not None:
+                watchdog.close()
 
     def __iter__(self):
         from concurrent.futures import ThreadPoolExecutor
@@ -356,8 +449,9 @@ class DataLoader:
         order, _ = self._epoch_order()
 
         def load(idx):
-            img1, img2, flow, valid = self.dataset[int(idx)]
-            return img1, img2, flow, valid
+            (img1, img2, flow, valid), subs = _read_sample(
+                self.dataset, int(idx))
+            return (img1, img2, flow, valid), subs
 
         with ThreadPoolExecutor(self.num_workers) as pool:
             yield from self._prefetch_loop(
@@ -381,8 +475,12 @@ def _process_worker_init(dataset, seed, epoch, counter):
 
 
 def _process_worker_load(idx):
-    s = _WORKER_DS[int(idx)]
-    return s[0], s[1], s[2], s[3]
+    # Same fault-tolerant read path as the thread loader; the
+    # substitution count rides back to the parent in the result tuple
+    # (workers are separate processes — parent-side counters can't see
+    # their recoveries otherwise).
+    (i1, i2, fl, v), subs = _read_sample(_WORKER_DS, int(idx))
+    return (i1, i2, fl, v), subs
 
 
 class ProcessDataLoader(DataLoader):

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.config import RAFTConfig, TrainConfig
 from raft_tpu.losses import sequence_loss
+from raft_tpu.resilience import active_injector
 
 
 class RAFTTrainState(struct.PyTreeNode):
@@ -82,18 +83,42 @@ def _maybe_add_noise(rng, image1, image2):
     return image1, image2
 
 
+def _all_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every leaf of ``tree`` is entirely finite."""
+    leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(tree)]
+    return functools.reduce(jnp.logical_and, leaves, jnp.bool_(True))
+
+
 def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
                     mesh: Optional[Mesh] = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    guard_nonfinite: bool = True) -> Callable:
     """Build the jitted train step.
 
     ``freeze_bn`` mirrors the reference's post-chairs BN freeze
     (``train.py:414-415`` / ``core/raft.py:60-63``).
 
+    ``guard_nonfinite`` (default on) arms the non-finite step guard: a
+    batch producing NaN/Inf loss or grads has its parameter/optimizer/BN
+    update suppressed inside the jitted program (``jnp.where`` select,
+    no host round-trip) and reports ``metrics["skipped_steps"] = 1``;
+    one poison batch then costs one step instead of the whole run. On a
+    finite step the select picks the freshly-computed arrays, so
+    per-step numerics are bit-identical to the unguarded step. The step
+    counter always advances (it counts batches seen, keeping the host
+    loop and LR schedule aligned).
+
+    Fault injection: when the active
+    :class:`raft_tpu.resilience.FaultInjector` carries ``nan_loss_steps``
+    (trace-time constant), the loss is forced non-finite at those step
+    numbers — CPU-testable coverage of the guard. With an inert injector
+    no injection nodes are traced.
+
     Returns ``step_fn(state, batch, rng) -> (state, metrics)`` where
     ``batch`` is a dict with ``image1/image2`` (B,H,W,3) float [0,255],
     ``flow`` (B,H,W,2), ``valid`` (B,H,W).
     """
+    nan_steps = tuple(active_injector().nan_loss_steps)
 
     def step_fn(state: RAFTTrainState, batch: Dict[str, jnp.ndarray], rng):
         noise_rng, dropout_rng = jax.random.split(
@@ -167,12 +192,36 @@ def make_train_step(tcfg: TrainConfig, freeze_bn: bool = False,
             new_bs = mutated.get("batch_stats")
             if not new_bs:
                 new_bs = state.batch_stats
+            if nan_steps:
+                # Multiplicative poison so the backward pass goes
+                # non-finite too (NaN * grad = NaN), like a real blowup.
+                inject = functools.reduce(
+                    jnp.logical_or,
+                    [state.step == s for s in nan_steps],
+                    jnp.bool_(False))
+                loss = loss * jnp.where(inject, jnp.float32(jnp.nan), 1.0)
+                metrics["loss"] = loss
             return loss, (metrics, new_bs)
 
-        grads, (metrics, new_bs) = jax.grad(
+        (loss, (metrics, new_bs)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         metrics["grad_norm"] = optax.global_norm(grads)
         new_state = state.apply_gradients(grads).replace(batch_stats=new_bs)
+        if guard_nonfinite:
+            ok = jnp.logical_and(jnp.all(jnp.isfinite(loss)),
+                                 _all_finite(grads))
+
+            def keep(new, old):
+                return jnp.where(ok, new, old)
+
+            new_state = new_state.replace(
+                params=jax.tree.map(keep, new_state.params, state.params),
+                opt_state=jax.tree.map(keep, new_state.opt_state,
+                                       state.opt_state),
+                batch_stats=jax.tree.map(keep, new_state.batch_stats,
+                                         state.batch_stats))
+            metrics["skipped_steps"] = \
+                jnp.logical_not(ok).astype(jnp.float32)
         return new_state, metrics
 
     if mesh is not None:

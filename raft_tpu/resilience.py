@@ -1,0 +1,230 @@
+"""Fault tolerance: retry policy, stall watchdog, fault injection.
+
+RAFT's curriculum training (chairs → things → sintel → kitti) means
+multi-day runs on preemptible TPU pods; the realistic failure menu —
+a transient checkpoint I/O error, a checkpoint truncated by a
+preemption mid-save, one corrupt PNG, one NaN batch — must degrade a
+run, not kill or silently poison it. This module holds the shared
+machinery:
+
+* :func:`retry_with_backoff` — generic exponential-backoff retry for
+  transient I/O (checkpoint saves, per-sample dataset reads).
+* :class:`StallWatchdog` — a timer that surfaces a diagnostic when the
+  loader's prefetch pump stops producing batches (hung NFS mount,
+  deadlocked worker pool) instead of the run silently wedging.
+* :class:`ResilienceStats` — counters (``substituted_samples``,
+  ``skipped_steps``) surfaced through the scalar stream so degraded
+  runs are auditable (see :class:`raft_tpu.utils.logger.TrainLogger`).
+* :class:`FaultInjector` — env/config-driven fault injection so every
+  recovery path above is testable on CPU under tier-1 (and drillable
+  via ``scripts/fault_drill.py``). Production runs never construct
+  faults: with no ``RAFT_FAULT_*`` env vars set the injector is inert.
+
+Consumers: :mod:`raft_tpu.checkpoint` (save retry, intact-step
+fallback), :mod:`raft_tpu.parallel.train_step` (non-finite guard +
+NaN injection), :mod:`raft_tpu.data.datasets` (resilient sample reads,
+pump watchdog), :mod:`raft_tpu.train` (consecutive-skip abort).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, FrozenSet, Optional, Tuple
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised by the train loop after N consecutive non-finite steps.
+
+    The state checkpointed immediately before raising is the last one
+    whose parameters were finite (the guard never applies a non-finite
+    update), so ``--resume`` restarts from healthy weights.
+    """
+
+
+def retry_with_backoff(fn: Callable, *, retries: int = 3,
+                       base_delay: float = 0.5, max_delay: float = 8.0,
+                       retry_on: Tuple[type, ...] = (OSError,),
+                       describe: str = "operation",
+                       on_retry: Optional[Callable] = None):
+    """Run ``fn()``, retrying transient failures with exponential backoff.
+
+    Attempts ``retries + 1`` times total; sleeps ``base_delay * 2**k``
+    (capped at ``max_delay``) between attempts. Exceptions outside
+    ``retry_on`` propagate immediately; the last retryable failure is
+    re-raised once the budget is exhausted. ``on_retry(attempt, exc)``
+    is called before each sleep (tests hook it; the default also prints
+    a warning so real runs leave evidence).
+    """
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == retries:
+                raise
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            print(f"WARNING: {describe} failed "
+                  f"(attempt {attempt + 1}/{retries + 1}): {e}; "
+                  f"retrying in {delay:.2f}s", flush=True)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(delay)
+
+
+class StallWatchdog:
+    """Surfaces a diagnostic when a producer loop stops making progress.
+
+    The owner calls :meth:`pet` on every unit of progress (one batch
+    yielded); if ``timeout`` seconds elapse with no pet, ``describe()``
+    is printed once per stall (the timer re-arms after the next pet, so
+    a recovered-then-re-stalled pump warns again). This is observability
+    only — it never kills the run; a wedged pump on a TPU pod should
+    leave a trail for the operator, not decide policy.
+    """
+
+    def __init__(self, timeout: float,
+                 describe: Callable[[], str],
+                 sink: Callable[[str], None] = None):
+        self.timeout = timeout
+        self.describe = describe
+        self.sink = sink if sink is not None else \
+            (lambda msg: print(msg, flush=True))
+        self.fired = 0
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+
+    def _fire(self):
+        with self._lock:
+            self.fired += 1
+        try:
+            self.sink(f"WARNING: loader stalled for >{self.timeout:.0f}s: "
+                      f"{self.describe()}")
+        except Exception as e:   # a broken describe() must not kill the timer
+            self.sink(f"WARNING: loader stalled for >{self.timeout:.0f}s "
+                      f"(diagnostic unavailable: {e})")
+
+    def pet(self):
+        """Record progress: cancel the pending alarm and re-arm."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.timeout, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def close(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+
+class ResilienceStats:
+    """Thread-safe degradation counters for one training run.
+
+    ``substituted_samples`` — unreadable/corrupt samples replaced by a
+    deterministic neighbor (loader recovery);
+    ``skipped_steps`` — host-side cumulative count of non-finite steps
+    whose parameter update was suppressed.
+    Surfaced into the JSONL/TensorBoard scalar stream by the train loop
+    so silent degradation is auditable after the fact.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.substituted_samples = 0
+        self.skipped_steps = 0
+
+    def count_substitution(self, n: int = 1):
+        with self._lock:
+            self.substituted_samples += n
+
+    def count_skip(self, n: int = 1):
+        with self._lock:
+            self.skipped_steps += n
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Deterministic fault injection for resilience tests and drills.
+
+    Inert by default; activate by constructing with faults (tests) or
+    via environment variables (``scripts/fault_drill.py``, CI):
+
+    * ``RAFT_FAULT_CKPT_SAVE_ERRORS=N`` — the first N checkpoint save
+      attempts raise ``OSError`` (exercises the save retry loop).
+    * ``RAFT_FAULT_CORRUPT_SAMPLES=3,17`` — dataset reads of these
+      indices raise ``OSError`` (exercises retry + substitution).
+    * ``RAFT_FAULT_NAN_STEPS=5,6`` — the jitted train step forces a
+      non-finite loss at these step numbers (exercises the update
+      guard). Trace-time constant: injection adds graph nodes only when
+      requested, so production steps carry zero overhead.
+
+    Mutable counters (the save-error budget) live on the instance;
+    :func:`active_injector` holds one per process so budgets persist
+    across calls.
+    """
+
+    ckpt_save_errors: int = 0
+    corrupt_sample_indices: FrozenSet[int] = frozenset()
+    nan_loss_steps: Tuple[int, ...] = ()
+
+    @staticmethod
+    def from_env() -> "FaultInjector":
+        def _ints(name):
+            raw = os.environ.get(name, "").strip()
+            return tuple(int(x) for x in raw.split(",") if x.strip())
+
+        return FaultInjector(
+            ckpt_save_errors=int(
+                os.environ.get("RAFT_FAULT_CKPT_SAVE_ERRORS", "0")),
+            corrupt_sample_indices=frozenset(
+                _ints("RAFT_FAULT_CORRUPT_SAMPLES")),
+            nan_loss_steps=_ints("RAFT_FAULT_NAN_STEPS"))
+
+    # -- hooks -----------------------------------------------------------
+
+    def maybe_fail_ckpt_save(self):
+        """Called once per checkpoint save *attempt*; burns one unit of
+        the error budget per call until exhausted."""
+        if self.ckpt_save_errors > 0:
+            self.ckpt_save_errors -= 1
+            raise OSError("injected checkpoint save failure "
+                          f"({self.ckpt_save_errors} more queued)")
+
+    def maybe_fail_sample(self, index: int):
+        """Called before each dataset read; deterministic by index so a
+        corrupt sample stays corrupt across retries (forcing the
+        substitution path) while its neighbors stay readable."""
+        if int(index) in self.corrupt_sample_indices:
+            raise OSError(f"injected corrupt sample at index {index}")
+
+    @property
+    def active(self) -> bool:
+        return bool(self.ckpt_save_errors or self.corrupt_sample_indices
+                    or self.nan_loss_steps)
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active_injector() -> FaultInjector:
+    """The process-wide injector: constructed from ``RAFT_FAULT_*`` env
+    vars on first use (so error budgets persist across calls), or
+    whatever :func:`set_injector` installed."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = FaultInjector.from_env()
+    return _ACTIVE
+
+
+def set_injector(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install ``inj`` as the process-wide injector (``None`` resets to
+    lazy env-construction). Returns the previous injector so tests can
+    restore it."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = inj
+    return prev

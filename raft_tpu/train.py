@@ -33,6 +33,7 @@ import threading
 from raft_tpu import checkpoint as ckpt_lib
 from raft_tpu import evaluate
 from raft_tpu.config import MODEL_FAMILIES, RAFTConfig, TrainConfig
+from raft_tpu.resilience import TrainingDiverged
 from raft_tpu.models.raft import RAFT
 from raft_tpu.optim import make_schedule
 from raft_tpu.parallel import (create_train_state, make_mesh,
@@ -154,12 +155,16 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     mesh = make_mesh(n_spatial=spatial_shards)
     model = build_model(tcfg.model_family, mcfg)
     run_ckpt_dir = os.path.join(ckpt_dir, tcfg.name)
+    # ONE manager per run: saves stop re-scanning the directory and the
+    # keep policy sees every save; saves retry transient I/O, restores
+    # fall back past truncated steps (raft_tpu/checkpoint.py).
+    ckptr = ckpt_lib.RunCheckpointer(run_ckpt_dir)
 
-    with mesh:
+    with ckptr, mesh:
         state = create_train_state(rng, model, tcfg, tcfg.image_size,
                                    mesh=mesh)
-        if resume and ckpt_lib.latest_step(run_ckpt_dir) is not None:
-            state = ckpt_lib.restore_checkpoint(run_ckpt_dir, state)
+        if resume and ckptr.latest_step() is not None:
+            state = ckptr.restore(state)
             print(f"resumed from step {int(state.step)}")
         elif restore_ckpt:
             params, batch_stats = ckpt_lib.load_params(restore_ckpt)
@@ -199,6 +204,9 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
         # counts (a conditional collective would deadlock); single
         # process checks every step with no collective.
         check_every = 1 if jax.process_count() == 1 else 10
+        consecutive_skips = 0
+        last_substituted = 0
+        loader_stats = getattr(dataloader, "stats", None)
         with guard:
             # the while-condition check also escapes a pathological spin
             # over an exhausted one-shot dataloader (local flag only; no
@@ -207,18 +215,43 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                 for batch in dataloader:
                     if total_steps % check_every == 0 and \
                             _preemption_agreed(guard.requested):
-                        ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+                        ckptr.save(state)
                         print(f"preemption checkpoint at step "
                               f"{total_steps}; resume with --resume")
                         return state
                     batch = shard_batch(batch, mesh)
                     state, metrics = step_fn(state, batch, step_rng)
                     total_steps += 1
-                    logger.push(jax.device_get(metrics),
+                    host_metrics = jax.device_get(metrics)
+                    # Degradation counters into the scalar stream
+                    # (logger accumulates them as run totals): per-step
+                    # skip flag from the jitted guard, substitution
+                    # delta from the loader.
+                    if loader_stats is not None:
+                        subs = loader_stats.substituted_samples
+                        host_metrics["substituted_samples"] = float(
+                            subs - last_substituted)
+                        last_substituted = subs
+                    if host_metrics.get("skipped_steps", 0.0) > 0:
+                        consecutive_skips += 1
+                    else:
+                        consecutive_skips = 0
+                    logger.push(host_metrics,
                                 lr=float(schedule(total_steps - 1)))
+                    if tcfg.max_consecutive_skips and consecutive_skips \
+                            >= tcfg.max_consecutive_skips:
+                        # The guard never applied a non-finite update,
+                        # so the state being saved is the last finite
+                        # one; persistent divergence needs an operator,
+                        # not more poisoned batches.
+                        ckptr.save(state)
+                        raise TrainingDiverged(
+                            f"{consecutive_skips} consecutive non-finite "
+                            f"steps at step {total_steps}; checkpointed "
+                            f"last finite state to {run_ckpt_dir}")
 
                     if total_steps % tcfg.val_freq == 0:
-                        ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+                        ckptr.save(state)
                         # Single-process only: sharded batch/pred arrays span
                         # non-addressable devices on multi-host meshes and
                         # device_get would raise there (panels are a debug
@@ -249,12 +282,22 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                             results = evaluate.run_validation(
                                 predictor, validation)
                             logger.write_dict(results, step=total_steps)
+                        # A SIGTERM landing during the validation/panel
+                        # block above must not wait for the next batch
+                        # to complete: re-vote here (deterministic
+                        # point — every host reaches this val_freq
+                        # boundary). The val checkpoint above already
+                        # holds this exact state.
+                        if _preemption_agreed(guard.requested):
+                            print(f"preemption after validation at step "
+                                  f"{total_steps}; resume with --resume")
+                            return state
 
                     if total_steps >= tcfg.num_steps:
                         keep_training = False
                         break
 
-        ckpt_lib.save_checkpoint(run_ckpt_dir, state)
+        ckptr.save(state)
     return state
 
 

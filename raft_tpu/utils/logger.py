@@ -156,12 +156,20 @@ class TrainLogger:
       sum_freq: console/scalar flush period (reference SUM_FREQ=100).
     """
 
+    # Degradation counters (non-finite steps skipped by the train-step
+    # guard, unreadable samples substituted by the loader): accumulated
+    # as RUN TOTALS rather than window means and emitted with every
+    # scalar flush, so a run can be audited for silent degradation
+    # from its JSONL/TensorBoard stream alone.
+    COUNTER_KEYS = ("skipped_steps", "substituted_samples")
+
     def __init__(self, log_dir: str, sum_freq: int = 100,
                  tensorboard: bool = True):
         self.log_dir = log_dir
         self.sum_freq = sum_freq
         self.total_steps = 0
         self.running: Dict[str, float] = {}
+        self.counters: Dict[str, float] = {}
         self._jsonl = _JsonlWriter(os.path.join(log_dir, "scalars.jsonl"))
         self._tb = None
         if tensorboard:
@@ -183,19 +191,29 @@ class TrainLogger:
         parts.append(f"lr {lr:10.7f}]" if lr is not None else "]")
         parts += [f"{k}: {v / self.sum_freq:10.4f}"
                   for k, v in sorted(self.running.items())]
+        parts += [f"{k}: {v:g}" for k, v in sorted(self.counters.items())
+                  if v]
         parts.append(f"({rate:.2f} it/s)")
         return " ".join(parts)
 
     def push(self, metrics: Dict[str, float], lr: Optional[float] = None):
-        """Accumulate one step's metrics; print + flush every sum_freq."""
+        """Accumulate one step's metrics; print + flush every sum_freq.
+
+        Keys in :attr:`COUNTER_KEYS` are treated as per-step increments
+        of run-total degradation counters (not window-averaged).
+        """
         self.total_steps += 1
         for k, v in metrics.items():
-            self.running[k] = self.running.get(k, 0.0) + float(v)
+            if k in self.COUNTER_KEYS:
+                self.counters[k] = self.counters.get(k, 0.0) + float(v)
+            else:
+                self.running[k] = self.running.get(k, 0.0) + float(v)
         if self.total_steps % self.sum_freq == 0:
             print(self._status(lr))
             scalars = {k: v / self.sum_freq for k, v in self.running.items()}
             if lr is not None:
                 scalars["lr"] = lr
+            scalars.update(self.counters)
             self.write_dict(scalars)
             self.running = {}
             self._t0 = time.time()

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Fault drill: inject each failure class into a tiny training run.
+
+CPU-runnable CI gate for the resilience subsystem
+(``raft_tpu/resilience.py``): runs a miniature synthetic-data training
+loop with each fault class injected in sequence —
+
+1. transient checkpoint-save I/O errors  -> save retries succeed;
+2. corrupt latest checkpoint             -> resume falls back to the
+   newest intact step;
+3. an unreadable sample                  -> the epoch completes with a
+   logged, counted substitution;
+4. a NaN batch                           -> the step is skipped, params
+   stay finite, the skip is counted;
+5. preemption (guard flag)               -> clean checkpoint, resume
+   continues from the exact step.
+
+Exits nonzero if any recovery path fails. Usage::
+
+    JAX_PLATFORMS=cpu python scripts/fault_drill.py
+"""
+
+import os
+import sys
+import tempfile
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+
+from raft_tpu import checkpoint as ckpt_lib                 # noqa: E402
+from raft_tpu.config import RAFTConfig, TrainConfig         # noqa: E402
+from raft_tpu.resilience import (FaultInjector,             # noqa: E402
+                                 TrainingDiverged, set_injector)
+from raft_tpu.utils.logger import TrainLogger               # noqa: E402
+
+H, W = 64, 96
+
+
+class SyntheticLoader:
+    """Batches with a constant 2px rightward flow."""
+
+    def __init__(self, batch_size=8, n=4, seed=0):
+        self.rng = np.random.default_rng(seed)
+        self.batch_size = batch_size
+        self.n = n
+
+    def __iter__(self):
+        for _ in range(self.n):
+            img1 = self.rng.uniform(
+                0, 255, (self.batch_size, H, W, 3)).astype(np.float32)
+            img2 = np.roll(img1, 2, axis=2)
+            flow = np.zeros((self.batch_size, H, W, 2), np.float32)
+            flow[..., 0] = 2.0
+            valid = np.ones((self.batch_size, H, W), np.float32)
+            yield {"image1": img1, "image2": img2, "flow": flow,
+                   "valid": valid}
+
+
+def _cfg(num_steps, **kw):
+    base = dict(name="drill", num_steps=num_steps, batch_size=8,
+                image_size=(H, W), iters=2, val_freq=1000, sum_freq=2)
+    base.update(kw)
+    return (TrainConfig(**base), RAFTConfig(small=True, iters=2))
+
+
+def _run(tcfg, mcfg, root, n_batches=8, resume=False):
+    from raft_tpu.train import train
+
+    return train(tcfg, mcfg, ckpt_dir=os.path.join(root, "ckpts"),
+                 log_dir=os.path.join(root, "logs"),
+                 dataloader=SyntheticLoader(n=n_batches), resume=resume,
+                 logger=TrainLogger(os.path.join(root, "logs", "drill"),
+                                    sum_freq=2, tensorboard=False))
+
+
+def _finite(state):
+    return all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree.leaves(state.params))
+
+
+# -- drills --------------------------------------------------------------
+
+
+def drill_ckpt_io_errors(root):
+    """Transient save failures are retried; the run still completes."""
+    set_injector(FaultInjector(ckpt_save_errors=2))
+    tcfg, mcfg = _cfg(num_steps=2)
+    state = _run(tcfg, mcfg, root)
+    d = os.path.join(root, "ckpts", "drill")
+    assert int(state.step) == 2, f"run did not complete: {int(state.step)}"
+    assert ckpt_lib.latest_step(d) == 2, "final save missing"
+    assert _finite(state), "non-finite params"
+
+
+def drill_corrupt_latest_checkpoint(root):
+    """Truncate the newest checkpoint; resume falls back and retrains."""
+    tcfg, mcfg = _cfg(num_steps=2)
+    _run(tcfg, mcfg, root)                       # saves step 2
+    tcfg3, _ = _cfg(num_steps=3)
+    _run(tcfg3, mcfg, root, resume=True)         # saves step 3
+    d = os.path.join(root, "ckpts", "drill")
+    with ckpt_lib.RunCheckpointer(d) as ckptr:
+        assert sorted(ckptr.all_steps())[-1] == 3
+    step_dir = os.path.join(d, "3")
+    for r, _, files in os.walk(step_dir):
+        for f in files:                          # preemption mid-save
+            open(os.path.join(r, f), "w").close()
+    assert ckpt_lib.latest_step(d) == 2, "intact fallback failed"
+    tcfg4, _ = _cfg(num_steps=4)
+    state = _run(tcfg4, mcfg, root, resume=True)  # resumes from 2
+    assert int(state.step) == 4, f"resume-after-corruption: {int(state.step)}"
+    assert _finite(state)
+
+
+def drill_corrupt_sample(root):
+    """One unreadable sample: the epoch completes with a substitution."""
+    from raft_tpu.data.datasets import DataLoader
+
+    class ArrayDataset:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            img = np.full((H, W, 3), float(i), np.float32)
+            return (img, img.copy(),
+                    np.zeros((H, W, 2), np.float32),
+                    np.ones((H, W), np.float32))
+
+    set_injector(FaultInjector(corrupt_sample_indices=frozenset({5})))
+    loader = DataLoader(ArrayDataset(), batch_size=8, shuffle=False,
+                        num_workers=2, stall_timeout=0)
+    batches = list(loader)
+    assert len(batches) == 2, f"epoch truncated: {len(batches)} batches"
+    assert loader.stats.substituted_samples == 1, \
+        f"substitutions: {loader.stats.substituted_samples}"
+
+
+def drill_nan_batch(root):
+    """One poisoned batch: step skipped, params stay finite, counted."""
+    set_injector(FaultInjector(nan_loss_steps=(1,)))
+    tcfg, mcfg = _cfg(num_steps=3)
+    state = _run(tcfg, mcfg, root)
+    assert int(state.step) == 3, f"run did not complete: {int(state.step)}"
+    assert _finite(state), "NaN leaked into params"
+    import json
+    scalars = [json.loads(l) for l in open(os.path.join(
+        root, "logs", "drill", "scalars.jsonl"))]
+    skipped = max(s.get("skipped_steps", 0) for s in scalars)
+    assert skipped == 1, f"skip not counted: {skipped}"
+
+
+def drill_nan_divergence_abort(root):
+    """Every batch poisoned: the loop aborts with a finite checkpoint
+    instead of grinding on."""
+    set_injector(FaultInjector(nan_loss_steps=tuple(range(64))))
+    tcfg, mcfg = _cfg(num_steps=50, max_consecutive_skips=3)
+    try:
+        _run(tcfg, mcfg, root, n_batches=50)
+    except TrainingDiverged:
+        pass
+    else:
+        raise AssertionError("divergence did not abort")
+    d = os.path.join(root, "ckpts", "drill")
+    assert ckpt_lib.latest_step(d) == 3, "abort checkpoint missing"
+
+
+def drill_preemption_resume(root):
+    """Guard flag mid-run -> exact-step checkpoint -> resume finishes."""
+    import raft_tpu.train as train_mod
+    from raft_tpu.train import train
+
+    tcfg, mcfg = _cfg(num_steps=50)
+    box = [None]
+
+    class SpyGuard(train_mod._PreemptionGuard):
+        def __init__(self):
+            super().__init__()
+            box[0] = self
+
+    class PreemptingLoader(SyntheticLoader):
+        def __iter__(self):
+            for i, batch in enumerate(super().__iter__()):
+                if i == 2:            # SIGTERM lands before batch 3
+                    box[0].requested = True
+                yield batch
+
+    orig = train_mod._PreemptionGuard
+    train_mod._PreemptionGuard = SpyGuard
+    try:
+        state = train(tcfg, mcfg, ckpt_dir=os.path.join(root, "ckpts"),
+                      log_dir=os.path.join(root, "logs"),
+                      dataloader=PreemptingLoader(n=50),
+                      logger=TrainLogger(os.path.join(root, "logs", "d"),
+                                         sum_freq=2, tensorboard=False))
+    finally:
+        train_mod._PreemptionGuard = orig
+    assert int(state.step) == 2, f"preempted at {int(state.step)}, not 2"
+    d = os.path.join(root, "ckpts", "drill")
+    assert ckpt_lib.latest_step(d) == 2, "preemption checkpoint missing"
+
+    tcfg2, _ = _cfg(num_steps=4)
+    state2 = _run(tcfg2, mcfg, root, resume=True)
+    assert int(state2.step) == 4, f"resume reached {int(state2.step)}, not 4"
+    assert _finite(state2)
+
+
+DRILLS = [
+    drill_ckpt_io_errors,
+    drill_corrupt_latest_checkpoint,
+    drill_corrupt_sample,
+    drill_nan_batch,
+    drill_nan_divergence_abort,
+    drill_preemption_resume,
+]
+
+
+def main() -> int:
+    failures = 0
+    for drill in DRILLS:
+        name = drill.__name__
+        set_injector(None)
+        with tempfile.TemporaryDirectory(prefix=f"{name}_") as root:
+            print(f"=== {name} ===", flush=True)
+            try:
+                drill(root)
+            except Exception:
+                failures += 1
+                print(f"FAIL {name}", flush=True)
+                traceback.print_exc()
+            else:
+                print(f"PASS {name}", flush=True)
+            finally:
+                set_injector(None)
+    print(f"\n{len(DRILLS) - failures}/{len(DRILLS)} drills passed",
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
