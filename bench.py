@@ -542,7 +542,131 @@ def _sparse_metrics() -> dict:
             "sparse_batch": batch, "sparse_resolution": [h, w]}
 
 
+SERVING_METRIC = "serving_vs_sequential_batch1_speedup"
+
+
+def serving_main():
+    """``python bench.py serving`` — dynamic-batching serving benchmark.
+
+    Drives the serving engine (raft_tpu/serving/) with concurrent
+    closed-loop clients and publishes its sustained throughput against
+    the thing it replaces: a sequential batch-1 request loop over the
+    SAME predictor on the same host. Emits ONE BENCH-compatible JSON
+    line (same contract as the headline mode).
+
+    Operating point is platform-adaptive: on TPU the flagship RAFT-large
+    at Sintel resolution / iters=12 (the batch-1 gap this subsystem
+    exists to close — BENCH_r05: 31.5 pairs/s at b1 vs 99.0 at b128);
+    on CPU a small-model smoke point that completes in minutes and
+    STILL verifies every response bit-for-bit. CPU hosts with one core
+    (this container) have no dispatch gap to recover — the artifact says
+    so explicitly in ``criterion_note`` instead of faking a speedup.
+    """
+    import jax
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+
+    platform = jax.devices()[0].platform
+    ncores = os.cpu_count() or 1
+    if platform == "tpu":
+        shapes = [(436, 1024)]
+        small, iters = False, ITERS
+        max_batch, concurrency, n_requests = 32, 16, 512
+        max_wait_ms = 5.0
+    else:
+        shapes = [(64, 96), (61, 93)]     # two raws, one padded bucket
+        small, iters = True, 4
+        max_batch, concurrency, n_requests = 8, 8, 48
+        max_wait_ms = 4.0
+
+    predictor = load_predictor("random", small=small, iters=iters)
+    frames = loadgen.make_frames(shapes, per_shape=2, seed=0)
+    refs = loadgen.batched_reference_flows(frames=frames,
+                                           predictor=predictor,
+                                           max_batch=max_batch)
+    seq = loadgen.sequential_baseline(predictor, frames,
+                                      n_requests=max(n_requests // 4, 8))
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        buckets=tuple(shapes), persistent_cache=True))
+    engine.start()                        # warms every bucket
+    try:
+        res = loadgen.run_load(engine, frames, n_requests=n_requests,
+                               concurrency=concurrency, references=refs)
+    finally:
+        engine.close()
+
+    speedup = (res["throughput_rps"] / seq["throughput_rps"]
+               if seq["throughput_rps"] else None)
+    payload = {
+        "metric": SERVING_METRIC,
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x",
+        "platform": platform,
+        "host_cores": ncores,
+        "model": "raft-small" if small else "raft-large",
+        "iters": iters,
+        "shapes": [list(s) for s in shapes],
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "serving_pairs_per_sec": round(res["throughput_rps"], 3),
+        "sequential_batch1_pairs_per_sec": round(
+            seq["throughput_rps"], 3),
+        "latency_p50_ms": round(res["latency_ms"]["p50"], 2),
+        "latency_p95_ms": round(res["latency_ms"]["p95"], 2),
+        "latency_p99_ms": round(res["latency_ms"]["p99"], 2),
+        "batch_histogram": {str(k): v for k, v in
+                            sorted(res["batch_histogram"].items())},
+        "mean_batch_size": round(engine.metrics.mean_batch_size(), 2),
+        "padded_slots": engine.metrics.padded_slots,
+        "queue_depth_peak": engine.metrics.queue_depth_peak,
+        "post_warmup_compiles": engine.metrics.compiles,
+        "responses_bit_exact": res["ok"],
+        "dropped": len(res["dropped"]),
+        "mismatched": len(res["mismatched"]),
+        "host_stage_ms": engine.stages.summary(),
+    }
+    if platform != "tpu":
+        # Honesty clause (bench.py discipline: context travels with the
+        # artifact, values are never faked): the batch-1 gap is a device
+        # dispatch-overhead phenomenon. A 1-core CPU host is
+        # compute-bound at every batch size, so the ≥2x criterion is
+        # measurable only on an accelerator — the committed TPU context
+        # below is what serving recovers there, not this host's number.
+        payload["criterion_note"] = (
+            "≥2x speedup is an accelerator dispatch-bound phenomenon; "
+            f"this {ncores}-core {platform} host is compute-bound at "
+            "every batch size (measured b8/b1 ratio ~1.0-1.25x), so "
+            "the speedup here reflects batching+pipelining overheads "
+            "amortized, not the dispatch gap")
+        payload["tpu_reference_context"] = {
+            "file": "BENCH_r05 (round-5 on-chip capture)",
+            "batch1_pairs_per_sec": 31.5,
+            "batch128_pairs_per_sec": 98.7,
+            "note": "labelled context from the committed TPU capture, "
+                    "not a substitute measurement",
+        }
+    _emit(payload)
+
+
+def _serving_failure(msg: str) -> None:
+    _emit({"metric": SERVING_METRIC, "value": None, "unit": "x",
+           "error": msg})
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        try:
+            serving_main()
+        except SystemExit:
+            raise
+        except BaseException as e:  # noqa: BLE001 — artifact must parse
+            _serving_failure(f"{type(e).__name__}: {e}")
+        sys.exit(0)
     try:
         main()
     except SystemExit:

@@ -459,26 +459,38 @@ class DataLoader:
                 lambda f: f.result())
 
 
-# Worker-process global: set once per worker by the pool initializer
+# Worker-process globals: set once per worker by the pool initializer
 # (the dataset is pickled once per worker at pool start — file lists +
-# augmentor params, a few hundred KB — never per task).
+# augmentor params, a few hundred KB — never per task). The pool is
+# created ONCE per loader and reused across epochs, so the augmentation
+# stream is reseeded lazily per task when the epoch changes, not at
+# init.
 _WORKER_DS = None
+_WORKER_WID = None
+_WORKER_STREAM = None     # (seed, epoch) the dataset is currently seeded for
 
 
-def _process_worker_init(dataset, seed, epoch, counter):
-    global _WORKER_DS
+def _process_worker_init(dataset, counter):
+    global _WORKER_DS, _WORKER_WID, _WORKER_STREAM
     with counter.get_lock():
-        wid = counter.value
+        _WORKER_WID = counter.value
         counter.value += 1
     _WORKER_DS = dataset
-    _WORKER_DS.reseed((seed, epoch, wid))
+    _WORKER_STREAM = None
 
 
-def _process_worker_load(idx):
+def _process_worker_load(idx, seed, epoch):
     # Same fault-tolerant read path as the thread loader; the
     # substitution count rides back to the parent in the result tuple
     # (workers are separate processes — parent-side counters can't see
-    # their recoveries otherwise).
+    # their recoveries otherwise). The (seed, epoch) ride with every
+    # task so the long-lived worker reseeds itself on the first task of
+    # each new epoch — same (seed, epoch, worker_id) streams as the
+    # old fork-per-epoch design, without paying a pool restart.
+    global _WORKER_STREAM
+    if _WORKER_STREAM != (seed, epoch):
+        _WORKER_DS.reseed((seed, epoch, _WORKER_WID))
+        _WORKER_STREAM = (seed, epoch)
     (i1, i2, fl, v), subs = _read_sample(_WORKER_DS, int(idx))
     return (i1, i2, fl, v), subs
 
@@ -504,25 +516,72 @@ class ProcessDataLoader(DataLoader):
     clean single-threaded process spawned at first use; workers fork
     from it, never from the JAX-infested parent. Each worker reseeds
     its augmentation stream with (seed, epoch, worker_id) so workers
-    don't produce identical crops.
+    don't produce identical crops — lazily on the first task of each
+    epoch, because ONE pool is reused across epochs (re-forking 24
+    workers and re-pickling the dataset every epoch bought nothing but
+    a per-epoch stall).
+
+    Results are drained with a timeout (``worker_timeout`` seconds, or
+    ``RAFT_LOADER_WORKER_TIMEOUT``, default 300): a worker that dies
+    without returning — the OOM killer is the classic — surfaces as a
+    RuntimeError naming the wait, not a permanent ``f.get()`` hang.
     """
 
-    def __iter__(self):
-        import multiprocessing as mp
+    def __init__(self, *args, worker_timeout: Optional[float] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        if worker_timeout is None:
+            worker_timeout = float(
+                os.environ.get("RAFT_LOADER_WORKER_TIMEOUT", "300"))
+        self.worker_timeout = worker_timeout
+        self._pool = None
 
-        ctx = mp.get_context("forkserver")
-        order, epoch = self._epoch_order()
-        counter = ctx.Value("i", 0)
-        pool = ctx.Pool(self.num_workers, initializer=_process_worker_init,
-                        initargs=(self.dataset, self.seed, epoch, counter))
+    def _ensure_pool(self):
+        import multiprocessing as mp
+        import weakref
+
+        if self._pool is None:
+            ctx = mp.get_context("forkserver")
+            counter = ctx.Value("i", 0)
+            self._pool = ctx.Pool(
+                self.num_workers, initializer=_process_worker_init,
+                initargs=(self.dataset, counter))
+            # GC-time cleanup that must not resurrect self: capture the
+            # pool, not the loader.
+            pool = self._pool
+            weakref.finalize(self, lambda p: (p.terminate(), p.join()),
+                             pool)
+        return self._pool
+
+    def close(self):
+        """Terminate the worker pool (idempotent; the next iteration
+        would start a fresh one)."""
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+    def _get_result(self, fut):
+        from multiprocessing import TimeoutError as MpTimeout
+
         try:
-            yield from self._prefetch_loop(
-                order,
-                lambda i: pool.apply_async(_process_worker_load, (i,)),
-                lambda f: f.get())
-        finally:
-            pool.terminate()
-            pool.join()
+            return fut.get(self.worker_timeout)
+        except MpTimeout:
+            raise RuntimeError(
+                f"loader worker produced no result within "
+                f"{self.worker_timeout:.0f}s — a worker process likely "
+                "died without returning (OOM-killed?); check dmesg, "
+                "lower num_workers, or raise "
+                "RAFT_LOADER_WORKER_TIMEOUT") from None
+
+    def __iter__(self):
+        order, epoch = self._epoch_order()
+        pool = self._ensure_pool()
+        yield from self._prefetch_loop(
+            order,
+            lambda i: pool.apply_async(_process_worker_load,
+                                       (i, self.seed, epoch)),
+            self._get_result)
 
 
 def select_loader(loader: str = "auto",

@@ -102,14 +102,25 @@ class FlowPredictor:
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.batch_size = batch_size
+        # Donate the image buffers to the compiled executable (serving's
+        # steady state re-stacks fresh host arrays every batch, so the
+        # device copies are dead after dispatch). Off by default: eval
+        # callers may reuse arrays, and CPU/older backends warn on
+        # donation. The serving engine flips it on TPU. Cold flips only:
+        # the flag is part of the executable cache key, so toggling it
+        # mid-run recompiles rather than corrupting cached callables.
+        self.donate_images = False
         self._cache: Dict = {}
 
-    def _pick_engine(self, shape, n_sp: int = 1):
+    def _pick_engine(self, shape, n_sp: int = 1, n_dt: int = 1):
         """corr_impl='auto' per-shape engine choice, shared by the
         sharded and unsharded paths: the fused on-demand kernel wherever
         its VMEM layout admits this padded shape on TPU (and, sharded,
-        where feature rows divide the spatial axis), else the
-        materialized pyramid."""
+        where feature rows divide the spatial axis AND the batch divides
+        the data axis — a sharded-fused configuration the shard_map
+        wrapper would reject must fall back to the materialized engine
+        here, not surface as a lowering failure), else the materialized
+        pyramid."""
         if self._engines is None:
             return self.model
         from raft_tpu.models.corr import alternate_eval_eligible
@@ -118,11 +129,17 @@ class FlowPredictor:
                 if jax.default_backend() == "tpu"
                 and alternate_eval_eligible(self.model.config,
                                             shape[1:3],
-                                            spatial_shards=n_sp)
+                                            spatial_shards=n_sp,
+                                            batch=shape[0],
+                                            data_shards=n_dt)
                 else allpairs)
 
     def _fn(self, shape, warm: bool) -> Callable:
-        key = (shape, warm, self.iters)
+        # Donation only applies to the plain-jit path: warm start feeds
+        # flow_init alongside the images (kept simple), and spatial_jit
+        # manages its own sharding/placement.
+        donate = bool(self.donate_images) and not warm and self.mesh is None
+        key = (shape, warm, self.iters, donate)
         if key not in self._cache:
             if self.mesh is not None:
                 if warm:
@@ -130,8 +147,9 @@ class FlowPredictor:
                         "warm start (flow_init) is not supported with "
                         "spatially-sharded eval — the init flow would "
                         "need its own sharding spec")
-                from raft_tpu.parallel.mesh import SPATIAL_AXIS
+                from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
                 n_sp = self.mesh.shape[SPATIAL_AXIS]
+                n_dt = self.mesh.shape.get(DATA_AXIS, 1)
                 rows = shape[1]
                 if rows % n_sp:
                     raise ValueError(
@@ -148,7 +166,7 @@ class FlowPredictor:
                 # multi-chip eval no longer eats the materialized
                 # engine's 1.5-1.7x penalty where the kernel fits VMEM
                 # and rows divide evenly.
-                model = self._pick_engine(shape, n_sp=n_sp)
+                model = self._pick_engine(shape, n_sp=n_sp, n_dt=n_dt)
 
                 def run(variables, image1, image2, model=model):
                     return model.apply(
@@ -167,7 +185,8 @@ class FlowPredictor:
                         variables, image1, image2, iters=self.iters,
                         flow_init=flow_init, test_mode=True)
 
-                self._cache[key] = jax.jit(run)
+                self._cache[key] = jax.jit(
+                    run, donate_argnums=(1, 2) if donate else ())
         return self._cache[key]
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
@@ -184,13 +203,23 @@ class FlowPredictor:
         flow_low, flow_up = fn(self.variables, img1, img2, init)
         return np.asarray(flow_low[0]), np.asarray(flow_up[0])
 
-    def predict_batch(self, images1: np.ndarray, images2: np.ndarray):
-        """Batched forward: (B, H, W, 3) stacks → ((B, H/8, W/8, 2),
-        (B, H, W, 2)) numpy."""
+    def dispatch_batch(self, images1: np.ndarray, images2: np.ndarray):
+        """Non-blocking batched forward: (B, H, W, 3) stacks →
+        ``(flow_low, flow_up)`` *device* arrays, returned as soon as the
+        computation is dispatched (JAX async dispatch). The caller syncs
+        when it reads them (``np.asarray``), so host work — stacking the
+        next batch, padding — overlaps device compute. This is the
+        serving engine's pipelining primitive; :meth:`predict_batch` is
+        the blocking wrapper."""
         img1 = jnp.asarray(images1)
         img2 = jnp.asarray(images2)
         fn = self._fn(img1.shape, False)
-        flow_low, flow_up = fn(self.variables, img1, img2, None)
+        return fn(self.variables, img1, img2, None)
+
+    def predict_batch(self, images1: np.ndarray, images2: np.ndarray):
+        """Batched forward: (B, H, W, 3) stacks → ((B, H/8, W/8, 2),
+        (B, H, W, 2)) numpy."""
+        flow_low, flow_up = self.dispatch_batch(images1, images2)
         return np.asarray(flow_low), np.asarray(flow_up)
 
 

@@ -230,11 +230,35 @@ def alternate_lookup(fmap1: jnp.ndarray, pyramid2, coords: jnp.ndarray,
         from raft_tpu.parallel.spatial import current_spatial_kernel_mesh
         mesh = current_spatial_kernel_mesh()
         if mesh is not None:
-            sharded = _sharded_fused_lookup(
-                fmap1, tuple(pyramid2), coords, mesh, radius, scale,
-                mxu_dtype, rescale, out_dtype)
-            if sharded is not None:
-                return sharded
+            from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+            n_sp = mesh.shape.get(SPATIAL_AXIS, 1)
+            n_dt = mesh.shape.get(DATA_AXIS, 1)
+            if n_sp > 1 or n_dt > 1:
+                if fmap1.shape[1] % n_sp or fmap1.shape[0] % n_dt:
+                    # The sharded composition needs rows % spatial and
+                    # batch % data to divide; without it the ONLY safe
+                    # engine under an active mesh is the jnp path (the
+                    # kernel's custom call is not auto-partitionable
+                    # under SPMD — lowering it unsharded here would
+                    # fail, not replicate). auto falls through to jnp;
+                    # an explicit pallas request gets a clear error
+                    # instead of an opaque lowering failure.
+                    if backend == "pallas":
+                        raise ValueError(
+                            "backend='pallas' under a spatial/data mesh "
+                            f"({SPATIAL_AXIS}={n_sp}, {DATA_AXIS}="
+                            f"{n_dt}) needs feature rows "
+                            f"({fmap1.shape[1]}) divisible by the "
+                            "spatial axis and batch "
+                            f"({fmap1.shape[0]}) by the data axis; "
+                            "use backend='auto'/'jnp' or adjust the "
+                            "mesh")
+                    use_pallas = False
+                else:
+                    return _sharded_fused_lookup(
+                        fmap1, tuple(pyramid2), coords, mesh, radius,
+                        scale, mxu_dtype, rescale, out_dtype)
+    if use_pallas:
         # out_dtype emitted from inside the kernel — bit-identical to a
         # post-hoc astype, but skips the convert+copy XLA would place at
         # the custom-call boundary (~2% of the b64 headline step).
@@ -313,7 +337,9 @@ def _sharded_fused_lookup(fmap1, pyramid2, coords, mesh, radius, scale,
 
 def alternate_eval_eligible(cfg, image_hw,
                             differentiable: bool = False,
-                            spatial_shards: int = 1) -> bool:
+                            spatial_shards: int = 1,
+                            batch: int = None,
+                            data_shards: int = 1) -> bool:
     """Whether the fused on-demand kernel admits a canonical-RAFT run at
     this padded image size (stride-8 features, ``cfg.corr_levels`` pooled
     levels, bf16 features under the mixed-precision policy). Used by the
@@ -328,11 +354,23 @@ def alternate_eval_eligible(cfg, image_hw,
     (``_sharded_fused_lookup``) additionally needs the feature rows
     divisible by the spatial axis so shard_map can split the query
     slab evenly; the VMEM envelope itself is unchanged (each shard
-    stages the full pooled target levels)."""
+    stages the full pooled target levels).
+
+    ``batch``/``data_shards``: the same divisibility story on the data
+    axis — shard_map splits the batch over ``data_shards``, so a batch
+    that doesn't divide makes the sharded composition unavailable and
+    the dispatch must not pick the kernel (the custom call can't lower
+    unsharded under an active mesh). Folded in here so
+    ``corr_impl="auto"`` predicts exactly what the runtime dispatch in
+    :func:`windowed_correlation_pyramid` will accept (ADVICE round 5).
+    ``batch=None`` (unknown at choice time) skips the check."""
     from raft_tpu.ops.corr_pallas import fused_eligible
     h, w = image_hw
     h8, w8 = h // 8, w // 8
     if spatial_shards > 1 and h8 % spatial_shards:
+        return False
+    if (batch is not None and data_shards > 1
+            and batch % data_shards):
         return False
     shapes = []
     for _ in range(cfg.corr_levels):

@@ -1,0 +1,41 @@
+"""Latency/throughput-focused inference serving for RAFT.
+
+The ROADMAP north star serves heavy traffic from millions of users;
+traffic like that arrives as single frame pairs, and BENCH_r05 puts the
+cost of serving them one at a time at ~3x (31.5 pairs/s at batch 1 vs
+99.0 at batch 128 per chip). This package closes that batch-1 gap at the
+queue level, reusing :class:`raft_tpu.evaluate.FlowPredictor` for the
+forward itself:
+
+* :mod:`~raft_tpu.serving.batcher` — thread-safe shape-bucketed dynamic
+  batcher (close on max-size or deadline, backlog cap).
+* :mod:`~raft_tpu.serving.engine` — warmup (per-bucket pre-compile +
+  persistent XLA cache), pipelined async dispatch with donated input
+  buffers, the ``submit() -> Future`` client API.
+* :mod:`~raft_tpu.serving.metrics` — p50/p95/p99 latency, batch-size
+  histogram, queue depth, throughput, XLA compile-count probe.
+* :mod:`~raft_tpu.serving.loadgen` — CPU-runnable concurrent load
+  generator with bit-exact response checking (drives ``bench.py
+  serving`` and ``scripts/serve_drill.py``).
+"""
+
+from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
+                                      ShapeBucketBatcher)
+from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
+                                     enable_persistent_compile_cache,
+                                     make_engine)
+from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
+                                      xla_compile_count)
+
+__all__ = [
+    "BacklogFull",
+    "CompileWatch",
+    "QueuedRequest",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingMetrics",
+    "ShapeBucketBatcher",
+    "enable_persistent_compile_cache",
+    "make_engine",
+    "xla_compile_count",
+]

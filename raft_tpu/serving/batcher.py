@@ -1,0 +1,177 @@
+"""Thread-safe request queue + shape-bucketed dynamic batcher.
+
+Single requests arrive one at a time (the ROADMAP's serving traffic —
+millions of users send frames, not pre-formed batches), but the chip
+earns its throughput at large batch (BENCH_r05: 31.5 pairs/s at batch 1
+vs 99.0 at batch 128). The batcher closes that gap at the queue level:
+
+* **Shape buckets.** XLA executables are shape-specialized, so requests
+  are grouped by their :class:`~raft_tpu.utils.padder.InputPadder`
+  *padded* shape — the same bucketing batched eval uses
+  (``evaluate._predict_dataset``). Distinct raw resolutions that pad to
+  the same /8 shape (e.g. Sintel 436x1024 and an already-padded
+  440x1024) share one bucket and one executable.
+* **Close on max-size or deadline.** A bucket dispatches the moment it
+  holds ``max_batch`` requests; otherwise the oldest waiting request's
+  ``max_wait`` deadline closes its bucket with whatever has arrived
+  (the classic dynamic-batching latency/throughput dial).
+* **FIFO within a bucket**, oldest-deadline-first across buckets.
+
+The batcher owns no JAX state — it moves :class:`QueuedRequest` records
+between client threads and the engine's dispatcher thread. Padding
+happens in the *client* thread at submit time (see
+``ServingEngine.submit``) so host-side pad work rides the request
+producers, not the single dispatch loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class QueuedRequest:
+    """One in-flight request: padded inputs + the padder to undo it,
+    submit timestamp (latency accounting + deadline), and the future the
+    client is waiting on."""
+
+    __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
+                 "future")
+
+    def __init__(self, image1, image2, padder, bucket: Tuple[int, int],
+                 t_submit: float):
+        self.image1 = image1
+        self.image2 = image2
+        self.padder = padder
+        self.bucket = bucket
+        self.t_submit = t_submit
+        self.future: Future = Future()
+
+
+class ShapeBucketBatcher:
+    """The queue between client threads and the dispatch loop.
+
+    Args:
+      max_batch: bucket dispatch size (and the executable's batch dim —
+        partial batches are tail-padded up to it by the engine).
+      max_wait_s: deadline for a non-full bucket, measured from its
+        oldest request's submit time. ``0`` degenerates to
+        batch-as-available (every poll drains whatever is queued).
+      max_pending: backlog cap across all buckets; ``enqueue`` beyond it
+        raises :class:`BacklogFull` (load shedding beats unbounded
+        memory growth and unbounded tail latency).
+      clock: injectable monotonic clock (tests).
+    """
+
+    def __init__(self, max_batch: int = 8, max_wait_s: float = 0.005,
+                 max_pending: int = 2048,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.max_pending = max_pending
+        self._clock = clock
+        self._cond = threading.Condition()
+        # bucket key -> FIFO of QueuedRequest. OrderedDict so iteration
+        # order is stable (deterministic tests).
+        self._buckets: "OrderedDict[Tuple[int, int], deque]" = OrderedDict()
+        self._pending = 0
+        self._closed = False
+
+    # -- client side ----------------------------------------------------
+
+    def enqueue(self, req: QueuedRequest) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed to new requests")
+            if self._pending >= self.max_pending:
+                raise BacklogFull(
+                    f"serving backlog full ({self._pending} pending >= "
+                    f"max_pending={self.max_pending})")
+            self._buckets.setdefault(req.bucket, deque()).append(req)
+            self._pending += 1
+            self._cond.notify_all()
+
+    def pending(self) -> int:
+        with self._cond:
+            return self._pending
+
+    def bucket_keys(self) -> List[Tuple[int, int]]:
+        with self._cond:
+            return list(self._buckets.keys())
+
+    def close(self) -> None:
+        """Stop accepting requests; ``next_batch`` drains what is queued
+        (immediately — no more arrivals can fill a bucket, so waiting
+        out deadlines would only add latency) and then returns None."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- dispatcher side ------------------------------------------------
+
+    def _pop_from(self, key) -> List[QueuedRequest]:
+        q = self._buckets[key]
+        batch = [q.popleft() for _ in range(min(len(q), self.max_batch))]
+        if not q:
+            del self._buckets[key]
+        self._pending -= len(batch)
+        return batch
+
+    def _full_bucket(self) -> Optional[Tuple[int, int]]:
+        for key, q in self._buckets.items():
+            if len(q) >= self.max_batch:
+                return key
+        return None
+
+    def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
+        oldest_key, oldest_t = None, None
+        for key, q in self._buckets.items():
+            t = q[0].t_submit
+            if oldest_t is None or t < oldest_t:
+                oldest_key, oldest_t = key, t
+        return oldest_key
+
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> Optional[List[QueuedRequest]]:
+        """Block until a batch closes. Returns the batch; ``[]`` when
+        ``timeout`` elapsed with nothing ready (poll again); ``None``
+        when the batcher is closed and fully drained (dispatcher should
+        exit)."""
+        poll_deadline = (None if timeout is None
+                         else self._clock() + timeout)
+        with self._cond:
+            while True:
+                key = self._full_bucket()
+                if key is not None:
+                    return self._pop_from(key)
+                if self._closed:
+                    oldest = self._oldest_bucket()
+                    if oldest is None:
+                        return None
+                    return self._pop_from(oldest)
+                now = self._clock()
+                wait = None
+                oldest = self._oldest_bucket()
+                if oldest is not None:
+                    deadline = (self._buckets[oldest][0].t_submit
+                                + self.max_wait_s)
+                    if deadline <= now:
+                        return self._pop_from(oldest)
+                    wait = deadline - now
+                if poll_deadline is not None:
+                    if poll_deadline <= now:
+                        return []
+                    wait = (poll_deadline - now if wait is None
+                            else min(wait, poll_deadline - now))
+                self._cond.wait(wait)
+
+
+class BacklogFull(RuntimeError):
+    """Raised by ``enqueue`` when the pending-request cap is hit."""

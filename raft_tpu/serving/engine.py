@@ -1,0 +1,349 @@
+"""The serving engine: warmup, pipelined dispatch, request lifecycle.
+
+Closes the batch-1 gap (BENCH_r05: 31.5 pairs/s at batch 1 vs 99.0 at
+batch 128 per chip) for streams of independent requests by putting three
+mechanisms behind one ``submit() -> Future`` API:
+
+* **Dynamic batching** — client threads pad (InputPadder, client-side so
+  pad work rides the producers) and enqueue into the shape-bucketed
+  :class:`~raft_tpu.serving.batcher.ShapeBucketBatcher`; batches close
+  on max-size or deadline, and partial batches are tail-padded by
+  repeating the last request (the batched-eval trick: one executable
+  per bucket, never per partial size).
+* **Pipelined dispatch** — a dispatcher thread stacks and *dispatches*
+  batch N+1 while the device still computes batch N (`jax.Array`
+  dispatch is non-blocking; only the completion thread syncs, via
+  ``np.asarray``). A bounded in-flight queue (``pipeline_depth``)
+  provides backpressure so a slow device can't queue unbounded work.
+  With ``donate`` (default on TPU) the input image buffers are donated
+  to the executable, so steady-state serving holds one batch of inputs,
+  not one per pipeline slot.
+* **Warmup + persistent compile cache** — ``warmup()`` pre-compiles the
+  executable for every configured bucket (counted by the
+  :class:`~raft_tpu.serving.metrics.CompileWatch` probe), and
+  :func:`enable_persistent_compile_cache` points XLA's on-disk cache at
+  the repo's ``.jax_cache/`` (the same wiring bench.py uses) so a
+  serving process restart pays seconds, not minutes, before its first
+  request.
+
+The engine *reuses* :class:`raft_tpu.evaluate.FlowPredictor` — including
+its ``corr_impl="auto"`` per-shape engine choice and its compiled-
+executable cache — rather than duplicating the forward; the serve path
+adds only queueing, stacking and unpadding around
+``FlowPredictor.dispatch_batch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
+                                      ShapeBucketBatcher)
+from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
+                                      xla_compile_count)
+from raft_tpu.utils.padder import InputPadder
+from raft_tpu.utils.profiling import HostStageTimer
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
+    """Point XLA's persistent compilation cache at ``cache_dir``.
+
+    Defaults to ``$JAX_COMPILATION_CACHE_DIR`` or the repo's
+    ``.jax_cache/`` (bench.py's location, so serving and bench share
+    warm entries). Min-compile-time/entry-size floors drop to zero so
+    every bucket executable is cached. Call before the first compile to
+    benefit the current process; later calls still help restarts.
+    Returns the directory used."""
+    import jax
+
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.join(_REPO_ROOT, ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for one :class:`ServingEngine`.
+
+    Attributes:
+      max_batch: executable batch size per bucket; batches close at this
+        many requests and partial batches are tail-padded up to it.
+      max_wait_ms: deadline for a non-full bucket, from its oldest
+        request's submit. The latency/throughput dial: 0 serves
+        whatever queued (lowest latency), larger values fill batches.
+      buckets: raw image ``(H, W)`` shapes to pre-compile at warmup
+        (padded internally — pass what requests will carry, e.g.
+        ``(436, 1024)`` for Sintel). Requests outside the configured
+        buckets still serve, paying their compile on first contact
+        (counted in ``metrics.compiles``).
+      pad_mode: InputPadder mode for every request ("sintel" centers
+        vertical padding, "kitti" bottom-pads).
+      factor: pad-to multiple (8 for stride-8 RAFT features).
+      max_pending: backlog cap; submits beyond it raise
+        :class:`~raft_tpu.serving.batcher.BacklogFull`.
+      pipeline_depth: dispatched-but-unsynced batches allowed in flight
+        (2 = classic double buffering: host stacks N+1 while device
+        runs N).
+      donate: donate input image buffers to the executable. ``None``
+        resolves to True on TPU, False elsewhere (CPU/older backends
+        warn and ignore donation).
+      persistent_cache: falsy → leave XLA's cache config alone; True →
+        wire the default location; a string → wire that directory.
+    """
+
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    buckets: Tuple[Tuple[int, int], ...] = ()
+    pad_mode: str = "sintel"
+    factor: int = 8
+    max_pending: int = 2048
+    pipeline_depth: int = 2
+    donate: Optional[bool] = None
+    persistent_cache: object = None
+
+
+class ServingEngine:
+    """Latency/throughput-focused request front-end over a
+    :class:`~raft_tpu.evaluate.FlowPredictor`.
+
+    Lifecycle::
+
+        predictor = load_predictor(ckpt, ...)          # evaluate.py
+        engine = ServingEngine(predictor, ServingConfig(
+            max_batch=32, max_wait_ms=5.0, buckets=((436, 1024),)))
+        engine.start()                                  # warms buckets
+        fut = engine.submit(image1, image2)             # thread-safe
+        flow = fut.result()                             # (H, W, 2) numpy
+        engine.close()                                  # drains in-flight
+
+    Futures resolve to the *unpadded* full-resolution flow, bit-identical
+    to ``padder.unpad(predictor(padded1, padded2)[1])`` for the same
+    inputs (tail-padded batch slots don't perturb real samples —
+    per-sample batch independence, pinned by tests/test_serving.py).
+    """
+
+    def __init__(self, predictor, config: Optional[ServingConfig] = None):
+        import jax
+
+        self.predictor = predictor
+        self.config = config or ServingConfig()
+        if self.config.persistent_cache:
+            cache = self.config.persistent_cache
+            enable_persistent_compile_cache(
+                cache if isinstance(cache, str) else None)
+        donate = self.config.donate
+        if donate is None:
+            donate = jax.default_backend() == "tpu"
+        predictor.donate_images = donate
+        self.metrics = ServingMetrics()
+        self.stages = HostStageTimer()
+        self.batcher = ShapeBucketBatcher(
+            max_batch=self.config.max_batch,
+            max_wait_s=self.config.max_wait_ms / 1e3,
+            max_pending=self.config.max_pending)
+        self._inflight: queue.Queue = queue.Queue(
+            maxsize=max(self.config.pipeline_depth, 1))
+        self._dispatcher: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
+        self._started = False
+        self._closed = False
+        self._fatal: Optional[BaseException] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if self._started:
+            raise RuntimeError("engine already started")
+        if warmup and self.config.buckets:
+            self.warmup()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serving-dispatch",
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._completion_loop, name="serving-complete",
+            daemon=True)
+        self._started = True
+        self._dispatcher.start()
+        self._completer.start()
+        return self
+
+    def warmup(self) -> Dict[Tuple[int, int], Dict[str, float]]:
+        """Pre-compile the (max_batch, padded H, padded W) executable for
+        every configured bucket through the exact serve-path code
+        (``dispatch_batch`` → ``FlowPredictor._fn`` cache). After this,
+        no request whose padded shape lands in a configured bucket
+        triggers a fresh XLA compile. Returns per-bucket
+        ``{"compiles": n, "seconds": s}`` stats."""
+        stats: Dict[Tuple[int, int], Dict[str, float]] = {}
+        for raw_hw in self.config.buckets:
+            padder = InputPadder((*raw_hw, 3), mode=self.config.pad_mode,
+                                 factor=self.config.factor)
+            ph, pw = padder.padded_shape
+            # Two distinct host arrays: with donation on, aliasing one
+            # device buffer into both donated args would be rejected.
+            z1 = np.zeros((self.config.max_batch, ph, pw, 3), np.float32)
+            z2 = np.zeros_like(z1)
+            t0 = time.perf_counter()
+            with CompileWatch() as w:
+                out = self.predictor.dispatch_batch(z1, z2)
+                np.asarray(out[1])            # sync: compile + one run
+            stats[(ph, pw)] = {"compiles": float(w.compiles),
+                               "seconds": time.perf_counter() - t0}
+        return stats
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting requests, drain every queued/in-flight request
+        to its future, join the worker threads."""
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close()
+        if self._started:
+            self._dispatcher.join(timeout)
+            self._completer.join(timeout)
+
+    def __enter__(self) -> "ServingEngine":
+        if not self._started:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- client API -----------------------------------------------------
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
+        ``image1``/``image2``: (H, W, 3) float arrays in [0, 255], any
+        resolution (padded here, in the caller's thread). Thread-safe.
+        """
+        if not self._started:
+            raise RuntimeError("engine not started (call start())")
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self._fatal is not None:
+            raise RuntimeError(
+                "serving engine hit a fatal dispatch error") \
+                from self._fatal
+        if image1.shape != image2.shape:
+            raise ValueError(f"frame shapes differ: {image1.shape} vs "
+                             f"{image2.shape}")
+        with self.stages.stage("pad"):
+            padder = InputPadder(image1.shape, mode=self.config.pad_mode,
+                                 factor=self.config.factor)
+            im1, im2 = padder.pad(image1, image2)
+        req = QueuedRequest(im1, im2, padder, bucket=padder.padded_shape,
+                            t_submit=time.monotonic())
+        try:
+            self.batcher.enqueue(req)
+        except (BacklogFull, RuntimeError):
+            self.metrics.record_reject()
+            raise
+        self.metrics.record_submit(self.batcher.pending())
+        return req.future
+
+    def predict(self, image1: np.ndarray, image2: np.ndarray,
+                timeout: Optional[float] = 120.0) -> np.ndarray:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(image1, image2).result(timeout)
+
+    # -- worker threads -------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                batch = self.batcher.next_batch(timeout=0.1)
+                if batch is None:
+                    break
+                if not batch:
+                    continue
+                self._dispatch_one(batch)
+        except BaseException as e:  # fatal: fail fast, not silently
+            self._fatal = e
+            self.batcher.close()
+            while True:
+                left = self.batcher.next_batch(timeout=0)
+                if not left:
+                    break
+                for r in left:
+                    r.future.set_exception(e)
+                self.metrics.record_error(len(left))
+        finally:
+            self._inflight.put(None)
+
+    def _dispatch_one(self, batch: List[QueuedRequest]) -> None:
+        n = len(batch)
+        with self.stages.stage("stack"):
+            i1 = np.stack([r.image1 for r in batch])
+            i2 = np.stack([r.image2 for r in batch])
+            if n < self.config.max_batch:
+                reps = self.config.max_batch - n
+                # Tail-pad by repeating the last request — same rule as
+                # batched eval; one executable per bucket, never one per
+                # partial size.
+                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
+                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
+        c0 = xla_compile_count()
+        try:
+            with self.stages.stage("dispatch"):
+                # Non-blocking: device_put + async dispatch. The device
+                # computes while this thread loops back to stack the
+                # next batch.
+                out = self.predictor.dispatch_batch(i1, i2)
+        except Exception as e:
+            for r in batch:
+                r.future.set_exception(e)
+            self.metrics.record_error(n)
+            return
+        self.metrics.record_batch(n, self.config.max_batch,
+                                  compiles=xla_compile_count() - c0)
+        # Bounded queue: blocks when pipeline_depth batches are already
+        # in flight — backpressure instead of unbounded device queueing.
+        self._inflight.put((batch, out))
+
+    def _completion_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                break
+            batch, out = item
+            try:
+                with self.stages.stage("sync"):
+                    flow_up = np.asarray(out[1])   # blocks until done
+            except Exception as e:
+                for r in batch:
+                    r.future.set_exception(e)
+                self.metrics.record_error(len(batch))
+                continue
+            now = time.monotonic()
+            with self.stages.stage("unpad"):
+                for j, r in enumerate(batch):
+                    r.future.set_result(r.padder.unpad(flow_up[j]))
+                    self.metrics.record_done(now - r.t_submit)
+
+
+def make_engine(model_path: str, serving: Optional[ServingConfig] = None,
+                **predictor_kw) -> ServingEngine:
+    """One-call constructor: ``load_predictor`` (torch ``.pth``, orbax
+    dir, fixture ``.npz`` or ``"random"``) + engine. ``predictor_kw``
+    forwards to :func:`raft_tpu.evaluate.load_predictor` (``small``,
+    ``iters``, ``corr_impl``, ...)."""
+    from raft_tpu.evaluate import load_predictor
+
+    predictor = load_predictor(model_path, **predictor_kw)
+    return ServingEngine(predictor, serving)

@@ -1,0 +1,231 @@
+"""Serving observability: latency percentiles, batch shape accounting,
+queue depth, throughput, and an XLA compile-count probe.
+
+The serving engine's contract ("after warmup no request triggers a fresh
+compile", "the batcher recovers large-batch efficiency") is only
+checkable if the numbers are first-class, so this module keeps them all
+in one thread-safe place:
+
+* :func:`xla_compile_count` / :class:`CompileWatch` — a process-wide
+  backend-compile counter fed by ``jax.monitoring``'s
+  ``/jax/core/compile/backend_compile_duration`` event stream (cache
+  *hits*, persistent or in-memory, don't emit it). The warmup routine
+  uses it to prove the configured buckets compiled, tests use it to
+  prove post-warmup requests didn't.
+* :class:`ServingMetrics` — request/response counters, a rolling
+  latency window (p50/p95/p99), the batch-size histogram (how well the
+  dynamic batcher is filling batches), padded-slot waste, queue-depth
+  peak, and wall-clock throughput. ``snapshot()`` returns a flat dict
+  of floats shaped for :meth:`raft_tpu.utils.logger.TrainLogger
+  .write_dict`, so serving metrics stream to the same JSONL/TensorBoard
+  sinks as training scalars.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Dict, Optional
+
+# -- XLA compile-count probe -------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_lock = threading.Lock()
+_compile_count = 0
+_listener_on = False
+
+
+def _on_duration_event(event: str, duration: float, **kwargs) -> None:
+    global _compile_count
+    if event == _COMPILE_EVENT:
+        with _compile_lock:
+            _compile_count += 1
+
+
+def _ensure_listener() -> None:
+    """Register the monitoring listener once per process (lazily — the
+    counter only measures deltas, so compiles before the first call to
+    :func:`xla_compile_count` are irrelevant)."""
+    global _listener_on
+    with _compile_lock:
+        if _listener_on:
+            return
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_duration_event)
+        _listener_on = True
+
+
+def xla_compile_count() -> int:
+    """Process-wide count of fresh XLA backend compiles observed since
+    the probe was first armed. Use deltas, not absolute values."""
+    _ensure_listener()
+    with _compile_lock:
+        return _compile_count
+
+
+class CompileWatch:
+    """``with CompileWatch() as w: ...; w.compiles`` — fresh XLA backend
+    compiles triggered inside the block (0 on cache hits, persistent
+    cache included)."""
+
+    def __enter__(self) -> "CompileWatch":
+        self._c0 = xla_compile_count()
+        self.compiles: Optional[int] = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.compiles = xla_compile_count() - self._c0
+
+    @property
+    def so_far(self) -> int:
+        return xla_compile_count() - self._c0
+
+
+# -- percentiles --------------------------------------------------------
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Linear-interpolation percentile over an already-sorted list
+    (numpy-free so the hot path never materializes arrays)."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = (len(sorted_vals) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac)
+
+
+class ServingMetrics:
+    """Thread-safe counters for one :class:`~raft_tpu.serving.engine
+    .ServingEngine`.
+
+    Latencies are request submit → result-set wall times over a rolling
+    window (default 10k — p99 over a bounded recent window, not the
+    run's full history). The batch-size histogram counts *real* request
+    counts per dispatched batch; ``padded_slots`` accumulates the
+    tail-padding waste (slots computed but thrown away), so
+    ``padded_slots / (sum(hist k*v) + padded_slots)`` is the compute
+    overhead the deadline policy is paying for latency.
+    """
+
+    def __init__(self, latency_window: int = 10000):
+        self._lock = threading.Lock()
+        self._lat: deque = deque(maxlen=latency_window)
+        self.batch_hist: Counter = Counter()
+        self.requests = 0          # accepted submits
+        self.rejected = 0          # backlog-full / closed rejections
+        self.responses = 0         # futures resolved with a result
+        self.errors = 0            # futures resolved with an exception
+        self.batches = 0
+        self.padded_slots = 0
+        self.compiles = 0          # fresh XLA compiles on the serve path
+        self.queue_depth_peak = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- recording (engine-internal) -----------------------------------
+
+    def record_submit(self, queue_depth: int) -> None:
+        with self._lock:
+            self.requests += 1
+            if self._t_first is None:
+                self._t_first = time.perf_counter()
+            if queue_depth > self.queue_depth_peak:
+                self.queue_depth_peak = queue_depth
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_batch(self, size: int, padded_to: int,
+                     compiles: int = 0) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_hist[size] += 1
+            self.padded_slots += max(padded_to - size, 0)
+            self.compiles += compiles
+
+    def record_done(self, latency_s: float) -> None:
+        with self._lock:
+            self.responses += 1
+            self._lat.append(latency_s)
+            self._t_last = time.perf_counter()
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+            self._t_last = time.perf_counter()
+
+    # -- reading --------------------------------------------------------
+
+    def latency_ms(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._lat)
+        return {"p50": _percentile(vals, 50) * 1e3,
+                "p95": _percentile(vals, 95) * 1e3,
+                "p99": _percentile(vals, 99) * 1e3,
+                "mean": (sum(vals) / len(vals) * 1e3) if vals else 0.0}
+
+    def throughput(self) -> float:
+        """Completed responses per second of serving wall time (first
+        submit → last completion)."""
+        with self._lock:
+            if self._t_first is None or self._t_last is None:
+                return 0.0
+            dt = self._t_last - self._t_first
+            return self.responses / dt if dt > 0 else 0.0
+
+    def mean_batch_size(self) -> float:
+        with self._lock:
+            total = sum(k * v for k, v in self.batch_hist.items())
+            n = sum(self.batch_hist.values())
+        return total / n if n else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float dict — the shape ``TrainLogger.write_dict`` (and
+        the bench JSON artifact) want."""
+        lat = self.latency_ms()
+        with self._lock:
+            out = {
+                "serving_requests": float(self.requests),
+                "serving_rejected": float(self.rejected),
+                "serving_responses": float(self.responses),
+                "serving_errors": float(self.errors),
+                "serving_batches": float(self.batches),
+                "serving_padded_slots": float(self.padded_slots),
+                "serving_compiles": float(self.compiles),
+                "serving_queue_depth_peak": float(self.queue_depth_peak),
+            }
+        out["serving_latency_p50_ms"] = lat["p50"]
+        out["serving_latency_p95_ms"] = lat["p95"]
+        out["serving_latency_p99_ms"] = lat["p99"]
+        out["serving_latency_mean_ms"] = lat["mean"]
+        out["serving_throughput_rps"] = self.throughput()
+        out["serving_mean_batch_size"] = self.mean_batch_size()
+        return out
+
+    def batch_histogram(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self.batch_hist)
+
+    def write_to(self, train_logger, step: Optional[int] = None) -> None:
+        """Stream the snapshot through the existing scalar sinks
+        (``scalars.jsonl`` + TensorBoard)."""
+        train_logger.write_dict(self.snapshot(), step=step)
+
+    def report(self) -> str:
+        lat = self.latency_ms()
+        hist = ", ".join(f"{k}:{v}" for k, v in
+                         sorted(self.batch_histogram().items()))
+        return (f"requests {self.requests} (rejected {self.rejected}) "
+                f"responses {self.responses} errors {self.errors} | "
+                f"{self.throughput():.2f} req/s, mean batch "
+                f"{self.mean_batch_size():.2f} | latency ms p50 "
+                f"{lat['p50']:.1f} p95 {lat['p95']:.1f} p99 "
+                f"{lat['p99']:.1f} | batch hist {{{hist}}} | padded "
+                f"slots {self.padded_slots}, compiles {self.compiles}, "
+                f"queue peak {self.queue_depth_peak}")

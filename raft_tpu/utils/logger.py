@@ -243,7 +243,14 @@ class TrainLogger:
         for i, panel in enumerate(panels):
             name = f"{phase}_Image_{i + 1:02d}"
             if self._tb is not None:
-                self._tb.add_image(name, panel, step, dataformats="HWC")
+                try:
+                    self._tb.add_image(name, panel, step,
+                                       dataformats="HWC")
+                except Exception as e:   # TB image sink is best-effort
+                    # EventWriter.add_image needs Pillow for the PNG
+                    # encode; a Pillow-free host should skip TB images,
+                    # not die mid-training (the scalar sinks still run).
+                    print(f"WARNING: TensorBoard image write failed: {e}")
             try:
                 from PIL import Image
                 Image.fromarray(panel).save(

@@ -11,6 +11,10 @@ actionable without TensorBoard:
   directly (the tensorboard-plugin converter stack is not required) and
   aggregate per-HLO-op self times from the device's "XLA Ops" timeline.
 * :func:`print_breakdown` — the top-N table, normalized per step.
+* :class:`HostStageTimer` — accumulated *host-side* wall time per named
+  pipeline stage (pad / stack / dispatch / sync), for code whose cost
+  the device tracer can't see. The serving engine threads one through
+  its dispatch loop; a loader or eval loop can do the same.
 
 Typical use::
 
@@ -35,6 +39,51 @@ import os
 import os.path as osp
 import time
 from typing import Dict, List, Optional, Tuple
+
+
+class HostStageTimer:
+    """Thread-safe accumulator of host-side wall time per named stage.
+
+    ``with timer.stage("pad"): ...`` around each host-pipeline section;
+    :meth:`summary` returns ``{stage: {total_ms, count, mean_ms}}`` and
+    :meth:`report` a one-line table. Stages may be entered concurrently
+    from several threads (client threads pad while the dispatcher
+    stacks) — times are summed, so on overlapping threads the totals
+    measure *work*, not wall clock.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._total_s: Dict[str, float] = collections.defaultdict(float)
+        self._count: Dict[str, int] = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._total_s[name] += dt
+                self._count[name] += 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {"total_ms": tot * 1e3,
+                       "count": float(self._count[name]),
+                       "mean_ms": tot * 1e3 / max(self._count[name], 1)}
+                for name, tot in self._total_s.items()}
+
+    def report(self) -> str:
+        rows = sorted(self.summary().items(),
+                      key=lambda kv: -kv[1]["total_ms"])
+        return " | ".join(
+            f"{name}: {v['total_ms']:.1f}ms/{int(v['count'])} "
+            f"({v['mean_ms']:.2f}ms avg)" for name, v in rows) or "(empty)"
 
 
 class _Trace:

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""CPU smoke drill for the serving engine (CI gate, runs in minutes).
+
+Warms two buckets, fires 50 concurrent requests through
+:class:`raft_tpu.serving.engine.ServingEngine`, and exits nonzero on
+ANY dropped or incorrect response. Correctness is bit-exact: every
+served flow must equal the direct ``FlowPredictor`` output for the same
+pair — on this script's single-process default topology the batch-1
+``__call__`` path and the batched serve path are bit-identical (the
+acceptance criterion's wording); under a forced multi-device topology
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``) the check
+automatically uses the same-executable batched reference instead, which
+is exact on any topology (see loadgen docstring).
+
+Also asserts the warmup contract — after the two buckets pre-compile,
+the 50 requests trigger ZERO fresh XLA compiles — and prints a one-line
+summary plus the engine's metrics report.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/serve_drill.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REQUESTS = 50
+CONCURRENCY = 8
+# Two raw shapes per bucket: (36,60) and (33,57) share the (40,64)
+# bucket; (52,76) pads to (56,80) — two buckets total, three raw shapes.
+SHAPES = [(36, 60), (33, 57), (52, 76)]
+BUCKETS = ((36, 60), (52, 76))
+
+
+def main() -> int:
+    import jax
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine, loadgen)
+
+    predictor = load_predictor("random", small=True, iters=2)
+    frames = loadgen.make_frames(SHAPES, per_shape=2, seed=11)
+    if jax.device_count() == 1:
+        refs = loadgen.reference_flows(predictor, frames)
+        ref_kind = "direct __call__ (batch-1, bit-exact single-device)"
+    else:
+        refs = loadgen.batched_reference_flows(predictor, frames,
+                                               max_batch=4)
+        ref_kind = (f"same-executable batched ({jax.device_count()} "
+                    "devices: cross-executable float order differs)")
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=BUCKETS))
+    warm = engine.warmup()
+    engine.start(warmup=False)
+    try:
+        with CompileWatch() as watch:
+            res = loadgen.run_load(engine, frames, n_requests=N_REQUESTS,
+                                   concurrency=CONCURRENCY,
+                                   references=refs)
+    finally:
+        engine.close()
+
+    failures = []
+    if res["completed"] != N_REQUESTS:
+        failures.append(f"completed {res['completed']}/{N_REQUESTS}")
+    if res["dropped"]:
+        failures.append(f"dropped requests: {res['dropped']}")
+    if res["mismatched"]:
+        failures.append(f"incorrect responses: {res['mismatched']}")
+    if len(warm) != len(BUCKETS):
+        failures.append(f"warmup covered {len(warm)} of "
+                        f"{len(BUCKETS)} buckets")
+    if watch.compiles:
+        failures.append(f"{watch.compiles} fresh XLA compile(s) after "
+                        "warmup (warmup contract broken)")
+
+    print(f"serve_drill: {res['completed']}/{N_REQUESTS} responses, "
+          f"{res['throughput_rps']:.1f} req/s at concurrency "
+          f"{CONCURRENCY}; reference = {ref_kind}")
+    warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
+                          for k, v in warm.items())
+    print(f"warmup: {{bucket: compiles}} = {{{warm_desc}}}")
+    print("metrics:", engine.metrics.report())
+    print("host stages:", engine.stages.report())
+    if failures:
+        for f in failures:
+            print("FAIL:", f)
+        return 1
+    print("PASS: all responses bit-exact, no post-warmup compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

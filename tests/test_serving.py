@@ -1,0 +1,505 @@
+"""Serving-engine suite: bucket routing, batch closure policy,
+bit-exact served outputs, warmup compile accounting, metrics, shutdown
+semantics — plus regression pins for the round-5 ADVICE fixes that rode
+along (logger TB-image guard, corr data-axis eligibility fold,
+ProcessDataLoader pool reuse + timed drains).
+
+All CPU-deterministic and `not slow`-eligible: the model is the random-
+weights RAFT-small at iters=2 over tiny frames, and batched CPU
+execution is bit-identical per sample to batch-1 (pinned here — it is
+what lets the equality tests assert exact, not approximate)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
+                                      ShapeBucketBatcher)
+from raft_tpu.serving.metrics import ServingMetrics, _percentile
+
+
+def _req(bucket=(40, 64), t=0.0):
+    return QueuedRequest(None, None, None, bucket=bucket, t_submit=t)
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestBatcher:
+    def test_full_bucket_closes_immediately(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=3, max_wait_s=100.0, clock=clock)
+        for _ in range(3):
+            b.enqueue(_req(t=clock.t))
+        batch = b.next_batch(timeout=0)
+        assert len(batch) == 3
+        assert b.pending() == 0
+
+    def test_deadline_closes_partial_batch(self):
+        clock = _FakeClock(10.0)
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=1.0, clock=clock)
+        b.enqueue(_req(t=10.0))
+        b.enqueue(_req(t=10.2))
+        assert b.next_batch(timeout=0) == []       # deadline not reached
+        clock.t = 11.0                             # oldest hits 1.0s wait
+        batch = b.next_batch(timeout=0)
+        assert len(batch) == 2
+
+    def test_bucket_routing_is_shape_homogeneous(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=2, max_wait_s=100.0, clock=clock)
+        for bucket in ((40, 64), (56, 80), (40, 64), (56, 80)):
+            b.enqueue(_req(bucket=bucket, t=clock.t))
+        first = b.next_batch(timeout=0)
+        second = b.next_batch(timeout=0)
+        assert len(first) == len(second) == 2
+        for batch in (first, second):
+            assert len({r.bucket for r in batch}) == 1
+        assert {first[0].bucket, second[0].bucket} == {(40, 64), (56, 80)}
+
+    def test_oldest_deadline_first_across_buckets(self):
+        clock = _FakeClock(0.0)
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=1.0, clock=clock)
+        b.enqueue(_req(bucket=(56, 80), t=0.5))    # younger
+        b.enqueue(_req(bucket=(40, 64), t=0.0))    # older
+        clock.t = 2.0                              # both past deadline
+        assert b.next_batch(timeout=0)[0].bucket == (40, 64)
+        assert b.next_batch(timeout=0)[0].bucket == (56, 80)
+
+    def test_backlog_cap(self):
+        b = ShapeBucketBatcher(max_batch=8, max_pending=2)
+        b.enqueue(_req())
+        b.enqueue(_req())
+        with pytest.raises(BacklogFull, match="backlog full"):
+            b.enqueue(_req())
+
+    def test_close_drains_then_none(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=100.0, clock=clock)
+        b.enqueue(_req(t=0.0))
+        b.close()
+        assert len(b.next_batch(timeout=0)) == 1   # no deadline wait
+        assert b.next_batch(timeout=0) is None
+        with pytest.raises(RuntimeError, match="closed"):
+            b.enqueue(_req())
+
+    def test_wakes_blocked_dispatcher_on_enqueue(self):
+        b = ShapeBucketBatcher(max_batch=1, max_wait_s=100.0)
+        got = []
+        th = threading.Thread(
+            target=lambda: got.append(b.next_batch(timeout=5)))
+        th.start()
+        time.sleep(0.05)
+        b.enqueue(_req(t=time.monotonic()))
+        th.join(timeout=5)
+        assert not th.is_alive() and len(got[0]) == 1
+
+
+class TestMetrics:
+    def test_percentile_interpolation(self):
+        vals = [1.0, 2.0, 3.0, 4.0]
+        assert _percentile(vals, 50) == pytest.approx(2.5)
+        assert _percentile(vals, 100) == pytest.approx(4.0)
+        assert _percentile([], 99) == 0.0
+        assert _percentile([7.0], 99) == 7.0
+
+    def test_counters_and_snapshot(self):
+        m = ServingMetrics()
+        m.record_submit(queue_depth=3)
+        m.record_submit(queue_depth=1)
+        m.record_batch(size=2, padded_to=4, compiles=1)
+        m.record_done(0.010)
+        m.record_done(0.030)
+        m.record_reject()
+        snap = m.snapshot()
+        assert snap["serving_requests"] == 2.0
+        assert snap["serving_rejected"] == 1.0
+        assert snap["serving_responses"] == 2.0
+        assert snap["serving_batches"] == 1.0
+        assert snap["serving_padded_slots"] == 2.0
+        assert snap["serving_compiles"] == 1.0
+        assert snap["serving_queue_depth_peak"] == 3.0
+        assert snap["serving_latency_p50_ms"] == pytest.approx(20.0)
+        assert m.batch_histogram() == {2: 1}
+        assert m.mean_batch_size() == 2.0
+        assert "p99" in m.report() or "requests" in m.report()
+
+    def test_snapshot_streams_through_train_logger(self, tmp_path):
+        import json
+
+        from raft_tpu.utils.logger import TrainLogger
+        m = ServingMetrics()
+        m.record_submit(queue_depth=1)
+        m.record_done(0.005)
+        logger = TrainLogger(log_dir=str(tmp_path))
+        m.write_to(logger, step=7)
+        logger.close()
+        lines = [json.loads(l) for l in
+                 open(os.path.join(str(tmp_path), "scalars.jsonl"))]
+        assert any("serving_latency_p50_ms" in l and l["step"] == 7
+                   for l in lines)
+
+
+# -- engine integration (real FlowPredictor, CPU) ----------------------
+
+# Two raw shapes that pad to the SAME /8 bucket (40, 64) — the bucket-
+# sharing case — kept tiny so RAFT-small at iters=2 stays fast on CPU.
+SHAPES = [(36, 60), (33, 57)]
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+@pytest.fixture(scope="module")
+def frames_and_refs(predictor):
+    """Frames + bit-exact references through the SAME (max_batch=4)
+    executable the engines below dispatch. (References via batch-1
+    ``__call__`` are a *different* executable, and this suite's 8
+    virtual CPU devices reorder float accumulation across executables —
+    see test_batch_composition_independence; the single-device drill
+    asserts the __call__ form of the criterion.)"""
+    from raft_tpu.serving import loadgen
+    frames = loadgen.make_frames(SHAPES, per_shape=2, seed=3)
+    return frames, loadgen.batched_reference_flows(predictor, frames,
+                                                   max_batch=4)
+
+
+def _engine(predictor, **kw):
+    from raft_tpu.serving import ServingConfig, ServingEngine
+    return ServingEngine(predictor, ServingConfig(**kw))
+
+
+class TestServingEngine:
+    def test_served_bit_equal_to_direct_call(self, predictor,
+                                             frames_and_refs):
+        from raft_tpu.serving import loadgen
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=3.0)
+        eng.start()
+        try:
+            res = loadgen.run_load(eng, frames, n_requests=24,
+                                   concurrency=8, references=refs)
+        finally:
+            eng.close()
+        assert res["completed"] == 24
+        assert res["dropped"] == []
+        # Bit-identical, not approximately equal: batching, tail-padding
+        # and pipelining must be invisible to the client.
+        assert res["mismatched"] == []
+        assert res["ok"]
+        # Everything routed through the one shared (40, 64) bucket.
+        assert all(k <= 4 for k in res["batch_histogram"])
+        assert sum(k * v for k, v in res["batch_histogram"].items()) == 24
+
+    def test_batch_composition_independence(self, predictor,
+                                            frames_and_refs):
+        """The property the bit-equality contract rests on: a sample's
+        batched result depends only on its own input — not its slot nor
+        the other batch entries (so tail-pad filler can't perturb real
+        samples). Also ties served values to the criterion's __call__
+        wording: across executables the match is allclose-tight (exact
+        on single-device hosts — asserted by scripts/serve_drill.py)."""
+        from raft_tpu.serving import loadgen
+        from raft_tpu.utils.padder import InputPadder
+        frames, refs = frames_and_refs
+        pads = []
+        for im1, im2 in frames[:3]:
+            p = InputPadder(im1.shape, mode="sintel")
+            pads.append(p.pad(im1, im2))
+        a, b, c = pads
+        _, u1 = predictor.predict_batch(
+            np.stack([a[0], b[0], c[0], a[0]]),
+            np.stack([a[1], b[1], c[1], a[1]]))
+        _, u2 = predictor.predict_batch(
+            np.stack([b[0], a[0], a[0], c[0]]),
+            np.stack([b[1], a[1], a[1], c[1]]))
+        np.testing.assert_array_equal(u1[0], u2[1])   # A: slot/comp swap
+        np.testing.assert_array_equal(u1[1], u2[0])   # B
+        np.testing.assert_array_equal(u1[2], u2[3])   # C
+        np.testing.assert_array_equal(u1[0], u1[3])   # within one batch
+        call_refs = loadgen.reference_flows(predictor, frames[:1])
+        np.testing.assert_allclose(refs[0], call_refs[0], atol=1e-4)
+
+    def test_metrics_after_load(self, predictor, frames_and_refs):
+        from raft_tpu.serving import loadgen
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=2.0)
+        eng.start()
+        try:
+            loadgen.run_load(eng, frames, n_requests=12, concurrency=4)
+        finally:
+            eng.close()
+        m = eng.metrics
+        assert m.requests == m.responses == 12
+        assert m.errors == 0 and m.rejected == 0
+        assert m.batches >= 3                      # 12 reqs, max_batch 4
+        assert 1.0 <= m.mean_batch_size() <= 4.0
+        lat = m.latency_ms()
+        assert lat["p50"] > 0 and lat["p99"] >= lat["p50"]
+        assert m.throughput() > 0
+        # Host-stage timer saw every pipeline stage.
+        stages = eng.stages.summary()
+        for name in ("pad", "stack", "dispatch", "sync", "unpad"):
+            assert stages[name]["count"] > 0
+
+    def test_clean_shutdown_resolves_inflight(self, predictor,
+                                              frames_and_refs):
+        frames, refs = frames_and_refs
+        # Long deadline: requests are still queued when close() lands,
+        # so the drain path (not the deadline path) must resolve them.
+        eng = _engine(predictor, max_batch=4, max_wait_ms=10_000.0)
+        eng.start()
+        futs = [eng.submit(*frames[i % len(frames)]) for i in range(6)]
+        eng.close(timeout=120)
+        for i, f in enumerate(futs):
+            flow = f.result(timeout=1)             # already resolved
+            assert np.array_equal(flow, refs[i % len(frames)])
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(*frames[0])
+
+    def test_backlog_rejection_counted(self, predictor, frames_and_refs):
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=8, max_wait_ms=5_000.0,
+                      max_pending=1)
+        eng.start()
+        try:
+            eng.submit(*frames[0])
+            with pytest.raises(BacklogFull):
+                eng.submit(*frames[1])
+            assert eng.metrics.rejected == 1
+        finally:
+            eng.close()
+
+    def test_mismatched_frame_shapes_rejected(self, predictor,
+                                              frames_and_refs):
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=2, max_wait_ms=1.0)
+        eng.start()
+        try:
+            with pytest.raises(ValueError, match="shapes differ"):
+                eng.submit(frames[0][0], frames[2][1])
+        finally:
+            eng.close()
+
+
+class TestWarmup:
+    def test_warmup_precompiles_then_no_request_compiles(self):
+        """The acceptance-criterion probe: warmup compiles every
+        configured bucket; after it, NO request triggers a fresh XLA
+        compile (fresh predictor so the executable cache starts cold)."""
+        from raft_tpu.evaluate import load_predictor
+        from raft_tpu.serving import CompileWatch, loadgen
+        pred = load_predictor("random", small=True, iters=2)
+        eng = _engine(pred, max_batch=2, max_wait_ms=2.0,
+                      buckets=((36, 60),))
+        stats = eng.warmup()
+        assert set(stats) == {(40, 64)}            # padded bucket key
+        assert stats[(40, 64)]["compiles"] >= 1    # cold cache compiled
+        eng.start(warmup=False)                    # already warmed
+        frames = loadgen.make_frames(SHAPES, per_shape=2, seed=5)
+        try:
+            with CompileWatch() as w:
+                res = loadgen.run_load(eng, frames, n_requests=10,
+                                       concurrency=4)
+        finally:
+            eng.close()
+        assert res["completed"] == 10
+        assert w.compiles == 0                     # nothing recompiled
+        assert eng.metrics.compiles == 0
+
+    def test_persistent_cache_wiring(self, tmp_path, monkeypatch):
+        import jax
+
+        from raft_tpu.serving import enable_persistent_compile_cache
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            used = enable_persistent_compile_cache(str(tmp_path))
+            assert used == str(tmp_path)
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+
+
+class TestEvaluateDispatch:
+    def test_dispatch_batch_is_async_and_equal(self, predictor,
+                                               frames_and_refs):
+        """dispatch_batch returns device arrays whose values equal the
+        blocking predict_batch path bit-for-bit."""
+        frames, _ = frames_and_refs
+        from raft_tpu.utils.padder import InputPadder
+        padder = InputPadder(frames[0][0].shape, mode="sintel")
+        p1, p2 = padder.pad(*frames[0])
+        i1 = np.stack([p1, p1])
+        i2 = np.stack([p2, p2])
+        out = predictor.dispatch_batch(i1, i2)
+        assert not isinstance(out[1], np.ndarray)  # still a jax.Array
+        low, up = predictor.predict_batch(i1, i2)
+        np.testing.assert_array_equal(np.asarray(out[1]), up)
+        np.testing.assert_array_equal(np.asarray(out[0]), low)
+
+    def test_donation_flag_recompiles_not_corrupts(self, frames_and_refs):
+        """donate_images is part of the executable cache key; on CPU
+        donation is ignored (with a warning) and results are unchanged."""
+        from raft_tpu.evaluate import load_predictor
+        frames, _ = frames_and_refs
+        pred = load_predictor("random", small=True, iters=2)
+        from raft_tpu.utils.padder import InputPadder
+        padder = InputPadder(frames[0][0].shape, mode="sintel")
+        p1, p2 = padder.pad(*frames[0])
+        i1, i2 = p1[None], p2[None]
+        _, up_plain = pred.predict_batch(i1, i2)
+        pred.donate_images = True
+        _, up_donated = pred.predict_batch(i1.copy(), i2.copy())
+        np.testing.assert_array_equal(up_plain, up_donated)
+        keys = list(pred._cache)
+        assert {k[3] for k in keys} == {False, True}   # two executables
+
+
+# -- satellite regressions ---------------------------------------------
+
+
+class TestLoggerImageGuard:
+    def test_tb_add_image_failure_is_best_effort(self, tmp_path, capsys):
+        """A TensorBoard image sink that raises (e.g. Pillow-free host:
+        EventWriter.add_image imports PIL) must not propagate out of
+        write_images — scalars and PNG sink behavior are unaffected."""
+        from raft_tpu.utils.logger import TrainLogger
+        logger = TrainLogger(log_dir=str(tmp_path))
+
+        class _BrokenTB:
+            def add_image(self, *a, **k):
+                raise ImportError("No module named 'PIL'")
+
+        logger._tb = _BrokenTB()
+        g = np.random.default_rng(0)
+        img = g.uniform(0, 255, (1, 16, 24, 3)).astype(np.float32)
+        flow = g.normal(size=(1, 16, 24, 2)).astype(np.float32)
+        preds = flow[None]                          # (iters=1, B, H, W, 2)
+        n = logger.write_images(img, img, flow, preds, step=1)
+        assert n >= 1                               # panels still produced
+        assert "TensorBoard image write failed" in capsys.readouterr().out
+        logger._tb = None
+        logger.close()
+
+
+class TestCorrDataAxisEligibility:
+    def test_eligibility_folds_batch_divisibility(self):
+        from raft_tpu.config import RAFTConfig
+        from raft_tpu.models.corr import alternate_eval_eligible
+        cfg = RAFTConfig(small=True)
+        base = alternate_eval_eligible(cfg, (64, 96))
+        # Divisible batch: same verdict as batch-agnostic.
+        assert alternate_eval_eligible(cfg, (64, 96), batch=4,
+                                       data_shards=2) == base
+        # Indivisible batch over a data-sharded mesh: never eligible.
+        assert alternate_eval_eligible(cfg, (64, 96), batch=3,
+                                       data_shards=2) is False
+        # No data sharding: batch is irrelevant.
+        assert alternate_eval_eligible(cfg, (64, 96), batch=3,
+                                       data_shards=1) == base
+
+    def test_pick_engine_falls_back_on_indivisible_batch(self,
+                                                         monkeypatch):
+        """corr_impl='auto' must hand an indivisible-batch sharded
+        config to the materialized engine, not to the shard_map wrapper
+        that rejects it at lowering."""
+        import jax
+
+        from raft_tpu.evaluate import FlowPredictor, load_predictor
+        from raft_tpu.models.corr import alternate_eval_eligible
+        pred = load_predictor("random", small=True, iters=1)
+        assert pred._engines is not None            # auto by default
+        if not alternate_eval_eligible(pred.model.config, (64, 96)):
+            pytest.skip("tiny shape not fused-eligible in this build")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        ok = pred._pick_engine((4, 64, 96, 3), n_dt=2)
+        bad = pred._pick_engine((3, 64, 96, 3), n_dt=2)
+        assert ok.config.alternate_corr is True
+        assert bad.config.alternate_corr is False   # materialized
+
+    def test_explicit_pallas_under_indivisible_mesh_raises(self):
+        """backend='pallas' + an active mesh whose axes don't divide the
+        operands: a clear ValueError, not an opaque lowering failure."""
+        import jax.numpy as jnp
+
+        from raft_tpu.models.corr import (alternate_lookup,
+                                          build_feature_pyramid)
+        from raft_tpu.ops.corr_pallas import fused_eligible
+        from raft_tpu.parallel import make_mesh
+        from raft_tpu.parallel.spatial import spatial_kernel_mesh
+        B, H, W, C = 1, 8, 16, 64
+        pyramid2 = build_feature_pyramid(
+            jnp.zeros((B, H, W, C), jnp.float32), 2)
+        if not fused_eligible([f.shape[1:3] for f in pyramid2], C):
+            pytest.skip("shape not fused-eligible in this build")
+        fmap1 = jnp.zeros((B, H, W, C), jnp.float32)
+        coords = jnp.zeros((B, H, W, 2), jnp.float32)
+        mesh = make_mesh(n_data=2, n_spatial=1)     # B=1 % 2 != 0
+        with spatial_kernel_mesh(mesh):
+            with pytest.raises(ValueError, match="divisible"):
+                alternate_lookup(fmap1, pyramid2, coords, radius=2,
+                                 backend="pallas")
+
+
+class _SlowDataset:
+    """Picklable dataset whose reads outlast any sane worker timeout —
+    stands in for an OOM-killed/hung worker process."""
+
+    def __len__(self):
+        return 4
+
+    def reseed(self, key):
+        pass
+
+    def __getitem__(self, idx):
+        time.sleep(30)
+        z = np.zeros((8, 8, 3), np.float32)
+        return z, z, z[..., :2], np.ones((8, 8), np.float32)
+
+
+class TestProcessLoader:
+    def test_pool_reused_across_epochs(self, tmp_path):
+        from raft_tpu.data.datasets import ProcessDataLoader
+        from test_data import _write_synthetic_sintel
+        from raft_tpu.data.datasets import MpiSintel
+        root = str(tmp_path / "Sintel")
+        _write_synthetic_sintel(root, scenes=2, frames=3)
+        ds = MpiSintel(aug_params={"crop_size": (32, 48)}, root=root,
+                       dstype="clean", seed=0)
+        loader = ProcessDataLoader(ds, batch_size=2, num_workers=2,
+                                   shuffle=False, seed=0)
+        try:
+            e1 = np.stack([b["image1"] for b in loader])
+            pool1 = loader._pool
+            e2 = np.stack([b["image1"] for b in loader])
+            pool2 = loader._pool
+            assert pool1 is not None and pool1 is pool2   # no re-fork
+            # Lazy per-epoch reseed still decorrelates augmentation.
+            assert not np.array_equal(e1, e2)
+        finally:
+            loader.close()
+        assert loader._pool is None                       # idempotent
+
+    def test_dead_worker_surfaces_as_timeout_error(self):
+        from raft_tpu.data.datasets import ProcessDataLoader
+        loader = ProcessDataLoader(_SlowDataset(), batch_size=2,
+                                   num_workers=2, shuffle=False,
+                                   stall_timeout=0,
+                                   worker_timeout=0.5)
+        try:
+            with pytest.raises(RuntimeError,
+                               match="no result within|died"):
+                next(iter(loader))
+        finally:
+            loader.close()
