@@ -15,31 +15,81 @@ Fault tolerance (multi-day preemptible-pod runs):
 * :class:`RunCheckpointer` holds ONE orbax ``CheckpointManager`` per run
   directory — saves stop re-scanning the directory every call and the
   ``max_to_keep`` policy is applied consistently across a run.
-* Saves retry transient I/O errors with exponential backoff
-  (:func:`raft_tpu.resilience.retry_with_backoff`).
-* ``restore``/``latest_step`` fall back to the newest *intact* step when
-  the latest checkpoint is truncated or corrupt (a preemption landing
-  mid-save): obviously-truncated step dirs (zero-byte files, missing
-  metadata) are skipped up front, and any step whose actual restore
-  raises falls back to the next-older one.
+* Saves retry transient I/O errors with exponential backoff; on
+  multi-host pods the whole attempt loop is vote-coordinated so every
+  host retries (or gives up) together.
+* **Async saves** (``async_save=True``): ``save`` only *dispatches* the
+  orbax write (arrays are snapshotted to host, the serialization runs
+  in background threads) and returns; the multi-second write latency
+  overlaps training steps. :meth:`wait_for_pending` is the barrier —
+  the train loop places it at the next save point, at preemption, at
+  divergence-abort and at exit. Retries wrap the *finalize* (a failed
+  or errored background write is re-saved synchronously on retry), not
+  the dispatch, so the transient-I/O guarantee is preserved.
+* **Cross-host commit agreement**: after each save every host votes
+  (:func:`raft_tpu.resilience.all_hosts_agree`, ``require="all"``) on
+  its local success at the same deterministic point. Only an
+  all-hosts-yes step is *committed* — recorded in the run directory's
+  ``commit.json`` and thereby eligible for ``latest_step``/``restore``.
+  A minority save failure rolls the step back everywhere (the step dir
+  is deleted, the vote result is global so no host diverges) instead of
+  leaving a torn checkpoint; retries exhausted raises
+  :class:`~raft_tpu.resilience.CheckpointCommitError` on every host.
+* ``restore``/``latest_step`` fall back to the newest *committed,
+  intact* step: uncommitted steps (in-flight async saves, vote-failed
+  leftovers) are invisible, obviously-truncated step dirs (zero-byte
+  files, missing metadata) are skipped up front, and any step whose
+  actual restore raises falls back to the next-older one. Directories
+  with no ``commit.json`` (pre-commit-agreement runs) keep the legacy
+  behavior: every intact step is eligible.
 """
 
 from __future__ import annotations
 
+import glob
+import json
+import logging
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, Optional, Set
 
 import jax
 import orbax.checkpoint as ocp
 
-from raft_tpu.resilience import active_injector, retry_with_backoff
+from raft_tpu.resilience import (CheckpointCommitError, active_injector,
+                                 all_hosts_agree)
+
+logger = logging.getLogger("raft_tpu.checkpoint")
+if not logging.getLogger().handlers and not logger.handlers:
+    # Pod runs route/filter these through the logging tree; a bare
+    # process (drill, notebook) still sees warnings on stderr via the
+    # lastResort handler — no basicConfig call, no format takeover.
+    logger.setLevel(logging.INFO)
+
+_COMMIT_FILE = "commit.json"
 
 
 def _manager(ckpt_dir: str, max_to_keep: Optional[int] = None):
+    # Explicit active_processes on multi-host: orbax then runs its
+    # internal barriers over the coordination service
+    # (client.wait_at_barrier) instead of an XLA device collective
+    # (sync_global_devices) — the same channel all_hosts_agree votes
+    # on, and the only one that also works on backends without
+    # cross-process computation support (the CPU fault drills).
+    mp, create = ocp.options.MultiprocessingOptions(), True
+    if jax.process_count() > 1:
+        mp = ocp.options.MultiprocessingOptions(
+            active_processes=set(range(jax.process_count())))
+        # Orbax refuses create=True alongside active_processes; the
+        # root is created here instead (idempotent on every host).
+        os.makedirs(os.path.abspath(ckpt_dir), exist_ok=True)
+        create = False
     return ocp.CheckpointManager(
         os.path.abspath(ckpt_dir),
         options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                             create=True))
+                                             create=create,
+                                             multiprocessing_options=mp))
 
 
 def _arrays_of(state) -> dict:
@@ -73,6 +123,24 @@ def _step_intact(ckpt_dir: str, step: int) -> bool:
     return saw_file
 
 
+def _read_committed(ckpt_dir: str) -> Optional[Set[int]]:
+    """The directory's committed-step set, or ``None`` when the run
+    predates commit agreement (legacy: every intact step is eligible).
+    An unreadable/garbled record degrades to legacy rather than hiding
+    every checkpoint behind a parse error."""
+    path = os.path.join(os.path.abspath(ckpt_dir), _COMMIT_FILE)
+    try:
+        with open(path) as f:
+            return {int(s) for s in json.load(f)["committed"]}
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        logger.warning("commit record %s unreadable (%s: %s); treating "
+                       "every intact step as committed", path,
+                       type(e).__name__, e)
+        return None
+
+
 class RunCheckpointer:
     """One hardened checkpoint manager for one run directory.
 
@@ -80,57 +148,252 @@ class RunCheckpointer:
     calling the module-level helpers per save: directory scans happen
     once, the keep policy sees every save, and the manager's async
     machinery is reused. Also usable as a context manager.
+
+    ``async_save=True`` turns ``save`` into a non-blocking dispatch;
+    the write is finalized, voted on and committed at the next
+    :meth:`wait_for_pending` barrier (``save`` itself starts with one,
+    so back-to-back saves are safe). Synchronous mode (the default)
+    finalizes and commits inline — on-disk step contents are identical
+    to the pre-async behavior.
     """
 
     def __init__(self, ckpt_dir: str, keep: int = 5,
-                 save_retries: int = 3, retry_delay: float = 0.5):
+                 save_retries: int = 3, retry_delay: float = 0.5,
+                 async_save: bool = False):
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         self.save_retries = save_retries
         self.retry_delay = retry_delay
+        self.async_save = async_save
         self._mngr = _manager(self.ckpt_dir, keep)
+        # (step, arrays, first_exc, first_dispatched) of the in-flight
+        # async save; holding `arrays` keeps the state alive for a
+        # synchronous re-save if the background write has to be retried.
+        self._pending = None
+        if async_save and _read_committed(self.ckpt_dir) is None:
+            # Establish commit gating up front: without a record, a
+            # concurrent reader during the FIRST in-flight save would
+            # fall back to legacy every-intact-step-is-eligible mode
+            # and could observe the uncommitted step the moment orbax
+            # finalizes it. Existing steps (a pre-commit-agreement run
+            # being resumed) are grandfathered in.
+            if jax.process_index() == 0:
+                self._write_commit_record(
+                    {int(s) for s in self._mngr.all_steps()})
+            if jax.process_count() > 1:
+                all_hosts_agree(True)   # record visible before any save
+
+    @property
+    def pending_step(self) -> Optional[int]:
+        """Step of the dispatched-but-uncommitted async save, if any."""
+        return self._pending[0] if self._pending is not None else None
 
     # -- save ------------------------------------------------------------
 
-    def _save_once(self, step: int, arrays: dict):
-        # Fault-injection hook first: an injected failure must not leave
-        # partial state inside the real manager.
-        active_injector().maybe_fail_ckpt_save()
-        self._mngr.save(step, args=ocp.args.StandardSave(arrays))
-        self._mngr.wait_until_finished()
-
     def save(self, state) -> None:
-        """Save ``state`` under its current step number, retrying
-        transient I/O errors with exponential backoff."""
+        """Save ``state`` under its current step number.
+
+        Synchronous mode: write, retry transient I/O with exponential
+        backoff (vote-coordinated on multi-host), commit, return.
+        Async mode: finalize any previous pending save (the barrier at
+        the next save point), dispatch this one, return immediately —
+        call :meth:`wait_for_pending` to finalize + commit it.
+        """
+        self.wait_for_pending()
         step = int(jax.device_get(state.step))
         arrays = _arrays_of(state)
+        if not self.async_save:
+            self._save_with_agreement(step, arrays)
+            return
 
-        def _cleanup(attempt, exc):
-            # A failed attempt may have left a half-written tmp dir or a
-            # stale in-memory directory view; reload is best-effort.
+        # Async dispatch. The injection hook and (on multi-host) a
+        # dispatch pre-vote run first so either every host enters the
+        # orbax dispatch or none does — orbax's internal barriers stay
+        # matched even when one simulated host fails.
+        first_exc: Optional[Exception] = None
+        try:
+            active_injector().maybe_fail_ckpt_save()
+        except (OSError, IOError) as e:
+            first_exc = e
+        dispatch_ok = first_exc is None
+        if jax.process_count() > 1:
+            dispatch_ok = all_hosts_agree(dispatch_ok)
+            if not dispatch_ok and first_exc is None:
+                first_exc = CheckpointCommitError(
+                    f"another host failed dispatching checkpoint "
+                    f"step {step}")
+        dispatched = False
+        if dispatch_ok:
             try:
-                self._mngr.reload()
-            except Exception:
-                pass
+                self._mngr.save(step, args=ocp.args.StandardSave(arrays))
+                dispatched = True
+            except (OSError, IOError) as e:
+                if jax.process_count() > 1:
+                    # The other hosts already entered the orbax
+                    # dispatch; deferring here would desync its
+                    # barriers. A real dispatch-time I/O error (not an
+                    # injected one — those fire in the hook above) is a
+                    # crash, not a degradation.
+                    raise
+                first_exc = e
+        self._pending = (step, arrays, first_exc, dispatched)
 
-        retry_with_backoff(
-            lambda: self._save_once(step, arrays),
-            retries=self.save_retries, base_delay=self.retry_delay,
-            retry_on=(OSError, IOError), on_retry=_cleanup,
-            describe=f"checkpoint save (step {step}, {self.ckpt_dir})")
+    def wait_for_pending(self) -> None:
+        """Barrier: finalize, vote on and commit the in-flight async
+        save. No-op when nothing is pending. The train loop calls this
+        at the next save point (via ``save``), at preemption, at
+        divergence-abort and at exit. Raises — after rollback — when
+        the save failed everywhere or failed cross-host agreement."""
+        if self._pending is None:
+            return
+        step, arrays, first_exc, dispatched = self._pending
+        self._pending = None
+        self._save_with_agreement(step, arrays, first_exc=first_exc,
+                                  first_dispatched=dispatched)
+
+    def _attempt(self, step: int, arrays: dict,
+                 exc: Optional[Exception],
+                 dispatched: bool) -> Optional[Exception]:
+        """One save attempt on this host; returns None on local
+        success, the failure otherwise. ``dispatched``: the orbax
+        dispatch for this step already ran (first finalize of an async
+        save) — go straight to the wait. On multi-host a pre-vote keeps
+        orbax's collectives matched: if any host already failed, no
+        host enters the orbax save this attempt."""
+        if not dispatched and exc is None:
+            try:
+                active_injector().maybe_fail_ckpt_save()
+            except (OSError, IOError) as e:
+                exc = e
+        if not dispatched:
+            ok = exc is None
+            if jax.process_count() > 1:
+                ok = all_hosts_agree(ok)
+            if not ok:
+                return exc or CheckpointCommitError(
+                    f"another host failed its save of checkpoint "
+                    f"step {step}")
+        try:
+            if not dispatched:
+                self._mngr.save(step,
+                                args=ocp.args.StandardSave(arrays))
+            self._mngr.wait_until_finished()
+            self._mngr.check_for_errors()
+            # Post-write health check: data is durable on disk here;
+            # an injected failure models a host dying between its write
+            # and its vote (the torn-step scenario).
+            active_injector().maybe_fail_ckpt_commit()
+        except (OSError, IOError) as e:
+            return e
+        return None
+
+    def _save_with_agreement(self, step: int, arrays: dict,
+                             first_exc: Optional[Exception] = None,
+                             first_dispatched: bool = False) -> None:
+        """The coordinated attempt loop: try, vote, commit-or-rollback,
+        retry with backoff. The vote result is global, so every host
+        retries (and sleeps, and gives up) in lockstep."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.save_retries + 1):
+            exc = self._attempt(step, arrays,
+                                exc=first_exc if attempt == 0 else None,
+                                dispatched=(first_dispatched
+                                            and attempt == 0))
+            if all_hosts_agree(exc is None):
+                self._record_commit(step)
+                return
+            last_exc = exc or last_exc or CheckpointCommitError(
+                f"another host failed its save of checkpoint "
+                f"step {step}")
+            self._rollback(step)
+            if attempt < self.save_retries:
+                delay = min(self.retry_delay * (2 ** attempt), 8.0)
+                print(f"WARNING: checkpoint save (step {step}, "
+                      f"{self.ckpt_dir}) failed (attempt {attempt + 1}/"
+                      f"{self.save_retries + 1}): {exc}; retrying in "
+                      f"{delay:.2f}s", flush=True)
+                time.sleep(delay)
+        if jax.process_count() > 1:
+            raise CheckpointCommitError(
+                f"checkpoint step {step} failed cross-host commit "
+                f"agreement after {self.save_retries + 1} attempts; "
+                f"rolled back — resume restores the newest committed "
+                f"step") from last_exc
+        raise last_exc
+
+    def _record_commit(self, step: int) -> None:
+        """Mark ``step`` committed (rank 0 writes ``commit.json``
+        atomically; a fence makes it visible before any host proceeds).
+        A directory without a record is grandfathered: its existing
+        steps enter the record alongside the new one, so legacy
+        checkpoints stay restorable."""
+        if jax.process_index() == 0:
+            committed = _read_committed(self.ckpt_dir)
+            if committed is None:
+                committed = {int(s) for s in self._mngr.all_steps()}
+            committed.add(int(step))
+            # Drop entries pruned by max_to_keep.
+            committed &= {int(s) for s in self._mngr.all_steps()}
+            self._write_commit_record(committed)
+        if jax.process_count() > 1:
+            all_hosts_agree(True)   # fence: record visible everywhere
+
+    def _write_commit_record(self, committed: Set[int]) -> None:
+        path = os.path.join(self.ckpt_dir, _COMMIT_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"committed": sorted(committed)}, f)
+        os.replace(tmp, path)
+
+    def _rollback(self, step: int) -> None:
+        """Delete a vote-failed (or errored) step everywhere: rank 0
+        removes the step dir and any half-written tmp dirs from the
+        shared filesystem, a fence makes the deletion visible, every
+        host refreshes its manager's directory view."""
+        if jax.process_index() == 0:
+            shutil.rmtree(os.path.join(self.ckpt_dir, str(step)),
+                          ignore_errors=True)
+            for tmp in glob.glob(os.path.join(
+                    self.ckpt_dir, f"{step}.orbax-checkpoint-tmp-*")):
+                shutil.rmtree(tmp, ignore_errors=True)
+        if jax.process_count() > 1:
+            all_hosts_agree(True)   # fence: deletion visible everywhere
+        try:
+            self._mngr.reload()
+        except Exception:
+            pass
 
     # -- inspect ---------------------------------------------------------
 
     def all_steps(self):
-        return sorted(self._mngr.all_steps())
+        return sorted(int(s) for s in self._mngr.all_steps())
+
+    def _candidate_steps(self):
+        """Steps eligible for restore, newest first: committed (when a
+        commit record exists) and not the in-flight async save."""
+        committed = _read_committed(self.ckpt_dir)
+        pending = self.pending_step
+        out = []
+        for step in sorted(self._mngr.all_steps(), reverse=True):
+            step = int(step)
+            if step == pending:
+                continue            # uncommitted by construction
+            if committed is not None and step not in committed:
+                logger.warning(
+                    "checkpoint step %d in %s is not committed "
+                    "(in-flight or failed cross-host agreement); "
+                    "falling back to an older step", step, self.ckpt_dir)
+                continue
+            out.append(step)
+        return out
 
     def latest_step(self) -> Optional[int]:
-        """Newest step that passes the structural intactness screen."""
-        for step in sorted(self._mngr.all_steps(), reverse=True):
+        """Newest committed step that passes the structural screen."""
+        for step in self._candidate_steps():
             if _step_intact(self.ckpt_dir, step):
-                return int(step)
-            print(f"WARNING: checkpoint step {step} in {self.ckpt_dir} "
-                  "looks truncated; falling back to an older step",
-                  flush=True)
+                return step
+            logger.warning(
+                "checkpoint step %d in %s looks truncated; falling "
+                "back to an older step", step, self.ckpt_dir)
         return None
 
     # -- restore ---------------------------------------------------------
@@ -149,38 +412,53 @@ class RunCheckpointer:
         """Restore a full train state; falls back to older intact steps.
 
         With an explicit ``step`` the restore is exact (corruption
-        raises). Otherwise candidates are tried newest-first: a step
-        that fails its structural screen or whose actual restore raises
-        is skipped with a warning, and the next-older one is tried —
-        the recovery for a preemption that landed mid-save. Returns
-        ``state`` unchanged when the directory holds no checkpoint;
-        raises the last error when every candidate is corrupt.
+        raises). Otherwise candidates are the committed steps tried
+        newest-first — an uncommitted step (in-flight async save,
+        vote-failed leftover) is never a candidate — and a step that
+        fails its structural screen or whose actual restore raises is
+        skipped with a warning, the next-older one tried: the recovery
+        for a preemption that landed mid-save. Returns ``state``
+        unchanged when the directory holds no checkpoint; raises the
+        last error when every candidate is corrupt.
         """
         if step is not None:
             return self._restore_step(step, state)
-        candidates = sorted(self._mngr.all_steps(), reverse=True)
-        if not candidates:
+        candidates = self._candidate_steps()
+        present = [int(s) for s in self._mngr.all_steps()
+                   if int(s) != self.pending_step]
+        if not candidates and not present:
+            # Empty directory — or its only step is the in-flight async
+            # save, which is not restorable yet by construction.
             return state
         last_err: Optional[Exception] = None
         for cand in candidates:
             if not _step_intact(self.ckpt_dir, cand):
-                print(f"WARNING: skipping truncated checkpoint step "
-                      f"{cand} in {self.ckpt_dir}", flush=True)
+                logger.warning("skipping truncated checkpoint step %d "
+                               "in %s", cand, self.ckpt_dir)
                 continue
             try:
                 return self._restore_step(cand, state)
             except Exception as e:   # corrupt beyond the cheap screen
                 last_err = e
-                print(f"WARNING: restore of checkpoint step {cand} "
-                      f"failed ({type(e).__name__}: {e}); falling back "
-                      "to an older step", flush=True)
+                logger.warning(
+                    "restore of checkpoint step %d failed (%s: %s); "
+                    "falling back to an older step", cand,
+                    type(e).__name__, e)
         if last_err is not None:
             raise last_err
         raise FileNotFoundError(
-            f"no intact checkpoint under {self.ckpt_dir} "
-            f"(steps present but truncated: {candidates})")
+            f"no committed intact checkpoint under {self.ckpt_dir} "
+            f"(steps present but uncommitted/truncated: {present})")
 
     def close(self):
+        """Finalize any pending async save (best-effort — ``close`` may
+        run during exception unwind and must not mask the original
+        error), then release the manager."""
+        try:
+            self.wait_for_pending()
+        except Exception as e:
+            logger.warning("pending checkpoint save failed during "
+                           "close (%s: %s)", type(e).__name__, e)
         self._mngr.close()
 
     def __enter__(self):
@@ -216,8 +494,8 @@ def restore_checkpoint(ckpt_dir: str, state,
     ``state`` provides the target structure (and sharding, when its arrays
     carry shardings); returns the restored state or ``state`` unchanged when
     the directory holds no checkpoint. When the newest checkpoint is
-    truncated or corrupt, falls back to the newest intact one (see
-    :meth:`RunCheckpointer.restore`).
+    truncated, corrupt or uncommitted, falls back to the newest committed
+    intact one (see :meth:`RunCheckpointer.restore`).
     """
     with RunCheckpointer(ckpt_dir) as ckptr:
         return ckptr.restore(state, step=step)
@@ -238,7 +516,7 @@ def load_params(path: str, step: Optional[int] = None) -> Any:
         variables = load_torch_checkpoint(path)
         return variables["params"], variables.get("batch_stats", {})
     with _manager(path) as mngr:
-        step = step if step is not None else mngr.latest_step()
+        step = step if step is not None else latest_step(path)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {path}")
         # Explicit StandardRestore: a fresh manager has no handler

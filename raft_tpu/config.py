@@ -246,3 +246,10 @@ class TrainConfig:
     # operator problem, not something to grind through. 0 disables the
     # abort (skipping still applies).
     max_consecutive_skips: int = 20
+    # Async (non-blocking) checkpointing: saves dispatch the orbax write
+    # and the loop keeps stepping; the write is finalized, cross-host
+    # vote-committed and only then made restore-visible at the next
+    # barrier (next save point / preemption / divergence-abort / exit).
+    # Hides multi-second save latency on big models. Off by default:
+    # synchronous saves keep bit-identical pre-async on-disk behavior.
+    async_checkpointing: bool = False

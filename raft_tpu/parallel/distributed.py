@@ -11,11 +11,26 @@ provided here.
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
 from typing import Dict, Optional
 
 import jax
 import numpy as np
+
+from raft_tpu.resilience import all_hosts_agree
+
+
+def _distributed_initialized() -> bool:
+    """Whether ``jax.distributed.initialize`` already ran, without
+    touching any device API. ``jax.distributed.is_initialized`` only
+    exists on newer jax; older versions expose the same fact through
+    the coordinator client's global state."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed as _dist
+    return getattr(_dist.global_state, "client", None) is not None
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -34,7 +49,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
     make ``jax.distributed.initialize`` unconditionally fail — the
     coordinator client state is inspected instead.
     """
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return  # already initialized
     env = os.environ
     if coordinator_address is None:
@@ -52,11 +67,28 @@ def is_main_process() -> bool:
     return jax.process_index() == 0
 
 
-def save_on_master(save_fn, *args, **kwargs):
+def save_on_master(save_fn, *args, **kwargs) -> bool:
     """Run a side-effecting save only on rank 0
-    (reference ``core/utils/misc.py:417-419``)."""
+    (reference ``core/utils/misc.py:417-419``).
+
+    Routes through :func:`raft_tpu.resilience.all_hosts_agree`: every
+    host learns whether the master's save actually succeeded (and the
+    vote doubles as a fence — no host races ahead of a save that is
+    still failing). Returns that agreed success flag on every host; the
+    master additionally re-raises its own exception after voting, so
+    the pod never deadlocks on a master that died silently mid-save.
+    Single process: plain call, exceptions propagate as before.
+    """
+    err = None
     if is_main_process():
-        save_fn(*args, **kwargs)
+        try:
+            save_fn(*args, **kwargs)
+        except Exception as e:      # vote first, raise after — a
+            err = e                 # pre-vote raise would desync hosts
+    agreed = all_hosts_agree(err is None)
+    if err is not None:
+        raise err
+    return agreed
 
 
 def reduce_metrics(metrics: Dict[str, jax.Array],
@@ -71,11 +103,42 @@ def reduce_metrics(metrics: Dict[str, jax.Array],
     """
     if jax.process_count() == 1:
         return {k: float(v) for k, v in metrics.items()}
-    from jax.experimental import multihost_utils
-
     keys = sorted(metrics.keys())
-    vec = np.asarray([float(metrics[k]) for k in keys], np.float32)
-    summed = multihost_utils.process_allgather(vec).sum(axis=0)
+    vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
+    rows = _host_allgather_floats(vec)
+    summed = np.sum(rows, axis=0)
     if average:
         summed = summed / jax.process_count()
     return {k: float(summed[i]) for i, k in enumerate(keys)}
+
+
+_GATHER_SEQ = itertools.count()
+_GATHER_TIMEOUT_MS = 600_000
+
+
+def _host_allgather_floats(vec: np.ndarray) -> np.ndarray:
+    """All-gather one float vector per process on the *host* side.
+
+    Python scalars don't need a device collective; the coordination
+    service's key-value store carries them (same channel as
+    :func:`raft_tpu.resilience.all_hosts_agree` votes), which also
+    works on backends without cross-process XLA computation support
+    (CPU multi-process drills/tests). Falls back to
+    ``process_allgather`` when no coordination client exists. Like
+    every cross-host helper here, each call consumes a sequence number
+    and must happen at the same point on every process.
+    """
+    from raft_tpu.resilience import _coordination_client
+
+    client = _coordination_client()
+    if client is None:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            vec.astype(np.float32)))
+    key = f"raft_tpu/gather/{next(_GATHER_SEQ)}"
+    client.key_value_set(f"{key}/{jax.process_index()}",
+                         json.dumps([float(x) for x in vec]))
+    return np.asarray([
+        json.loads(client.blocking_key_value_get(
+            f"{key}/{i}", _GATHER_TIMEOUT_MS))
+        for i in range(jax.process_count())])

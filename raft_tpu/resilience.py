@@ -9,6 +9,11 @@ machinery:
 
 * :func:`retry_with_backoff` — generic exponential-backoff retry for
   transient I/O (checkpoint saves, per-sample dataset reads).
+* :func:`all_hosts_agree` — cross-host boolean vote at a deterministic
+  point (generalized from the train loop's preemption vote): "all"
+  semantics drive checkpoint commit agreement (a step is committed only
+  when every host's save succeeded), "any" semantics drive preemption
+  (one host's SIGTERM stops the pod).
 * :class:`StallWatchdog` — a timer that surfaces a diagnostic when the
   loader's prefetch pump stops producing batches (hung NFS mount,
   deadlocked worker pool) instead of the run silently wedging.
@@ -29,6 +34,7 @@ pump watchdog), :mod:`raft_tpu.train` (consecutive-skip abort).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import threading
 import time
@@ -42,6 +48,80 @@ class TrainingDiverged(RuntimeError):
     whose parameters were finite (the guard never applies a non-finite
     update), so ``--resume`` restarts from healthy weights.
     """
+
+
+class CheckpointCommitError(RuntimeError):
+    """A checkpoint step failed cross-host commit agreement.
+
+    Raised on EVERY host (the vote result is global, so all hosts take
+    the same branch — no host diverges into a collective alone) after
+    the step has been rolled back everywhere. The newest *committed*
+    step is intact on all hosts; ``--resume`` restarts from it.
+    """
+
+
+def all_hosts_agree(local_vote: bool, *, require: str = "all") -> bool:
+    """Cross-host boolean vote at a deterministic point.
+
+    Every host calls this at the SAME point in its control flow (a
+    collective runs underneath on multi-host; a host skipping the call
+    would deadlock the pod) and passes its local vote. Returns, on every
+    host, whether the votes satisfy ``require``:
+
+    * ``"all"`` — True iff EVERY host voted True (checkpoint commit
+      agreement: a step is committed only when every host's save
+      succeeded, so a minority failure can never leave a torn step);
+    * ``"any"`` — True iff ANY host voted True (preemption: one host's
+      SIGTERM stops the whole pod).
+
+    Because the result is identical on all hosts, callers can branch on
+    it (commit vs rollback, stop vs continue) without desyncing. Single
+    process: returns ``local_vote`` with no collective.
+
+    The vote rides the distributed *coordination service* key-value
+    store (the same gRPC channel orbax barriers use), NOT a device
+    collective: it must work while a save is failing, before/without
+    any XLA program, and on backends with no cross-process computation
+    support (CPU drills). Each call consumes one sequence number from a
+    process-local counter — in lockstep across hosts because the calls
+    themselves are — so votes can never alias. Falls back to
+    ``process_allgather`` when no coordination client exists.
+    """
+    if require not in ("all", "any"):
+        raise ValueError(f"require must be 'all' or 'any', got {require!r}")
+    import jax
+    if jax.process_count() == 1:
+        return bool(local_vote)
+    client = _coordination_client()
+    if client is None:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        votes = multihost_utils.process_allgather(
+            np.asarray([bool(local_vote)]))
+        return bool(votes.all() if require == "all" else votes.any())
+    key = f"raft_tpu/vote/{next(_VOTE_SEQ)}"
+    client.key_value_set(f"{key}/{jax.process_index()}",
+                         "1" if local_vote else "0")
+    # blocking_key_value_get synchronizes implicitly: each reader waits
+    # until each writer has written, so no extra barrier is needed.
+    votes = [client.blocking_key_value_get(f"{key}/{i}", _VOTE_TIMEOUT_MS)
+             == "1" for i in range(jax.process_count())]
+    return all(votes) if require == "all" else any(votes)
+
+
+_VOTE_SEQ = itertools.count()
+_VOTE_TIMEOUT_MS = 600_000      # a vote waits on peers' save attempts
+
+
+def _coordination_client():
+    """The jax distributed coordination-service client, or ``None``
+    when the process runs without one (single process, or a bootstrap
+    path that bypassed ``jax.distributed.initialize``)."""
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None)
+    except Exception:
+        return None
 
 
 def retry_with_backoff(fn: Callable, *, retries: int = 3,
@@ -94,6 +174,7 @@ class StallWatchdog:
         self.fired = 0
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._closed = False
 
     def _fire(self):
         with self._lock:
@@ -106,16 +187,30 @@ class StallWatchdog:
                       f"(diagnostic unavailable: {e})")
 
     def pet(self):
-        """Record progress: cancel the pending alarm and re-arm."""
+        """Record progress: cancel the pending alarm and re-arm.
+
+        No-op after :meth:`close` — a late pet from a draining producer
+        thread must not re-arm a timer the owner already tore down (the
+        re-armed timer would be the only live non-daemon-ish thing left
+        at interpreter shutdown).
+        """
         with self._lock:
+            if self._closed:
+                return
             if self._timer is not None:
                 self._timer.cancel()
             self._timer = threading.Timer(self.timeout, self._fire)
+            # Daemon: a watchdog must never keep a dying interpreter
+            # alive (mid-drill shutdown with a stalled pump).
             self._timer.daemon = True
             self._timer.start()
 
     def close(self):
+        """Tear down the watchdog. Idempotent; later ``pet`` calls
+        no-op, so double-close / close-then-drain sequences during
+        interpreter shutdown cannot leave a live timer behind."""
         with self._lock:
+            self._closed = True
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
@@ -161,6 +256,16 @@ class FaultInjector:
       non-finite loss at these step numbers (exercises the update
       guard). Trace-time constant: injection adds graph nodes only when
       requested, so production steps carry zero overhead.
+    * ``RAFT_FAULT_CKPT_COMMIT_ERRORS=N`` — the first N checkpoint
+      commit checks (after the step's data is durably written, before
+      the cross-host vote) raise ``OSError`` — the mid-save host-death
+      simulation: data on disk, commit never agreed, step rolled back.
+    * ``RAFT_FAULT_TARGET_PROCESS=K`` — restrict EVERY host-side fault
+      above to the host with ``jax.process_index() == K`` (multi-host
+      drills: exactly one simulated host fails while the others
+      succeed). Unset = faults fire on every process. The in-graph NaN
+      injection is exempt: it is a trace-time constant compiled into a
+      program all hosts share.
 
     Mutable counters (the save-error budget) live on the instance;
     :func:`active_injector` holds one per process so budgets persist
@@ -170,6 +275,8 @@ class FaultInjector:
     ckpt_save_errors: int = 0
     corrupt_sample_indices: FrozenSet[int] = frozenset()
     nan_loss_steps: Tuple[int, ...] = ()
+    ckpt_commit_errors: int = 0
+    target_process: Optional[int] = None
 
     @staticmethod
     def from_env() -> "FaultInjector":
@@ -177,34 +284,56 @@ class FaultInjector:
             raw = os.environ.get(name, "").strip()
             return tuple(int(x) for x in raw.split(",") if x.strip())
 
+        target = os.environ.get("RAFT_FAULT_TARGET_PROCESS", "").strip()
         return FaultInjector(
             ckpt_save_errors=int(
                 os.environ.get("RAFT_FAULT_CKPT_SAVE_ERRORS", "0")),
             corrupt_sample_indices=frozenset(
                 _ints("RAFT_FAULT_CORRUPT_SAMPLES")),
-            nan_loss_steps=_ints("RAFT_FAULT_NAN_STEPS"))
+            nan_loss_steps=_ints("RAFT_FAULT_NAN_STEPS"),
+            ckpt_commit_errors=int(
+                os.environ.get("RAFT_FAULT_CKPT_COMMIT_ERRORS", "0")),
+            target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
+
+    def _on_target(self) -> bool:
+        """Whether host-side faults apply to THIS process."""
+        if self.target_process is None:
+            return True
+        import jax
+        return jax.process_index() == self.target_process
 
     def maybe_fail_ckpt_save(self):
         """Called once per checkpoint save *attempt*; burns one unit of
         the error budget per call until exhausted."""
-        if self.ckpt_save_errors > 0:
+        if self.ckpt_save_errors > 0 and self._on_target():
             self.ckpt_save_errors -= 1
             raise OSError("injected checkpoint save failure "
                           f"({self.ckpt_save_errors} more queued)")
+
+    def maybe_fail_ckpt_commit(self):
+        """Called once per checkpoint *commit* check — after the step's
+        bytes are durably on disk, before the cross-host commit vote.
+        An injected failure here models a host dying mid-save: the data
+        exists but this host never vouches for it, so the vote fails
+        and the step is rolled back everywhere."""
+        if self.ckpt_commit_errors > 0 and self._on_target():
+            self.ckpt_commit_errors -= 1
+            raise OSError("injected checkpoint commit failure "
+                          f"({self.ckpt_commit_errors} more queued)")
 
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
         substitution path) while its neighbors stay readable."""
-        if int(index) in self.corrupt_sample_indices:
+        if int(index) in self.corrupt_sample_indices and self._on_target():
             raise OSError(f"injected corrupt sample at index {index}")
 
     @property
     def active(self) -> bool:
         return bool(self.ckpt_save_errors or self.corrupt_sample_indices
-                    or self.nan_loss_steps)
+                    or self.nan_loss_steps or self.ckpt_commit_errors)
 
 
 _ACTIVE: Optional[FaultInjector] = None

@@ -20,7 +20,7 @@ forward itself:
 """
 
 from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
-                                      ShapeBucketBatcher)
+                                      RequestTimedOut, ShapeBucketBatcher)
 from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
                                      enable_persistent_compile_cache,
                                      make_engine)
@@ -31,6 +31,7 @@ __all__ = [
     "BacklogFull",
     "CompileWatch",
     "QueuedRequest",
+    "RequestTimedOut",
     "ServingConfig",
     "ServingEngine",
     "ServingMetrics",

@@ -35,20 +35,25 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 class QueuedRequest:
     """One in-flight request: padded inputs + the padder to undo it,
-    submit timestamp (latency accounting + deadline), and the future the
-    client is waiting on."""
+    submit timestamp (latency accounting + batching deadline), an
+    optional queue-timeout deadline (monotonic; ``None`` = wait
+    forever), and the future the client is waiting on."""
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
-                 "future")
+                 "deadline", "future")
 
     def __init__(self, image1, image2, padder, bucket: Tuple[int, int],
-                 t_submit: float):
+                 t_submit: float, deadline: Optional[float] = None):
         self.image1 = image1
         self.image2 = image2
         self.padder = padder
         self.bucket = bucket
         self.t_submit = t_submit
+        self.deadline = deadline
         self.future: Future = Future()
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
 
 
 class ShapeBucketBatcher:
@@ -175,3 +180,9 @@ class ShapeBucketBatcher:
 
 class BacklogFull(RuntimeError):
     """Raised by ``enqueue`` when the pending-request cap is hit."""
+
+
+class RequestTimedOut(RuntimeError):
+    """Set on a request's future when its queue-timeout deadline passed
+    before the engine dispatched it (overload shedding: the client gets
+    a clear, fast error instead of an arbitrarily stale result)."""

@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
-                                      ShapeBucketBatcher)
+                                      RequestTimedOut, ShapeBucketBatcher)
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
 from raft_tpu.utils.padder import InputPadder
@@ -95,6 +95,13 @@ class ServingConfig:
       factor: pad-to multiple (8 for stride-8 RAFT features).
       max_pending: backlog cap; submits beyond it raise
         :class:`~raft_tpu.serving.batcher.BacklogFull`.
+      queue_timeout_ms: per-request time-in-queue budget. A request
+        still undispatched this long after submit has its future
+        completed with :class:`~raft_tpu.serving.batcher
+        .RequestTimedOut` instead of occupying a batch slot — under
+        overload clients get a fast, clear error rather than an
+        arbitrarily stale result. Counted in ``metrics.timeouts``.
+        ``None``/``0`` disables (requests wait forever).
       pipeline_depth: dispatched-but-unsynced batches allowed in flight
         (2 = classic double buffering: host stacks N+1 while device
         runs N).
@@ -111,6 +118,7 @@ class ServingConfig:
     pad_mode: str = "sintel"
     factor: int = 8
     max_pending: int = 2048
+    queue_timeout_ms: Optional[float] = None
     pipeline_depth: int = 2
     donate: Optional[bool] = None
     persistent_cache: object = None
@@ -247,8 +255,11 @@ class ServingEngine:
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self.config.factor)
             im1, im2 = padder.pad(image1, image2)
+        t_submit = time.monotonic()
+        timeout = self.config.queue_timeout_ms
+        deadline = (t_submit + timeout / 1e3) if timeout else None
         req = QueuedRequest(im1, im2, padder, bucket=padder.padded_shape,
-                            t_submit=time.monotonic())
+                            t_submit=t_submit, deadline=deadline)
         try:
             self.batcher.enqueue(req)
         except (BacklogFull, RuntimeError):
@@ -287,6 +298,21 @@ class ServingEngine:
             self._inflight.put(None)
 
     def _dispatch_one(self, batch: List[QueuedRequest]) -> None:
+        # Expire requests whose time-in-queue budget ran out while they
+        # waited for a batch slot: complete them with a clear error and
+        # don't spend device compute on them.
+        now = time.monotonic()
+        expired = [r for r in batch if r.expired(now)]
+        if expired:
+            for r in expired:
+                r.future.set_exception(RequestTimedOut(
+                    f"request spent {(now - r.t_submit) * 1e3:.1f} ms "
+                    f"in queue (queue_timeout_ms="
+                    f"{self.config.queue_timeout_ms})"))
+            self.metrics.record_timeout(len(expired))
+            batch = [r for r in batch if not r.expired(now)]
+            if not batch:
+                return
         n = len(batch)
         with self.stages.stage("stack"):
             i1 = np.stack([r.image1 for r in batch])

@@ -120,6 +120,7 @@ class ServingMetrics:
         self.rejected = 0          # backlog-full / closed rejections
         self.responses = 0         # futures resolved with a result
         self.errors = 0            # futures resolved with an exception
+        self.timeouts = 0          # futures resolved with RequestTimedOut
         self.batches = 0
         self.padded_slots = 0
         self.compiles = 0          # fresh XLA compiles on the serve path
@@ -160,6 +161,14 @@ class ServingMetrics:
             self.errors += n
             self._t_last = time.perf_counter()
 
+    def record_timeout(self, n: int = 1) -> None:
+        """Requests whose queue-timeout deadline expired before
+        dispatch. Counted separately from ``errors``: a timeout is the
+        shedding policy working, not the engine failing."""
+        with self._lock:
+            self.timeouts += n
+            self._t_last = time.perf_counter()
+
     # -- reading --------------------------------------------------------
 
     def latency_ms(self) -> Dict[str, float]:
@@ -195,6 +204,7 @@ class ServingMetrics:
                 "serving_rejected": float(self.rejected),
                 "serving_responses": float(self.responses),
                 "serving_errors": float(self.errors),
+                "serving_timeouts": float(self.timeouts),
                 "serving_batches": float(self.batches),
                 "serving_padded_slots": float(self.padded_slots),
                 "serving_compiles": float(self.compiles),
@@ -222,7 +232,8 @@ class ServingMetrics:
         hist = ", ".join(f"{k}:{v}" for k, v in
                          sorted(self.batch_histogram().items()))
         return (f"requests {self.requests} (rejected {self.rejected}) "
-                f"responses {self.responses} errors {self.errors} | "
+                f"responses {self.responses} errors {self.errors} "
+                f"timeouts {self.timeouts} | "
                 f"{self.throughput():.2f} req/s, mean batch "
                 f"{self.mean_batch_size():.2f} | latency ms p50 "
                 f"{lat['p50']:.1f} p95 {lat['p95']:.1f} p99 "

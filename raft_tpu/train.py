@@ -33,7 +33,7 @@ import threading
 from raft_tpu import checkpoint as ckpt_lib
 from raft_tpu import evaluate
 from raft_tpu.config import MODEL_FAMILIES, RAFTConfig, TrainConfig
-from raft_tpu.resilience import TrainingDiverged
+from raft_tpu.resilience import TrainingDiverged, all_hosts_agree
 from raft_tpu.models.raft import RAFT
 from raft_tpu.optim import make_schedule
 from raft_tpu.parallel import (create_train_state, make_mesh,
@@ -87,12 +87,11 @@ def _preemption_agreed(requested: bool) -> bool:
     diverging into the (collective) checkpoint save while another enters
     the step's collectives would deadlock the pod.  All hosts therefore
     vote at the SAME deterministic points (the caller schedules this by
-    step count) and stop iff ANY host saw the signal."""
-    if jax.process_count() == 1:
-        return requested
-    from jax.experimental import multihost_utils
-    return bool(multihost_utils.process_allgather(
-        np.asarray([requested])).any())
+    step count) and stop iff ANY host saw the signal. The vote itself is
+    :func:`raft_tpu.resilience.all_hosts_agree` — the same primitive
+    that drives checkpoint commit agreement (there with ``"all"``
+    semantics)."""
+    return all_hosts_agree(bool(requested), require="any")
 
 
 def _eval_variables(state):
@@ -157,8 +156,13 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     run_ckpt_dir = os.path.join(ckpt_dir, tcfg.name)
     # ONE manager per run: saves stop re-scanning the directory and the
     # keep policy sees every save; saves retry transient I/O, restores
-    # fall back past truncated steps (raft_tpu/checkpoint.py).
-    ckptr = ckpt_lib.RunCheckpointer(run_ckpt_dir)
+    # fall back past truncated/uncommitted steps (raft_tpu/checkpoint.py).
+    # With async_checkpointing, save() only dispatches the write; the
+    # explicit wait_for_pending() barriers below (preemption, abort,
+    # exit — the next save point is covered by save() itself) are where
+    # the write is finalized and cross-host commit-voted.
+    ckptr = ckpt_lib.RunCheckpointer(run_ckpt_dir,
+                                     async_save=tcfg.async_checkpointing)
 
     with ckptr, mesh:
         state = create_train_state(rng, model, tcfg, tcfg.image_size,
@@ -216,6 +220,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                     if total_steps % check_every == 0 and \
                             _preemption_agreed(guard.requested):
                         ckptr.save(state)
+                        ckptr.wait_for_pending()   # commit before exit
                         print(f"preemption checkpoint at step "
                               f"{total_steps}; resume with --resume")
                         return state
@@ -245,6 +250,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         # one; persistent divergence needs an operator,
                         # not more poisoned batches.
                         ckptr.save(state)
+                        ckptr.wait_for_pending()   # commit before abort
                         raise TrainingDiverged(
                             f"{consecutive_skips} consecutive non-finite "
                             f"steps at step {total_steps}; checkpointed "
@@ -289,6 +295,10 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         # boundary). The val checkpoint above already
                         # holds this exact state.
                         if _preemption_agreed(guard.requested):
+                            # The val checkpoint above may still be in
+                            # flight (async mode): commit it so resume
+                            # sees this exact step.
+                            ckptr.wait_for_pending()
                             print(f"preemption after validation at step "
                                   f"{total_steps}; resume with --resume")
                             return state
@@ -298,6 +308,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         break
 
         ckptr.save(state)
+        ckptr.wait_for_pending()       # exit barrier: final save commits
     return state
 
 
@@ -391,6 +402,14 @@ def main(argv=None):
                              "canonical family only, must divide the "
                              "device count and the image height)")
     parser.add_argument("--val_freq", type=int, default=5000)
+    parser.add_argument("--async_ckpt", action="store_true",
+                        help="non-blocking checkpointing: saves "
+                             "dispatch the orbax write and training "
+                             "keeps stepping; the write is finalized + "
+                             "cross-host commit-voted at the next save "
+                             "point / preemption / abort / exit "
+                             "barrier (hides multi-second save latency "
+                             "on big models)")
     parser.add_argument("--corr_impl", default=None,
                         choices=["fixed", "auto"],
                         help="correlation engine for canonical-RAFT "
@@ -443,7 +462,8 @@ def main(argv=None):
         image_size=tuple(args.image_size), wdecay=args.wdecay,
         epsilon=args.epsilon, clip=args.clip, gamma=args.gamma,
         add_noise=args.add_noise, iters=iters,
-        val_freq=args.val_freq, scheduler=args.scheduler, seed=args.seed)
+        val_freq=args.val_freq, scheduler=args.scheduler, seed=args.seed,
+        async_checkpointing=args.async_ckpt)
     mcfg = RAFTConfig(
         small=args.small, dropout=args.dropout, iters=iters,
         alternate_corr=alternate,

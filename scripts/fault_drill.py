@@ -13,16 +13,27 @@ loop with each fault class injected in sequence —
 4. a NaN batch                           -> the step is skipped, params
    stay finite, the skip is counted;
 5. preemption (guard flag)               -> clean checkpoint, resume
-   continues from the exact step.
+   continues from the exact step;
+6. async save (``--drill async-save``)   -> dispatch is non-blocking,
+   the in-flight step is invisible to restore until the barrier
+   commits it, an injected commit failure rolls the step back;
+7. multi-host save (``--drill multihost-save``) -> two coordinated
+   processes share a checkpoint dir; a targeted injection kills ONE
+   host's save commit check and BOTH hosts must roll the step back,
+   agree on the older committed step, and restore bit-identical state
+   (the torn-step invariant).
 
-Exits nonzero if any recovery path fails. Usage::
+Exits nonzero if any recovery path fails (a torn step detected by the
+multi-host drill is a failure). Usage::
 
-    JAX_PLATFORMS=cpu python scripts/fault_drill.py
+    JAX_PLATFORMS=cpu python scripts/fault_drill.py [--drill NAME]
 """
 
+import argparse
 import os
 import sys
 import tempfile
+import textwrap
 import traceback
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -214,6 +225,239 @@ def drill_preemption_resume(root):
     assert _finite(state2)
 
 
+class _TinyState:
+    """Minimal checkpointable state for direct RunCheckpointer drills
+    (no training loop needed — save/restore only touch the four array
+    fields)."""
+
+    def __init__(self, step):
+        self.step = jnp.asarray(step, jnp.int32)
+        self.params = {"w": jnp.arange(8, dtype=jnp.float32) * step}
+        self.batch_stats = {}
+        self.opt_state = {"m": jnp.zeros(8, jnp.float32)}
+
+    def replace(self, **kw):
+        import copy
+        s = copy.copy(self)
+        for k, v in kw.items():
+            setattr(s, k, v)
+        return s
+
+
+def drill_async_save(root):
+    """Async saves: non-blocking dispatch, commit gating of the
+    in-flight step, rollback + resume on an injected commit failure,
+    and the train loop's exit barrier."""
+    # Integration: the train loop with async checkpointing on completes
+    # and its exit barrier commits the final save.
+    tcfg, mcfg = _cfg(num_steps=2, async_checkpointing=True)
+    state = _run(tcfg, mcfg, root)
+    d = os.path.join(root, "ckpts", "drill")
+    assert int(state.step) == 2, f"run did not complete: {int(state.step)}"
+    assert ckpt_lib.latest_step(d) == 2, "exit barrier did not commit"
+    assert _finite(state)
+
+    # Direct: dispatch returns immediately and the in-flight step is
+    # invisible until the barrier commits it.
+    d2 = os.path.join(root, "direct")
+    c = ckpt_lib.RunCheckpointer(d2, async_save=True, save_retries=1,
+                                 retry_delay=0.05)
+    c.save(_TinyState(1))
+    assert c.pending_step == 1, "async save did not stay pending"
+    assert c.latest_step() is None, "uncommitted step visible"
+    st = c.restore(_TinyState(0))
+    assert int(st.step) == 0, "restore observed the in-flight step"
+    c.wait_for_pending()
+    assert c.latest_step() == 1, "barrier did not commit"
+
+    # Non-blocking proof: dispatch must defer the whole finalize +
+    # vote + commit routine to the barrier — the loop keeps stepping
+    # (simulated below) while the write runs in background threads.
+    finalizes = []
+    orig_fin = c._save_with_agreement
+    c._save_with_agreement = lambda *a, **kw: (finalizes.append(1),
+                                               orig_fin(*a, **kw))[1]
+    c.save(_TinyState(2))
+    assert not finalizes, "async dispatch ran the finalize inline"
+    assert c.pending_step == 2
+    work = sum(float(jnp.sum(jnp.ones(64) * i)) for i in range(16))
+    assert work > 0                     # steps ran while save in flight
+    c.wait_for_pending()
+    assert finalizes, "barrier did not finalize"
+    c._save_with_agreement = orig_fin
+    assert c.latest_step() == 2
+
+    # Injected commit failure past the retry budget: the barrier
+    # raises, the torn step is rolled back, resume sees the older one.
+    set_injector(FaultInjector(ckpt_commit_errors=8))
+    c.save(_TinyState(3))
+    try:
+        c.wait_for_pending()
+    except OSError:
+        pass
+    else:
+        raise AssertionError("commit failure did not surface")
+    set_injector(None)
+    assert c.latest_step() == 2, \
+        f"torn step visible: latest={c.latest_step()}"
+    assert not os.path.isdir(os.path.join(d2, "3")), \
+        "failed step dir not rolled back"
+    st = c.restore(_TinyState(0))
+    assert int(st.step) == 2, "resume did not use the committed step"
+    c.close()
+
+
+_MULTIHOST_CHILD = textwrap.dedent("""
+    import hashlib, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ""          # drop inherited topology flags
+    os.environ["COORDINATOR_ADDRESS"] = "localhost:%(port)d"
+    # Targeted injection, described the way CI would: host 1's commit
+    # health check fails past the retry budget (the mid-save host-death
+    # simulation); host 0 stays healthy.
+    os.environ["RAFT_FAULT_CKPT_COMMIT_ERRORS"] = "8"
+    os.environ["RAFT_FAULT_TARGET_PROCESS"] = "1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1])
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from raft_tpu.parallel.distributed import init_distributed
+    init_distributed(num_processes=2, process_id=pid)
+
+    from raft_tpu import checkpoint as ckpt_lib
+    from raft_tpu.resilience import (CheckpointCommitError, FaultInjector,
+                                     set_injector)
+
+    root = %(root)r
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def garr(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, rep,
+                                            lambda idx: x[idx])
+
+    class TinyState:
+        def __init__(self, step):
+            self.step = garr(np.int32(step))
+            self.params = {"w": garr(
+                np.arange(8, dtype=np.float32) * step)}
+            self.batch_stats = {}
+            self.opt_state = {"m": garr(np.zeros(8, np.float32))}
+        def replace(self, **kw):
+            import copy
+            s = copy.copy(self)
+            for k, v in kw.items():
+                setattr(s, k, v)
+            return s
+
+    out = {"pid": pid}
+    c = ckpt_lib.RunCheckpointer(root, save_retries=1, retry_delay=0.05)
+    set_injector(FaultInjector())         # baseline save is clean
+    c.save(TinyState(1))
+    out["baseline_latest"] = c.latest_step()
+
+    # Arm the env-described injection (exercises from_env + targeting).
+    set_injector(FaultInjector.from_env())
+    torn = False
+    try:
+        c.save(TinyState(2))
+    except CheckpointCommitError:
+        torn = True
+    out["commit_error_raised"] = torn
+    set_injector(FaultInjector())
+
+    out["latest_after_tear"] = c.latest_step()
+    out["step2_dir_absent"] = not os.path.isdir(
+        os.path.join(root, "2"))
+    st = c.restore(TinyState(0))
+    out["restored_step"] = int(jax.device_get(st.step))
+    w = np.asarray(jax.device_get(st.params["w"]))
+    out["restored_hash"] = hashlib.sha256(w.tobytes()).hexdigest()
+
+    # Transient one-host blip: one injected failure inside the retry
+    # budget — every host retries in lockstep and the step commits.
+    set_injector(FaultInjector(ckpt_commit_errors=1, target_process=1))
+    c.save(TinyState(3))
+    set_injector(FaultInjector())
+    out["latest_after_blip"] = c.latest_step()
+    c.close()
+    print("RESULT " + json.dumps(out), flush=True)
+""")
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _scaled_timeout(timeout: int) -> int:
+    try:
+        load = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+    except OSError:
+        load = 0.0
+    return int(timeout * (1.0 + min(3.0, max(0.0, load))))
+
+
+def drill_multihost_save(root):
+    """Two coordinated processes, shared checkpoint dir, one host's
+    save killed by targeted injection: both hosts must roll the step
+    back, agree on the older committed step and restore bit-identical
+    state. A torn step (any host still seeing step 2) fails the drill."""
+    import json
+    import subprocess
+
+    repo_root = os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..")
+    ckpt_root = os.path.join(root, "shared_ckpts")
+    os.makedirs(ckpt_root, exist_ok=True)
+    code = _MULTIHOST_CHILD % {"port": _free_port(), "root": ckpt_root}
+    env = {**os.environ,
+           "PYTHONPATH": os.pathsep.join(
+               [os.path.abspath(repo_root),
+                os.environ.get("PYTHONPATH", "")]).rstrip(os.pathsep)}
+    env.pop("RAFT_FAULT_CKPT_COMMIT_ERRORS", None)
+    env.pop("RAFT_FAULT_TARGET_PROCESS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    results = {}
+    timeout = _scaled_timeout(300)
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(
+                "multihost drill child timed out (coordinator hang?)")
+        assert p.returncode == 0, f"child failed:\n{out[-3000:]}"
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out[-3000:]}"
+        r = json.loads(lines[-1][len("RESULT "):])
+        results[r["pid"]] = r
+    assert set(results) == {0, 1}, f"missing host: {set(results)}"
+    for pid, r in results.items():
+        assert r["baseline_latest"] == 1, (pid, r)
+        assert r["commit_error_raised"], \
+            f"host {pid} did not observe the commit failure"
+        assert r["latest_after_tear"] == 1, \
+            f"TORN STEP: host {pid} sees latest={r['latest_after_tear']}"
+        assert r["step2_dir_absent"], \
+            f"TORN STEP: failed step dir survived on host {pid}"
+        assert r["restored_step"] == 1, (pid, r)
+        assert r["latest_after_blip"] == 3, \
+            f"lockstep retry failed on host {pid}: {r}"
+    assert results[0]["restored_hash"] == results[1]["restored_hash"], \
+        "hosts restored DIFFERENT states from the same committed step"
+
+
 DRILLS = [
     drill_ckpt_io_errors,
     drill_corrupt_latest_checkpoint,
@@ -221,12 +465,26 @@ DRILLS = [
     drill_nan_batch,
     drill_nan_divergence_abort,
     drill_preemption_resume,
+    drill_async_save,
+    drill_multihost_save,
 ]
 
 
-def main() -> int:
+def _drill_name(fn) -> str:
+    return fn.__name__[len("drill_"):].replace("_", "-")
+
+
+def main(argv=None) -> int:
+    by_name = {_drill_name(fn): fn for fn in DRILLS}
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--drill", default="all",
+                    choices=["all", *by_name],
+                    help="run one drill (default: all)")
+    args = ap.parse_args(argv)
+    selected = DRILLS if args.drill == "all" else [by_name[args.drill]]
+
     failures = 0
-    for drill in DRILLS:
+    for drill in selected:
         name = drill.__name__
         set_injector(None)
         with tempfile.TemporaryDirectory(prefix=f"{name}_") as root:
@@ -241,7 +499,7 @@ def main() -> int:
                 print(f"PASS {name}", flush=True)
             finally:
                 set_injector(None)
-    print(f"\n{len(DRILLS) - failures}/{len(DRILLS)} drills passed",
+    print(f"\n{len(selected) - failures}/{len(selected)} drills passed",
           flush=True)
     return 1 if failures else 0
 

@@ -66,6 +66,22 @@ CHILD_TRAIN = _PRELUDE + textwrap.dedent("""
     from raft_tpu.parallel import make_mesh
     from test_multiprocess import run_one_step
 
+    # Cheap capability probe BEFORE the expensive model compile: some
+    # jaxlib builds (this container's CPU backend) cannot run
+    # cross-process XLA computations at all — the host-side machinery
+    # (coordination-service votes, KV gathers, orbax barriers) still
+    # works there, but a sharded train step cannot. Report honestly
+    # and let the parent skip.
+    try:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("capability probe")
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            print("RESULT " + json.dumps(
+                {"pid": pid, "unsupported": True}), flush=True)
+            sys.exit(0)
+        raise
+
     mesh = make_mesh()                      # 2 global devices, 1/process
     assert mesh.devices.size == 2, mesh.devices
     with mesh:
@@ -189,6 +205,10 @@ def test_two_process_sharded_train_step():
     import numpy as np
 
     results = _run_children(CHILD_TRAIN, timeout=_scaled(420))
+    if any(r.get("unsupported") for r in results.values()):
+        pytest.skip("jaxlib backend lacks cross-process XLA computations "
+                    "(CPU multiprocess); host-side distributed machinery "
+                    "is covered by test_two_process_distributed_helpers")
     assert results[0]["step"] == results[1]["step"] == 1
     # replicated metrics: both hosts computed the same global loss
     assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6

@@ -94,6 +94,21 @@ def test_stall_watchdog_quiet_when_petted():
     assert msgs == []
 
 
+def test_stall_watchdog_close_idempotent_and_pet_noop_after_close():
+    """Double close is safe, and a late pet from a draining producer
+    thread must not re-arm a timer after teardown."""
+    msgs = []
+    wd = StallWatchdog(0.05, lambda: "diag", sink=msgs.append)
+    wd.pet()
+    assert wd._timer is not None and wd._timer.daemon
+    wd.close()
+    wd.close()                      # idempotent
+    wd.pet()                        # no-op: must not re-arm
+    assert wd._timer is None
+    time.sleep(0.15)
+    assert wd.fired == 0 and msgs == []
+
+
 def test_fault_injector_from_env(monkeypatch):
     monkeypatch.setenv("RAFT_FAULT_CKPT_SAVE_ERRORS", "2")
     monkeypatch.setenv("RAFT_FAULT_CORRUPT_SAMPLES", "3, 17")
@@ -104,6 +119,28 @@ def test_fault_injector_from_env(monkeypatch):
     assert inj.nan_loss_steps == (5,)
     assert inj.active
     assert not FaultInjector().active
+
+
+def test_fault_injector_commit_errors_and_process_targeting(monkeypatch):
+    monkeypatch.setenv("RAFT_FAULT_CKPT_COMMIT_ERRORS", "2")
+    monkeypatch.setenv("RAFT_FAULT_TARGET_PROCESS", "1")
+    inj = FaultInjector.from_env()
+    assert inj.ckpt_commit_errors == 2
+    assert inj.target_process == 1
+    assert inj.active
+
+    # This test runs as process 0: faults targeted at process 1 never
+    # fire here and their budget is not burned...
+    inj.maybe_fail_ckpt_commit()
+    inj.maybe_fail_ckpt_save()
+    assert inj.ckpt_commit_errors == 2
+    # ...while untargeted (or process-0-targeted) faults do fire.
+    on_me = FaultInjector(ckpt_commit_errors=1,
+                          target_process=jax.process_index())
+    with pytest.raises(OSError, match="injected checkpoint commit"):
+        on_me.maybe_fail_ckpt_commit()
+    assert on_me.ckpt_commit_errors == 0
+    on_me.maybe_fail_ckpt_commit()  # budget exhausted: silent
 
 
 # -- checkpoint hardening -----------------------------------------------
@@ -189,6 +226,138 @@ def test_restore_explicit_step_still_raises_on_corruption(tmp_path):
     _, _, fresh = _tiny_state(seed=1)
     with pytest.raises(Exception):
         ckpt_lib.restore_checkpoint(d, fresh, step=7)
+
+
+# -- async saves + commit agreement -------------------------------------
+
+
+class _FakeState:
+    """Minimal checkpointable state (mirrors the drill's ``_TinyState``)
+    — async/commit semantics don't depend on the state's size, and a
+    real RAFT state would dominate the runtime of every test here."""
+
+    def __init__(self, step):
+        self.step = jnp.asarray(step, jnp.int32)
+        self.params = {"w": jnp.arange(8, dtype=jnp.float32) * step}
+        self.batch_stats = {}
+        self.opt_state = {"m": jnp.zeros(8, jnp.float32)}
+
+    def replace(self, **kw):
+        import copy
+        s = copy.copy(self)
+        for k, v in kw.items():
+            setattr(s, k, v)
+        return s
+
+
+def test_async_save_gates_commit_and_restores_during_pending(tmp_path):
+    """The in-flight async step is invisible to latest/restore until
+    the wait_for_pending barrier commits it (satellite d)."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d, async_save=True) as c:
+        # First save in flight, nothing committed yet: a restore during
+        # the pending save returns the caller's state unchanged.
+        c.save(_FakeState(1))
+        assert c.pending_step == 1
+        assert c.latest_step() is None
+        probe = _FakeState(0)
+        assert ckpt_lib.restore_checkpoint(d, probe) is probe
+        c.wait_for_pending()
+        assert c.pending_step is None and c.latest_step() == 1
+
+        c.save(_FakeState(2))
+        assert c.pending_step == 2
+        # Both this manager and a fresh reader see only the committed
+        # step while 2 is in flight.
+        assert c.latest_step() == 1
+        assert ckpt_lib.latest_step(d) == 1
+        got = ckpt_lib.restore_checkpoint(d, _FakeState(0))
+        assert int(got.step) == 1
+        np.testing.assert_array_equal(np.asarray(got.params["w"]),
+                                      np.arange(8, dtype=np.float32))
+        c.wait_for_pending()
+        assert c.latest_step() == 2
+    assert ckpt_lib.latest_step(d) == 2
+
+
+def test_async_save_dispatch_does_not_finalize_inline(tmp_path):
+    """``save`` in async mode only dispatches: the finalize/vote/commit
+    path (``_save_with_agreement``) runs at the barrier, not inline."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d, async_save=True) as c:
+        calls = []
+        orig = c._save_with_agreement
+        c._save_with_agreement = \
+            lambda *a, **kw: (calls.append(a[0]), orig(*a, **kw))[1]
+        c.save(_FakeState(1))
+        assert calls == []          # dispatch returned without finalizing
+        c.wait_for_pending()
+        assert calls == [1]         # the barrier did
+        c.wait_for_pending()
+        assert calls == [1]         # idempotent: nothing pending
+
+
+def test_async_commit_failure_rolls_back_to_older_step(tmp_path):
+    """A host that dies between its write and its vote (injected commit
+    failure outlasting the retry budget) must not leave a torn step:
+    the step dir is rolled back and restore lands on the previous
+    committed step."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d, async_save=True, save_retries=1,
+                                  retry_delay=0.001) as c:
+        c.save(_FakeState(1))
+        c.wait_for_pending()        # baseline commit
+        set_injector(FaultInjector(ckpt_commit_errors=8))
+        c.save(_FakeState(2))       # dispatch succeeds (write-side OK)
+        with pytest.raises(OSError, match="injected checkpoint commit"):
+            c.wait_for_pending()
+        set_injector(FaultInjector())
+        assert not os.path.isdir(os.path.join(d, "2"))   # rolled back
+        assert c.latest_step() == 1
+    got = ckpt_lib.restore_checkpoint(d, _FakeState(0))
+    assert int(got.step) == 1
+
+
+def test_uncommitted_step_invisible_to_fresh_reader(tmp_path):
+    """Commit gating is honored by readers that never saw the writer:
+    a step present on disk but absent from ``commit.json`` (vote-failed
+    leftover on another host, in-flight save) is skipped."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1))
+        c.save(_FakeState(2))
+    record = os.path.join(d, "commit.json")
+    assert json.load(open(record))["committed"] == [1, 2]
+    json.dump({"committed": [1]}, open(record, "w"))
+    assert ckpt_lib.latest_step(d) == 1
+    got = ckpt_lib.restore_checkpoint(d, _FakeState(0))
+    assert int(got.step) == 1
+    # Explicit-step restore stays exact: the caller asked for 2.
+    got2 = ckpt_lib.restore_checkpoint(d, _FakeState(0), step=2)
+    assert int(got2.step) == 2
+
+
+def test_sync_and_async_saves_agree_on_disk(tmp_path):
+    """Async mode changes *when* a step is finalized, not *what* is
+    saved: both modes leave a committed, structurally intact step whose
+    restore is bit-identical. (Exact file lists can't be compared —
+    ocdbt names data files per write.)"""
+    ds, da = str(tmp_path / "sync"), str(tmp_path / "async")
+    with ckpt_lib.RunCheckpointer(ds) as c:
+        c.save(_FakeState(3))
+    with ckpt_lib.RunCheckpointer(da, async_save=True) as c:
+        c.save(_FakeState(3))       # finalized by close()'s barrier
+
+    for d in (ds, da):
+        assert ckpt_lib._step_intact(d, 3)
+        assert json.load(open(os.path.join(
+            d, "commit.json")))["committed"] == [3]
+    rs = ckpt_lib.restore_checkpoint(ds, _FakeState(0))
+    ra = ckpt_lib.restore_checkpoint(da, _FakeState(0))
+    assert int(rs.step) == int(ra.step) == 3
+    for a, b in zip(jax.tree.leaves(ckpt_lib._arrays_of(rs)),
+                    jax.tree.leaves(ckpt_lib._arrays_of(ra))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 # -- non-finite step guard ----------------------------------------------

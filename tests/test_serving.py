@@ -281,6 +281,61 @@ class TestServingEngine:
         finally:
             eng.close()
 
+    def test_queue_timeout_expires_stale_requests(self, predictor,
+                                                  frames_and_refs):
+        """A request whose time-in-queue budget expires before dispatch
+        completes with RequestTimedOut (clear, fast shedding), is
+        counted in metrics, and never reaches the device."""
+        from raft_tpu.serving.batcher import RequestTimedOut
+
+        frames, _ = frames_and_refs
+        # Batching deadline (300 ms) far past the per-request budget
+        # (50 ms): the lone request is guaranteed expired when its
+        # bucket finally closes.
+        eng = _engine(predictor, max_batch=8, max_wait_ms=300.0,
+                      queue_timeout_ms=50.0)
+        eng.start(warmup=False)
+        try:
+            fut = eng.submit(*frames[0])
+            with pytest.raises(RequestTimedOut, match="in queue"):
+                fut.result(timeout=30)
+            assert eng.metrics.timeouts == 1
+            assert eng.metrics.errors == 0      # shedding is not failure
+            assert eng.metrics.responses == 0
+            snap = eng.metrics.snapshot()
+            assert snap["serving_timeouts"] == 1.0
+            assert "timeouts 1" in eng.metrics.report()
+        finally:
+            eng.close()
+
+    def test_queue_timeout_spares_live_requests(self, predictor,
+                                                frames_and_refs):
+        """Only the expired requests in a closing batch are shed; the
+        rest still serve, bit-equal to the direct call."""
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=5.0,
+                      queue_timeout_ms=60_000.0)
+        eng.start(warmup=False)
+        try:
+            fut = eng.submit(*frames[0])
+            assert np.array_equal(fut.result(timeout=120), refs[0])
+            assert eng.metrics.timeouts == 0
+        finally:
+            eng.close()
+
+    def test_queue_timeout_disabled_by_default(self, predictor,
+                                               frames_and_refs):
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=2, max_wait_ms=5.0)
+        assert eng.config.queue_timeout_ms is None
+        eng.start(warmup=False)
+        try:
+            fut = eng.submit(*frames[0])
+            fut.result(timeout=120)             # no deadline attached
+            assert eng.metrics.timeouts == 0
+        finally:
+            eng.close()
+
     def test_mismatched_frame_shapes_rejected(self, predictor,
                                               frames_and_refs):
         frames, _ = frames_and_refs
