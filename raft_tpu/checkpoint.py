@@ -35,6 +35,17 @@ Fault tolerance (multi-day preemptible-pod runs):
   is deleted, the vote result is global so no host diverges) instead of
   leaving a torn checkpoint; retries exhausted raises
   :class:`~raft_tpu.resilience.CheckpointCommitError` on every host.
+* **Input-pipeline state rides the step**: ``save(state, loader_state=…)``
+  writes each process's data-loader cursor as a
+  ``loader_state_p<rank>.json`` sidecar inside the step directory,
+  after the orbax finalize and before the commit vote — params and
+  cursor commit (or roll back) as one atomic unit. ``loader_state(step)``
+  reads it back; ``None`` for old-format checkpoints.
+* **Startup GC** (``gc_orphans=True`` — the run-owning checkpointer
+  only, never read-only helpers): step dirs absent from ``commit.json``
+  and stray orbax tmp dirs are deleted at init, so crashed saves don't
+  accumulate dirt. Legacy directories (no commit record) are left
+  untouched.
 * ``restore``/``latest_step`` fall back to the newest *committed,
   intact* step: uncommitted steps (in-flight async saves, vote-failed
   leftovers) are invisible, obviously-truncated step dirs (zero-byte
@@ -68,6 +79,15 @@ if not logging.getLogger().handlers and not logger.handlers:
     logger.setLevel(logging.INFO)
 
 _COMMIT_FILE = "commit.json"
+
+
+def _loader_state_file(ckpt_dir: str, step: int,
+                       process_index: int) -> str:
+    """Per-process input-pipeline sidecar inside the step directory —
+    it lives and dies with the step (committed together, rolled back
+    together, GC'd together)."""
+    return os.path.join(os.path.abspath(ckpt_dir), str(step),
+                        f"loader_state_p{process_index}.json")
 
 
 def _manager(ckpt_dir: str, max_to_keep: Optional[int] = None):
@@ -159,15 +179,22 @@ class RunCheckpointer:
 
     def __init__(self, ckpt_dir: str, keep: int = 5,
                  save_retries: int = 3, retry_delay: float = 0.5,
-                 async_save: bool = False):
+                 async_save: bool = False, gc_orphans: bool = False):
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         self.save_retries = save_retries
         self.retry_delay = retry_delay
         self.async_save = async_save
+        if gc_orphans:
+            # Only the run's OWNING checkpointer may GC: a read-only
+            # helper (latest_step(), a drill inspector) constructed
+            # while another process has an in-flight async save would
+            # otherwise delete that not-yet-committed step.
+            self._gc_orphaned_steps()
         self._mngr = _manager(self.ckpt_dir, keep)
-        # (step, arrays, first_exc, first_dispatched) of the in-flight
-        # async save; holding `arrays` keeps the state alive for a
-        # synchronous re-save if the background write has to be retried.
+        # (step, arrays, loader_state, first_exc, first_dispatched) of
+        # the in-flight async save; holding `arrays` keeps the state
+        # alive for a synchronous re-save if the background write has
+        # to be retried.
         self._pending = None
         if async_save and _read_committed(self.ckpt_dir) is None:
             # Establish commit gating up front: without a record, a
@@ -187,10 +214,50 @@ class RunCheckpointer:
         """Step of the dispatched-but-uncommitted async save, if any."""
         return self._pending[0] if self._pending is not None else None
 
+    # -- startup GC ------------------------------------------------------
+
+    def _gc_orphaned_steps(self):
+        """Delete step directories absent from ``commit.json`` (torn or
+        vote-failed saves the crash interrupted before rollback) and
+        stray orbax tmp dirs. Legacy directories (no commit record) are
+        untouched — every intact step there is grandfathered as
+        restorable, so nothing is provably an orphan. Runs before the
+        manager is created so its directory scan never sees the dirt.
+        Returns the list of removed directory names."""
+        removed = []
+        committed = _read_committed(self.ckpt_dir)
+        if jax.process_index() == 0 and os.path.isdir(self.ckpt_dir):
+            for name in sorted(os.listdir(self.ckpt_dir)):
+                path = os.path.join(self.ckpt_dir, name)
+                if not os.path.isdir(path):
+                    continue
+                orphan = (".orbax-checkpoint-tmp-" in name or
+                          (committed is not None and name.isdigit()
+                           and int(name) not in committed))
+                if orphan:
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed.append(name)
+            if removed:
+                logger.info(
+                    "checkpoint GC removed %d orphaned (uncommitted) "
+                    "step dir(s) from %s: %s", len(removed),
+                    self.ckpt_dir, ", ".join(removed))
+        if jax.process_count() > 1:
+            # Unconditional fence — every host must burn the same vote
+            # sequence number whether or not anything was removed.
+            all_hosts_agree(True)
+        return removed
+
     # -- save ------------------------------------------------------------
 
-    def save(self, state) -> None:
+    def save(self, state, loader_state=None) -> None:
         """Save ``state`` under its current step number.
+
+        ``loader_state`` (a :class:`~raft_tpu.data.datasets.LoaderState`
+        or its dict form) is written as a per-process sidecar *inside*
+        the step directory — it participates in the commit vote and is
+        rolled back with the step, so params and input-pipeline cursor
+        are one atomic unit.
 
         Synchronous mode: write, retry transient I/O with exponential
         backoff (vote-coordinated on multi-host), commit, return.
@@ -201,8 +268,10 @@ class RunCheckpointer:
         self.wait_for_pending()
         step = int(jax.device_get(state.step))
         arrays = _arrays_of(state)
+        if loader_state is not None and hasattr(loader_state, "to_dict"):
+            loader_state = loader_state.to_dict()
         if not self.async_save:
-            self._save_with_agreement(step, arrays)
+            self._save_with_agreement(step, arrays, loader_state)
             return
 
         # Async dispatch. The injection hook and (on multi-host) a
@@ -235,7 +304,8 @@ class RunCheckpointer:
                     # crash, not a degradation.
                     raise
                 first_exc = e
-        self._pending = (step, arrays, first_exc, dispatched)
+        self._pending = (step, arrays, loader_state, first_exc,
+                         dispatched)
 
     def wait_for_pending(self) -> None:
         """Barrier: finalize, vote on and commit the in-flight async
@@ -245,12 +315,13 @@ class RunCheckpointer:
         the save failed everywhere or failed cross-host agreement."""
         if self._pending is None:
             return
-        step, arrays, first_exc, dispatched = self._pending
+        step, arrays, loader_state, first_exc, dispatched = self._pending
         self._pending = None
-        self._save_with_agreement(step, arrays, first_exc=first_exc,
+        self._save_with_agreement(step, arrays, loader_state,
+                                  first_exc=first_exc,
                                   first_dispatched=dispatched)
 
-    def _attempt(self, step: int, arrays: dict,
+    def _attempt(self, step: int, arrays: dict, loader_state,
                  exc: Optional[Exception],
                  dispatched: bool) -> Optional[Exception]:
         """One save attempt on this host; returns None on local
@@ -278,6 +349,17 @@ class RunCheckpointer:
                                 args=ocp.args.StandardSave(arrays))
             self._mngr.wait_until_finished()
             self._mngr.check_for_errors()
+            # The input-pipeline sidecar goes into the finalized step
+            # dir on every host (per-process shard cursor), BEFORE the
+            # commit vote: a host dying here leaves a torn step that
+            # the vote rolls back, sidecar included.
+            if loader_state is not None:
+                path = _loader_state_file(self.ckpt_dir, step,
+                                          jax.process_index())
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(loader_state, f)
+                os.replace(tmp, path)
             # Post-write health check: data is durable on disk here;
             # an injected failure models a host dying between its write
             # and its vote (the torn-step scenario).
@@ -287,6 +369,7 @@ class RunCheckpointer:
         return None
 
     def _save_with_agreement(self, step: int, arrays: dict,
+                             loader_state=None,
                              first_exc: Optional[Exception] = None,
                              first_dispatched: bool = False) -> None:
         """The coordinated attempt loop: try, vote, commit-or-rollback,
@@ -294,7 +377,7 @@ class RunCheckpointer:
         retries (and sleeps, and gives up) in lockstep."""
         last_exc: Optional[Exception] = None
         for attempt in range(self.save_retries + 1):
-            exc = self._attempt(step, arrays,
+            exc = self._attempt(step, arrays, loader_state,
                                 exc=first_exc if attempt == 0 else None,
                                 dispatched=(first_dispatched
                                             and attempt == 0))
@@ -366,6 +449,27 @@ class RunCheckpointer:
 
     def all_steps(self):
         return sorted(int(s) for s in self._mngr.all_steps())
+
+    def loader_state(self, step: int,
+                     process_index: Optional[int] = None
+                     ) -> Optional[dict]:
+        """This process's input-pipeline state saved with ``step``, as
+        a dict, or ``None`` when the step predates loader-state capture
+        (old checkpoint format) — callers log a warning and fall back
+        to epoch-start replay."""
+        if process_index is None:
+            process_index = jax.process_index()
+        path = _loader_state_file(self.ckpt_dir, step, process_index)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            logger.warning(
+                "loader state %s unreadable (%s: %s); resuming without "
+                "an input-pipeline cursor", path, type(e).__name__, e)
+            return None
 
     def _candidate_steps(self):
         """Steps eligible for restore, newest first: committed (when a

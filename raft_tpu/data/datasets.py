@@ -11,10 +11,23 @@ Batches are NHWC numpy dicts (``image1/image2`` float32 [0,255], ``flow``,
 ``valid``) — the TPU-facing layout; ``device_put`` / ``shard_batch`` happens
 in the train loop. Batching is done by a thread-pool prefetcher
 (:class:`DataLoader`) instead of torch's fork-based workers.
+
+Crash consistency: both loaders own a serializable :class:`LoaderState`
+(seed, epoch, sample cursor within the epoch's permutation, resilience
+counters). Iteration consumes the deterministic epoch order from an
+explicit cursor — advanced when a batch is *yielded to the consumer*,
+never at pump-fill time, so the prefetch depth is invisible to the
+cursor — and ``state()``/``load_state()`` round-trip it through the
+checkpoint layer (:meth:`raft_tpu.checkpoint.RunCheckpointer.save`).
+Restoring mid-iteration drains the in-flight prefetch pump: the live
+iterator stops at its next batch boundary and the next iteration
+rebuilds the pump from the restored cursor, so no consumed-but-unstepped
+batch is replayed or dropped.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import os.path as osp
 import random
@@ -45,15 +58,18 @@ def _read_sample(dataset, index: int, retries: int = 2,
     ``(index + k) % len`` for ``k = 1, 2, ...`` — when the sample is
     truly unreadable (one corrupt PNG must cost one logged substitution,
     not the epoch: the reference's ``f.result()`` re-raise would kill
-    the run). Returns ``(sample, n_substituted)`` where
+    the run). Returns ``(sample, n_substituted, n_retried)`` where
     ``n_substituted`` is how many indices were skipped (0 on the normal
-    path). Raises only when ``max_substitutions + 1`` consecutive
-    indices are all unreadable — at that point the dataset, not a
-    sample, is broken.
+    path) and ``n_retried`` how many read attempts failed transiently
+    before one succeeded (both feed :class:`~raft_tpu.resilience
+    .ResilienceStats`). Raises only when ``max_substitutions + 1``
+    consecutive indices are all unreadable — at that point the dataset,
+    not a sample, is broken.
     """
     n = len(dataset)
     idx = int(index)
     last_err = None
+    retried = 0
     for k in range(max_substitutions + 1):
         cand = (idx + k) % n
 
@@ -61,15 +77,20 @@ def _read_sample(dataset, index: int, retries: int = 2,
             active_injector().maybe_fail_sample(cand)
             return dataset[cand]
 
+        def _count_retry(attempt, exc):
+            nonlocal retried
+            retried += 1
+
         try:
             sample = retry_with_backoff(
                 _once, retries=retries, base_delay=base_delay,
                 retry_on=_TRANSIENT_READ_ERRORS,
-                describe=f"sample read (index {cand})")
+                describe=f"sample read (index {cand})",
+                on_retry=_count_retry)
             if k:
                 print(f"WARNING: sample {idx} unreadable; substituted "
                       f"index {cand} ({last_err})", flush=True)
-            return sample, k
+            return sample, k, retried
         except _TRANSIENT_READ_ERRORS as e:
             last_err = e
     raise RuntimeError(
@@ -341,12 +362,52 @@ class HD1K(FlowDataset):
             seq_ix += 1
 
 
+@dataclasses.dataclass
+class LoaderState:
+    """Serializable input-pipeline state — the unit the checkpoint layer
+    saves inside each commit-gated step directory.
+
+    ``seed``/``epoch`` pin the deterministic permutation
+    (``default_rng(seed + epoch)``); ``pos`` is the sample cursor within
+    that permutation, counted in *yielded-to-the-consumer* samples (a
+    multiple of the batch size — prefetched-but-unyielded batches are
+    not consumed). The resilience counters ride along so a resumed
+    run's degradation totals continue instead of resetting to zero.
+    """
+
+    seed: int
+    epoch: int
+    pos: int
+    substituted_samples: int = 0
+    sample_retries: int = 0
+    worker_timeouts: int = 0
+
+    def to_dict(self) -> dict:
+        return {k: int(v) for k, v in dataclasses.asdict(self).items()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoaderState":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            print(f"WARNING: ignoring unknown loader-state fields "
+                  f"{sorted(unknown)} (newer writer?)", flush=True)
+        return cls(**{k: int(v) for k, v in d.items() if k in known})
+
+
 class DataLoader:
     """Thread-pool prefetching batch loader.
 
     Replaces torch ``DataLoader(num_workers=24, pin_memory, drop_last)``
     (reference ``core/datasets.py:236-237``): worker threads read+augment
     samples ahead of the train loop; batches are stacked NHWC numpy dicts.
+
+    One ``__iter__`` pass yields the *remainder* of the current epoch
+    from the cursor (the whole epoch on a fresh or epoch-aligned
+    loader); exhausting it advances ``epoch`` and resets the cursor, so
+    ``while True: for batch in loader`` walks epochs exactly as before.
+    Breaking out mid-epoch leaves the cursor at the last yielded batch
+    — :meth:`state` then names the exact next sample to be produced.
     """
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
@@ -361,6 +422,14 @@ class DataLoader:
         self.seed = seed
         self.prefetch = prefetch
         self.epoch = 0
+        # Sample cursor within the current epoch's permutation: counts
+        # samples YIELDED to the consumer (always a multiple of
+        # batch_size), never samples merely submitted to the pump.
+        self._pos = 0
+        # Bumped by load_state(): a live iterator from before the
+        # restore notices at its next batch boundary and drains instead
+        # of yielding stale pre-restore batches.
+        self._generation = 0
         # Degradation counters for this loader (substituted samples);
         # the train loop streams them to the scalar sinks.
         self.stats = ResilienceStats()
@@ -377,39 +446,86 @@ class DataLoader:
         return n // self.batch_size if self.drop_last else \
             (n + self.batch_size - 1) // self.batch_size
 
+    # -- checkpointable state --------------------------------------------
+
+    def state(self) -> LoaderState:
+        """Snapshot of the input-pipeline cursor + resilience counters.
+
+        Call it when the *consumer* is at a quiescent point (the train
+        loop snapshots right after each optimizer step): ``pos`` then
+        equals the samples actually trained on, regardless of how far
+        ahead the prefetch pump has filled.
+        """
+        return LoaderState(
+            seed=int(self.seed), epoch=int(self.epoch),
+            pos=int(self._pos),
+            substituted_samples=int(self.stats.substituted_samples),
+            sample_retries=int(self.stats.sample_retries),
+            worker_timeouts=int(self.stats.worker_timeouts))
+
+    def load_state(self, state) -> None:
+        """Restore a :meth:`state` snapshot (``LoaderState`` or its
+        ``to_dict`` form). The next iteration resumes at exactly the
+        restored cursor; an iterator already in flight drains at its
+        next batch boundary (its pending prefetch futures are abandoned)
+        instead of yielding pre-restore batches.
+        """
+        if isinstance(state, dict):
+            state = LoaderState.from_dict(state)
+        if state.pos % self.batch_size:
+            raise ValueError(
+                f"loader cursor {state.pos} is not a multiple of "
+                f"batch_size={self.batch_size} — state saved by an "
+                f"incompatible run configuration")
+        self.seed = int(state.seed)
+        self.epoch = int(state.epoch)
+        self._pos = int(state.pos)
+        self.stats.substituted_samples = int(state.substituted_samples)
+        self.stats.sample_retries = int(state.sample_retries)
+        self.stats.worker_timeouts = int(state.worker_timeouts)
+        self._generation += 1   # drain any in-flight pump
+
     def _batches(self, order):
         bs = self.batch_size
         stop = len(order) - (len(order) % bs if self.drop_last else 0)
         for i in range(0, stop, bs):
             yield order[i:i + bs]
 
-    def _epoch_order(self):
-        rng = np.random.default_rng(self.seed + self.epoch)
-        epoch = self.epoch
-        self.epoch += 1
+    def _epoch_order(self, epoch: int):
+        """The deterministic permutation for ``epoch`` — a pure function
+        of (seed, epoch), so a restored cursor indexes the identical
+        order the interrupted run was consuming."""
+        rng = np.random.default_rng(self.seed + epoch)
         order = np.arange(len(self.dataset))
         if self.shuffle:
             rng.shuffle(order)
-        return order, epoch
+        return order
 
-    def _prefetch_loop(self, order, submit, result):
+    def _prefetch_loop(self, order, submit, result, start: int, gen: int):
         """Shared pump for both loader kinds: keep ``prefetch`` batches
         of per-sample futures in flight via ``submit(idx)``, drain in
-        order via ``result(fut)``, yield stacked NHWC batch dicts.
+        order via ``result(fut, sample_idx, batch_no)``, yield stacked
+        NHWC batch dicts starting at sample cursor ``start``.
 
-        ``result(fut)`` resolves to ``(sample, n_substituted)`` (see
-        :func:`_read_sample`); substitutions are accumulated into
-        ``self.stats``. A :class:`StallWatchdog` (``stall_timeout`` > 0)
-        is petted per yielded batch and prints a pump diagnostic when
-        production stops.
+        ``result(...)`` resolves to ``(sample, n_substituted,
+        n_retried)`` (see :func:`_read_sample`); both counters are
+        accumulated into ``self.stats``. ``self._pos`` advances to the
+        end of each batch immediately before it is yielded, and a
+        ``load_state`` during iteration (generation mismatch against
+        ``gen``) drains the pump at the next batch boundary. A
+        :class:`StallWatchdog` (``stall_timeout`` > 0) is petted per
+        yielded batch and prints a pump diagnostic when production
+        stops.
         """
-        pending = []
         batches = list(self._batches(order))
-        k = 0
+        skip = start // self.batch_size
+        pending = []
+        k = skip
         yielded = 0
 
         def _diagnose():
-            return (f"{yielded}/{len(batches)} batches yielded, "
+            return (f"{yielded}/{len(batches) - skip} batches yielded "
+                    f"(epoch cursor {skip}+), "
                     f"{len(pending)} batch(es) of futures in flight, "
                     f"{self.num_workers} workers "
                     f"({type(self).__name__})")
@@ -421,21 +537,31 @@ class DataLoader:
             if watchdog is not None:
                 watchdog.pet()
             while k < len(batches) or pending:
+                if self._generation != gen:
+                    return          # restored mid-flight: drain the pump
                 while k < len(batches) and len(pending) < self.prefetch:
-                    pending.append([submit(i) for i in batches[k]])
+                    pending.append(
+                        (k, [(int(i), submit(i)) for i in batches[k]]))
                     k += 1
+                batch_no, futures = pending.pop(0)
                 samples = []
-                for f in pending.pop(0):
-                    sample, subs = result(f)
+                for idx, f in futures:
+                    sample, subs, retries = result(f, idx, batch_no)
                     if subs:
                         self.stats.count_substitution(subs)
+                    if retries:
+                        self.stats.count_sample_retries(retries)
                     samples.append(sample)
-                yield {
+                batch = {
                     "image1": np.stack([s[0] for s in samples]),
                     "image2": np.stack([s[1] for s in samples]),
                     "flow": np.stack([s[2] for s in samples]),
                     "valid": np.stack([s[3] for s in samples]),
                 }
+                # Cursor advances with the handoff: once the consumer
+                # holds this batch, state() reports it consumed.
+                self._pos = (batch_no + 1) * self.batch_size
+                yield batch
                 yielded += 1
                 if watchdog is not None:
                     watchdog.pet()
@@ -446,17 +572,23 @@ class DataLoader:
     def __iter__(self):
         from concurrent.futures import ThreadPoolExecutor
 
-        order, _ = self._epoch_order()
+        gen = self._generation
+        epoch = self.epoch
+        order = self._epoch_order(epoch)
 
         def load(idx):
-            (img1, img2, flow, valid), subs = _read_sample(
-                self.dataset, int(idx))
-            return (img1, img2, flow, valid), subs
+            return _read_sample(self.dataset, int(idx))
 
         with ThreadPoolExecutor(self.num_workers) as pool:
             yield from self._prefetch_loop(
                 order, lambda i: pool.submit(load, i),
-                lambda f: f.result())
+                lambda f, idx, batch_no: f.result(),
+                start=self._pos, gen=gen)
+        # Reached only on full exhaustion (a consumer break skips this,
+        # leaving the cursor mid-epoch; a load_state drain skips the
+        # advance via the generation check).
+        if self._generation == gen:
+            self.epoch, self._pos = epoch + 1, 0
 
 
 # Worker-process globals: set once per worker by the pool initializer
@@ -468,31 +600,43 @@ class DataLoader:
 _WORKER_DS = None
 _WORKER_WID = None
 _WORKER_STREAM = None     # (seed, epoch) the dataset is currently seeded for
+_WORKER_CLAIMS = None     # shared array: claims[wid] = sample idx in flight
 
 
-def _process_worker_init(dataset, counter):
-    global _WORKER_DS, _WORKER_WID, _WORKER_STREAM
+def _process_worker_init(dataset, counter, claims):
+    global _WORKER_DS, _WORKER_WID, _WORKER_STREAM, _WORKER_CLAIMS
     with counter.get_lock():
         _WORKER_WID = counter.value
         counter.value += 1
     _WORKER_DS = dataset
     _WORKER_STREAM = None
+    _WORKER_CLAIMS = claims
 
 
 def _process_worker_load(idx, seed, epoch):
     # Same fault-tolerant read path as the thread loader; the
-    # substitution count rides back to the parent in the result tuple
-    # (workers are separate processes — parent-side counters can't see
-    # their recoveries otherwise). The (seed, epoch) ride with every
-    # task so the long-lived worker reseeds itself on the first task of
-    # each new epoch — same (seed, epoch, worker_id) streams as the
-    # old fork-per-epoch design, without paying a pool restart.
+    # substitution/retry counts ride back to the parent in the result
+    # tuple (workers are separate processes — parent-side counters
+    # can't see their recoveries otherwise). The (seed, epoch) ride
+    # with every task so the long-lived worker reseeds itself on the
+    # first task of each new epoch — same (seed, epoch, worker_id)
+    # streams as the old fork-per-epoch design, without paying a pool
+    # restart.
     global _WORKER_STREAM
     if _WORKER_STREAM != (seed, epoch):
         _WORKER_DS.reseed((seed, epoch, _WORKER_WID))
         _WORKER_STREAM = (seed, epoch)
-    (i1, i2, fl, v), subs = _read_sample(_WORKER_DS, int(idx))
-    return (i1, i2, fl, v), subs
+    # Claim the sample in the shared array so the parent can name this
+    # worker if it dies mid-read (the claim survives the death; the
+    # result never arrives). Cleared on every normal return.
+    if _WORKER_CLAIMS is not None:
+        _WORKER_CLAIMS[_WORKER_WID] = int(idx)
+    try:
+        (i1, i2, fl, v), subs, retries = _read_sample(_WORKER_DS, int(idx))
+        return (i1, i2, fl, v), subs, retries
+    finally:
+        if _WORKER_CLAIMS is not None:
+            _WORKER_CLAIMS[_WORKER_WID] = -1
 
 
 class ProcessDataLoader(DataLoader):
@@ -535,6 +679,7 @@ class ProcessDataLoader(DataLoader):
                 os.environ.get("RAFT_LOADER_WORKER_TIMEOUT", "300"))
         self.worker_timeout = worker_timeout
         self._pool = None
+        self._claims = None
 
     def _ensure_pool(self):
         import multiprocessing as mp
@@ -543,9 +688,13 @@ class ProcessDataLoader(DataLoader):
         if self._pool is None:
             ctx = mp.get_context("forkserver")
             counter = ctx.Value("i", 0)
+            # claims[wid] = sample index that worker is reading right
+            # now (-1 idle): lets a timed-out drain name the worker
+            # that died holding the sample instead of just the wait.
+            self._claims = ctx.Array("l", [-1] * self.num_workers)
             self._pool = ctx.Pool(
                 self.num_workers, initializer=_process_worker_init,
-                initargs=(self.dataset, counter))
+                initargs=(self.dataset, counter, self._claims))
             # GC-time cleanup that must not resurrect self: capture the
             # pool, not the loader.
             pool = self._pool
@@ -561,27 +710,42 @@ class ProcessDataLoader(DataLoader):
             self._pool.join()
             self._pool = None
 
-    def _get_result(self, fut):
+    def _get_result(self, fut, sample_idx, batch_no):
         from multiprocessing import TimeoutError as MpTimeout
 
         try:
             return fut.get(self.worker_timeout)
         except MpTimeout:
+            self.stats.count_worker_timeout()
+            # Name the culprit: the claims array records which worker
+            # was holding this sample when it stopped responding.
+            wid = "unknown"
+            if self._claims is not None:
+                holders = [w for w, idx in enumerate(self._claims)
+                           if idx == sample_idx]
+                if holders:
+                    wid = ", ".join(str(w) for w in holders)
             raise RuntimeError(
-                f"loader worker produced no result within "
-                f"{self.worker_timeout:.0f}s — a worker process likely "
-                "died without returning (OOM-killed?); check dmesg, "
-                "lower num_workers, or raise "
+                f"loader worker {wid} produced no result for sample "
+                f"{sample_idx} (batch {batch_no}) within "
+                f"{self.worker_timeout:.0f}s — the worker process "
+                "likely died without returning (OOM-killed?); check "
+                "dmesg, lower num_workers, or raise "
                 "RAFT_LOADER_WORKER_TIMEOUT") from None
 
     def __iter__(self):
-        order, epoch = self._epoch_order()
+        gen = self._generation
+        epoch = self.epoch
+        order = self._epoch_order(epoch)
         pool = self._ensure_pool()
         yield from self._prefetch_loop(
             order,
             lambda i: pool.apply_async(_process_worker_load,
                                        (i, self.seed, epoch)),
-            self._get_result)
+            self._get_result,
+            start=self._pos, gen=gen)
+        if self._generation == gen:
+            self.epoch, self._pos = epoch + 1, 0
 
 
 def select_loader(loader: str = "auto",

@@ -222,15 +222,22 @@ class ResilienceStats:
     ``substituted_samples`` — unreadable/corrupt samples replaced by a
     deterministic neighbor (loader recovery);
     ``skipped_steps`` — host-side cumulative count of non-finite steps
-    whose parameter update was suppressed.
+    whose parameter update was suppressed;
+    ``sample_retries`` — transient read errors that succeeded on a
+    retry (a blip, not a substitution);
+    ``worker_timeouts`` — loader worker-pool drains that hit the
+    ``RAFT_LOADER_WORKER_TIMEOUT`` deadline (a worker died or wedged).
     Surfaced into the JSONL/TensorBoard scalar stream by the train loop
-    so silent degradation is auditable after the fact.
+    (and into the checkpointed :class:`raft_tpu.data.datasets
+    .LoaderState`) so silent degradation is auditable after the fact.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self.substituted_samples = 0
         self.skipped_steps = 0
+        self.sample_retries = 0
+        self.worker_timeouts = 0
 
     def count_substitution(self, n: int = 1):
         with self._lock:
@@ -239,6 +246,14 @@ class ResilienceStats:
     def count_skip(self, n: int = 1):
         with self._lock:
             self.skipped_steps += n
+
+    def count_sample_retries(self, n: int = 1):
+        with self._lock:
+            self.sample_retries += n
+
+    def count_worker_timeout(self, n: int = 1):
+        with self._lock:
+            self.worker_timeouts += n
 
 
 @dataclasses.dataclass

@@ -262,7 +262,13 @@ class ServingEngine:
                             t_submit=t_submit, deadline=deadline)
         try:
             self.batcher.enqueue(req)
-        except (BacklogFull, RuntimeError):
+        except BacklogFull:
+            # Shed counted on top of the rejection: the shed rate is
+            # the capacity signal, the reject total the error rate.
+            self.metrics.record_shed()
+            self.metrics.record_reject()
+            raise
+        except RuntimeError:
             self.metrics.record_reject()
             raise
         self.metrics.record_submit(self.batcher.pending())

@@ -118,6 +118,7 @@ class ServingMetrics:
         self.batch_hist: Counter = Counter()
         self.requests = 0          # accepted submits
         self.rejected = 0          # backlog-full / closed rejections
+        self.sheds = 0             # BacklogFull load-sheds specifically
         self.responses = 0         # futures resolved with a result
         self.errors = 0            # futures resolved with an exception
         self.timeouts = 0          # futures resolved with RequestTimedOut
@@ -141,6 +142,14 @@ class ServingMetrics:
     def record_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+
+    def record_shed(self) -> None:
+        """A ``BacklogFull`` load-shed. Counted on top of
+        ``record_reject`` (every shed is a rejection; closed-engine
+        rejections are not sheds): the shed rate is the capacity-planning
+        signal, the reject total is the client-visible error rate."""
+        with self._lock:
+            self.sheds += 1
 
     def record_batch(self, size: int, padded_to: int,
                      compiles: int = 0) -> None:
@@ -202,6 +211,7 @@ class ServingMetrics:
             out = {
                 "serving_requests": float(self.requests),
                 "serving_rejected": float(self.rejected),
+                "serving_shed": float(self.sheds),
                 "serving_responses": float(self.responses),
                 "serving_errors": float(self.errors),
                 "serving_timeouts": float(self.timeouts),
@@ -231,7 +241,8 @@ class ServingMetrics:
         lat = self.latency_ms()
         hist = ", ".join(f"{k}:{v}" for k, v in
                          sorted(self.batch_histogram().items()))
-        return (f"requests {self.requests} (rejected {self.rejected}) "
+        return (f"requests {self.requests} (rejected {self.rejected}, "
+                f"shed {self.sheds}) "
                 f"responses {self.responses} errors {self.errors} "
                 f"timeouts {self.timeouts} | "
                 f"{self.throughput():.2f} req/s, mean batch "

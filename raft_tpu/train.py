@@ -9,7 +9,10 @@ loader. Flags mirror reference ``train.py:431-452``; stage schedules mirror
 
 Improvements over the reference, kept explicit:
   * true resume (``--resume``): step/optimizer/BN state round-trip through
-    orbax (the reference restarts the schedule every stage);
+    orbax (the reference restarts the schedule every stage), and the
+    input-pipeline cursor rides every checkpoint — resume continues the
+    epoch at the exact sample, bit-identically to an uninterrupted run
+    (``scripts/fault_drill.py --drill resume-exact`` proves it);
   * graceful preemption: SIGTERM/SIGINT checkpoint the exact step and
     exit cleanly, multi-host-safe (:class:`_PreemptionGuard`);
   * validation runs through the shape-bucketed jitted
@@ -161,14 +164,22 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
     # explicit wait_for_pending() barriers below (preemption, abort,
     # exit — the next save point is covered by save() itself) are where
     # the write is finalized and cross-host commit-voted.
+    # gc_orphans: this is the run-OWNING checkpointer — it may sweep
+    # step dirs that never made commit.json (crash-interrupted saves).
     ckptr = ckpt_lib.RunCheckpointer(run_ckpt_dir,
-                                     async_save=tcfg.async_checkpointing)
+                                     async_save=tcfg.async_checkpointing,
+                                     gc_orphans=True)
 
+    restored_loader_state = None
+    resumed = False
     with ckptr, mesh:
         state = create_train_state(rng, model, tcfg, tcfg.image_size,
                                    mesh=mesh)
         if resume and ckptr.latest_step() is not None:
             state = ckptr.restore(state)
+            resumed = True
+            restored_loader_state = ckptr.loader_state(
+                int(jax.device_get(state.step)))
             print(f"resumed from step {int(state.step)}")
         elif restore_ckpt:
             params, batch_stats = ckpt_lib.load_params(restore_ckpt)
@@ -189,6 +200,20 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                                           tcfg.image_size, seed=tcfg.seed,
                                           root=data_root, loader=loader,
                                           num_workers=num_workers)
+        # Exact-cursor resume: restore this process's input-pipeline
+        # state BEFORE the first post-resume batch, so the stream
+        # continues at the precise sample the checkpointed step had
+        # consumed up to (not an epoch-start replay).
+        can_cursor = hasattr(dataloader, "load_state")
+        if restored_loader_state is not None and can_cursor:
+            dataloader.load_state(restored_loader_state)
+            print(f"restored input-pipeline cursor: epoch "
+                  f"{dataloader.epoch}, sample {dataloader._pos}")
+        elif resumed and int(jax.device_get(state.step)) > 0:
+            print("WARNING: checkpoint has no input-pipeline state "
+                  "(old format, or a loader without cursor support); "
+                  "resuming replays the epoch from its start",
+                  flush=True)
         if logger is None:
             logger = TrainLogger(os.path.join(log_dir, tcfg.name),
                                  sum_freq=tcfg.sum_freq)
@@ -209,8 +234,19 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
         # process checks every step with no collective.
         check_every = 1 if jax.process_count() == 1 else 10
         consecutive_skips = 0
-        last_substituted = 0
         loader_stats = getattr(dataloader, "stats", None)
+        # Counter deltas must start from the RESTORED totals, not zero —
+        # otherwise the first post-resume step logs the whole history as
+        # one spurious spike.
+        last_substituted = (loader_stats.substituted_samples
+                            if loader_stats is not None else 0)
+        # Loader snapshot taken at each *stepped* boundary. The for-loop
+        # below pulls batch N+1 before the preemption check, so the
+        # loader's live cursor at save time is one batch ahead of the
+        # trained step — saves always use this snapshot, and the
+        # pulled-but-unstepped batch is re-produced on resume.
+        loader_snap = (dataloader.state().to_dict()
+                       if hasattr(dataloader, "state") else None)
         with guard:
             # the while-condition check also escapes a pathological spin
             # over an exhausted one-shot dataloader (local flag only; no
@@ -219,7 +255,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                 for batch in dataloader:
                     if total_steps % check_every == 0 and \
                             _preemption_agreed(guard.requested):
-                        ckptr.save(state)
+                        ckptr.save(state, loader_state=loader_snap)
                         ckptr.wait_for_pending()   # commit before exit
                         print(f"preemption checkpoint at step "
                               f"{total_steps}; resume with --resume")
@@ -227,6 +263,11 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                     batch = shard_batch(batch, mesh)
                     state, metrics = step_fn(state, batch, step_rng)
                     total_steps += 1
+                    if loader_snap is not None:
+                        # The batch is now *trained on*: snapshot the
+                        # cursor at this quiescent point for every save
+                        # until the next step.
+                        loader_snap = dataloader.state().to_dict()
                     host_metrics = jax.device_get(metrics)
                     # Degradation counters into the scalar stream
                     # (logger accumulates them as run totals): per-step
@@ -249,7 +290,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         # so the state being saved is the last finite
                         # one; persistent divergence needs an operator,
                         # not more poisoned batches.
-                        ckptr.save(state)
+                        ckptr.save(state, loader_state=loader_snap)
                         ckptr.wait_for_pending()   # commit before abort
                         raise TrainingDiverged(
                             f"{consecutive_skips} consecutive non-finite "
@@ -257,7 +298,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                             f"last finite state to {run_ckpt_dir}")
 
                     if total_steps % tcfg.val_freq == 0:
-                        ckptr.save(state)
+                        ckptr.save(state, loader_state=loader_snap)
                         # Single-process only: sharded batch/pred arrays span
                         # non-addressable devices on multi-host meshes and
                         # device_get would raise there (panels are a debug
@@ -307,7 +348,7 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
                         keep_training = False
                         break
 
-        ckptr.save(state)
+        ckptr.save(state, loader_state=loader_snap)
         ckptr.wait_for_pending()       # exit barrier: final save commits
     return state
 

@@ -21,12 +21,18 @@ loop with each fault class injected in sequence —
    processes share a checkpoint dir; a targeted injection kills ONE
    host's save commit check and BOTH hosts must roll the step back,
    agree on the older committed step, and restore bit-identical state
-   (the torn-step invariant).
+   (the torn-step invariant) — per-process loader-state sidecars roll
+   back with the step;
+8. exact resume (``--drill resume-exact``)  -> training killed
+   mid-epoch with one batch pulled but unstepped; resume re-produces
+   that batch and the interrupted+resumed run matches an uninterrupted
+   control bit-for-bit (batch-index stream, loss trajectory, final
+   params), in sync and async checkpoint modes.
 
 Exits nonzero if any recovery path fails (a torn step detected by the
-multi-host drill is a failure). Usage::
+multi-host drill is a failure; any resume divergence likewise). Usage::
 
-    JAX_PLATFORMS=cpu python scripts/fault_drill.py [--drill NAME]
+    JAX_PLATFORMS=cpu python scripts/fault_drill.py [--drill NAME|--list]
 """
 
 import argparse
@@ -225,6 +231,159 @@ def drill_preemption_resume(root):
     assert _finite(state2)
 
 
+class _IndexDataset:
+    """Samples carry their own index at ``image1[0, 0, 0]`` — a batch's
+    identity is readable from the stacked array, so a drill can compare
+    the exact sample stream two runs consumed."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(1000 + i)
+        img1 = rng.uniform(0, 255, (H, W, 3)).astype(np.float32)
+        img1[0, 0, 0] = float(i)                 # identity marker
+        img2 = np.roll(img1, 2, axis=1)
+        flow = np.zeros((H, W, 2), np.float32)
+        flow[..., 0] = 2.0
+        valid = np.ones((H, W), np.float32)
+        return img1, img2, flow, valid
+
+
+def _losses(log_dir):
+    import json
+    path = os.path.join(log_dir, "scalars.jsonl")
+    return [rec["loss"] for rec in map(json.loads, open(path))
+            if "loss" in rec]
+
+
+def _params_digest(state):
+    import hashlib
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state.params):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def drill_resume_exact(root):
+    """Kill training mid-epoch, resume, and require a bit-identical
+    batch-index stream + loss trajectory + final params versus an
+    uninterrupted control run — in sync AND async checkpoint modes.
+    The interruption lands with one batch pulled but not yet stepped;
+    exact resume must re-produce that batch (not skip it, not replay
+    an already-trained one)."""
+    import raft_tpu.train as train_mod
+    from raft_tpu.data.datasets import DataLoader
+    from raft_tpu.train import train
+
+    box = [None]
+
+    class SpyGuard(train_mod._PreemptionGuard):
+        def __init__(self):
+            super().__init__()
+            box[0] = self
+
+    class RecordingLoader(DataLoader):
+        """Records the index stream it hands the consumer; optionally
+        raises the (spied) preemption flag as the ``preempt_at``-th
+        batch is being handed over — the train loop then checkpoints
+        WITHOUT stepping it, the pulled-but-unstepped case."""
+
+        def __init__(self, *a, preempt_at=None, **kw):
+            super().__init__(*a, **kw)
+            self.record = []
+            self.preempt_at = preempt_at
+
+        def __iter__(self):
+            for b in super().__iter__():
+                self.record.append(
+                    [int(x) for x in b["image1"][:, 0, 0, 0]])
+                if len(self.record) - 1 == self.preempt_at:
+                    box[0].requested = True
+                yield b
+
+    def make_loader(**kw):
+        return RecordingLoader(_IndexDataset(), batch_size=8,
+                               shuffle=True, num_workers=2, seed=7,
+                               stall_timeout=0, **kw)
+
+    def run(sub, tcfg, mcfg, loader, resume=False):
+        return train(tcfg, mcfg,
+                     ckpt_dir=os.path.join(sub, "ckpts"),
+                     log_dir=os.path.join(sub, "logs"),
+                     dataloader=loader, resume=resume,
+                     logger=TrainLogger(
+                         os.path.join(sub, "logs",
+                                      "r" if resume else "f"),
+                         sum_freq=1, tensorboard=False))
+
+    for mode in ("sync", "async"):
+        tcfg, mcfg = _cfg(num_steps=10, sum_freq=1,
+                          async_checkpointing=(mode == "async"))
+        ctrl = os.path.join(root, mode, "control")
+        kill = os.path.join(root, mode, "kill")
+
+        # Control: 10 uninterrupted steps (2.5 epochs of 4 batches).
+        ctrl_loader = make_loader()
+        ctrl_state = run(ctrl, tcfg, mcfg, ctrl_loader)
+        control = ctrl_loader.record
+        assert len(control) == 10, f"[{mode}] control pulled " \
+            f"{len(control)} batches, expected 10"
+
+        # Interrupted: preemption flag raised as batch 6 is handed
+        # over — 6 steps trained, the 7th batch pulled but unstepped.
+        int_loader = make_loader(preempt_at=6)
+        orig = train_mod._PreemptionGuard
+        train_mod._PreemptionGuard = SpyGuard
+        try:
+            int_state = run(kill, tcfg, mcfg, int_loader)
+        finally:
+            train_mod._PreemptionGuard = orig
+        assert int(int_state.step) == 6, \
+            f"[{mode}] preempted at step {int(int_state.step)}, not 6"
+        assert len(int_loader.record) == 7
+        assert int_loader.record[:6] == control[:6], \
+            f"[{mode}] pre-kill stream diverged from control"
+
+        # The checkpoint carries the exact cursor: epoch 1, 2 batches
+        # (16 samples) in — the snapshot at step 6, NOT the pump-ahead
+        # position (which already pulled batch 7).
+        d = os.path.join(kill, "ckpts", "drill")
+        with ckpt_lib.RunCheckpointer(d) as ckptr:
+            ls = ckptr.loader_state(6)
+        assert ls is not None, f"[{mode}] no loader state in checkpoint"
+        assert (ls["epoch"], ls["pos"]) == (1, 16), \
+            f"[{mode}] wrong cursor: {ls}"
+
+        # Resume: must re-produce the unstepped batch first, then match
+        # the control stream, losses and final params bit-for-bit.
+        res_loader = make_loader()
+        res_state = run(kill, tcfg, mcfg, res_loader, resume=True)
+        assert int(res_state.step) == 10
+        assert res_loader.record[0] == int_loader.record[6], \
+            f"[{mode}] pulled-but-unstepped batch not replayed"
+        assert res_loader.record == control[6:10], \
+            (f"[{mode}] DIVERGED: resumed stream "
+             f"{res_loader.record} != control {control[6:10]}")
+
+        ctrl_losses = _losses(os.path.join(ctrl, "logs", "f"))
+        int_losses = _losses(os.path.join(kill, "logs", "f"))
+        res_losses = _losses(os.path.join(kill, "logs", "r"))
+        assert len(ctrl_losses) == 10 and len(int_losses) == 6 \
+            and len(res_losses) == 4
+        assert int_losses + res_losses == ctrl_losses, \
+            (f"[{mode}] loss trajectory diverged:\n"
+             f"  control  {ctrl_losses}\n"
+             f"  stitched {int_losses + res_losses}")
+        assert _params_digest(res_state) == _params_digest(ctrl_state), \
+            f"[{mode}] final params differ from control"
+        print(f"  [{mode}] stream+losses+params bit-identical",
+              flush=True)
+
+
 class _TinyState:
     """Minimal checkpointable state for direct RunCheckpointer drills
     (no training loop needed — save/restore only touch the four array
@@ -356,14 +515,17 @@ _MULTIHOST_CHILD = textwrap.dedent("""
     out = {"pid": pid}
     c = ckpt_lib.RunCheckpointer(root, save_retries=1, retry_delay=0.05)
     set_injector(FaultInjector())         # baseline save is clean
-    c.save(TinyState(1))
+    # Each host checkpoints its own shard cursor alongside the arrays.
+    c.save(TinyState(1),
+           loader_state={"seed": 0, "epoch": 0, "pos": 8 * (pid + 1)})
     out["baseline_latest"] = c.latest_step()
 
     # Arm the env-described injection (exercises from_env + targeting).
     set_injector(FaultInjector.from_env())
     torn = False
     try:
-        c.save(TinyState(2))
+        c.save(TinyState(2),
+               loader_state={"seed": 0, "epoch": 0, "pos": 999})
     except CheckpointCommitError:
         torn = True
     out["commit_error_raised"] = torn
@@ -372,8 +534,13 @@ _MULTIHOST_CHILD = textwrap.dedent("""
     out["latest_after_tear"] = c.latest_step()
     out["step2_dir_absent"] = not os.path.isdir(
         os.path.join(root, "2"))
+    # Torn loader state must roll back WITH the step...
+    out["torn_loader_state_absent"] = c.loader_state(2) is None
     st = c.restore(TinyState(0))
     out["restored_step"] = int(jax.device_get(st.step))
+    # ...and the committed step must still hold THIS host's cursor.
+    ls = c.loader_state(out["restored_step"]) or {}
+    out["restored_loader_pos"] = ls.get("pos")
     w = np.asarray(jax.device_get(st.params["w"]))
     out["restored_hash"] = hashlib.sha256(w.tobytes()).hexdigest()
 
@@ -451,7 +618,12 @@ def drill_multihost_save(root):
             f"TORN STEP: host {pid} sees latest={r['latest_after_tear']}"
         assert r["step2_dir_absent"], \
             f"TORN STEP: failed step dir survived on host {pid}"
+        assert r["torn_loader_state_absent"], \
+            f"TORN STEP: loader state outlived its step on host {pid}"
         assert r["restored_step"] == 1, (pid, r)
+        assert r["restored_loader_pos"] == 8 * (pid + 1), \
+            (f"host {pid} restored the wrong shard cursor: "
+             f"{r['restored_loader_pos']}")
         assert r["latest_after_blip"] == 3, \
             f"lockstep retry failed on host {pid}: {r}"
     assert results[0]["restored_hash"] == results[1]["restored_hash"], \
@@ -465,6 +637,7 @@ DRILLS = [
     drill_nan_batch,
     drill_nan_divergence_abort,
     drill_preemption_resume,
+    drill_resume_exact,
     drill_async_save,
     drill_multihost_save,
 ]
@@ -480,7 +653,14 @@ def main(argv=None) -> int:
     ap.add_argument("--drill", default="all",
                     choices=["all", *by_name],
                     help="run one drill (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="print available drills and exit")
     args = ap.parse_args(argv)
+    if args.list:
+        for fn in DRILLS:
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{_drill_name(fn):28s} {doc}")
+        return 0
     selected = DRILLS if args.drill == "all" else [by_name[args.drill]]
 
     failures = 0

@@ -1,0 +1,309 @@
+"""Exactly-resumable training: crash-consistent input-pipeline state.
+
+Tier-1 coverage for the exact-cursor resume subsystem: ``LoaderState``
+round-trips for both loader classes, mid-epoch restore resumes at the
+precise sample, a ``load_state`` during iteration drains the prefetch
+pump, the checkpoint layer commits/rolls back the per-process loader
+sidecar with the step, startup GC removes orphaned steps while sparing
+committed and legacy (pre-commit-era) ones. The end-to-end bit-identity
+proof (kill + resume == control) is ``scripts/fault_drill.py --drill
+resume-exact``, exercised by the slow drill test in
+``test_resilience.py``.
+"""
+
+import json
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import checkpoint as ckpt_lib
+from raft_tpu.data.datasets import (DataLoader, LoaderState,
+                                    ProcessDataLoader)
+
+
+class IdxDataset:
+    """Picklable; every sample is stamped with its own index at
+    ``image1[0, 0, 0]`` so a yielded batch's identity is readable."""
+
+    def __init__(self, n=16):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def reseed(self, key):
+        pass
+
+    def __getitem__(self, i):
+        img = np.full((8, 8, 3), float(i), np.float32)
+        return (img, img.copy(), np.zeros((8, 8, 2), np.float32),
+                np.ones((8, 8), np.float32))
+
+
+def _ids(batch):
+    return [int(x) for x in batch["image1"][:, 0, 0, 0]]
+
+
+def _loader(cls=DataLoader, n=16, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("num_workers", 2)
+    kw.setdefault("seed", 11)
+    kw.setdefault("stall_timeout", 0)
+    return cls(IdxDataset(n=n), **kw)
+
+
+# -- LoaderState round-trip ----------------------------------------------
+
+
+@pytest.mark.parametrize("cls", [DataLoader, ProcessDataLoader])
+def test_loader_state_round_trip(cls):
+    src = _loader(cls)
+    src.epoch, src._pos = 3, 8
+    src.stats.count_substitution(2)
+    src.stats.count_sample_retries(5)
+    src.stats.count_worker_timeout()
+    st = src.state()
+    assert (st.seed, st.epoch, st.pos) == (11, 3, 8)
+    assert (st.substituted_samples, st.sample_retries,
+            st.worker_timeouts) == (2, 5, 1)
+
+    dst = _loader(cls)
+    dst.load_state(st.to_dict())            # dict form (the JSON path)
+    assert dst.state() == st
+    dst2 = _loader(cls)
+    dst2.load_state(st)                     # object form
+    assert dst2.state() == st
+
+
+def test_loader_state_dict_round_trip_and_unknown_fields(capsys):
+    st = LoaderState(seed=1, epoch=2, pos=12, substituted_samples=3)
+    assert LoaderState.from_dict(st.to_dict()) == st
+    # Forward compatibility: a newer writer's extra field is ignored
+    # loudly, not a crash.
+    d = {**st.to_dict(), "from_the_future": 9}
+    assert LoaderState.from_dict(d) == st
+    assert "from_the_future" in capsys.readouterr().out
+
+
+def test_load_state_rejects_misaligned_cursor():
+    dst = _loader()
+    with pytest.raises(ValueError, match="not a multiple"):
+        dst.load_state(LoaderState(seed=11, epoch=0, pos=3))
+
+
+# -- exact-cursor iteration ----------------------------------------------
+
+
+def test_epoch_advances_only_on_clean_exhaustion():
+    loader = _loader()
+    assert [len(_ids(b)) for b in loader] == [4, 4, 4, 4]
+    assert (loader.epoch, loader._pos) == (1, 0)
+    it = iter(loader)
+    next(it)                                 # mid-epoch break
+    del it
+    assert loader.epoch == 1 and loader._pos == 4
+
+
+def test_mid_epoch_restore_skips_consumed_samples_exactly():
+    control = [_ids(b) for b in _loader()]           # full epoch 0
+
+    src = _loader()
+    it = iter(src)
+    consumed = [_ids(next(it)), _ids(next(it))]
+    assert consumed == control[:2]
+    st = src.state()
+
+    dst = _loader()
+    dst.load_state(st.to_dict())
+    rest = [_ids(b) for b in dst]
+    assert rest == control[2:], \
+        f"restored stream {rest} != control tail {control[2:]}"
+    # Clean exhaustion of the restored epoch advances normally.
+    assert (dst.epoch, dst._pos) == (1, 0)
+
+
+def test_restore_across_epoch_boundary():
+    src = _loader()
+    stream = []
+    for _ in range(2):                       # epochs 0 and 1 fully
+        stream += [_ids(b) for b in src]
+    st_mid = LoaderState(seed=11, epoch=1, pos=8)
+    dst = _loader()
+    dst.load_state(st_mid)
+    assert [_ids(b) for b in dst] == stream[6:8]     # tail of epoch 1
+
+
+def test_process_loader_mid_epoch_restore():
+    src = _loader(ProcessDataLoader)
+    try:
+        control = [_ids(b) for b in src]             # epoch 0
+    finally:
+        src.close()
+    dst = _loader(ProcessDataLoader)
+    dst.load_state(LoaderState(seed=11, epoch=0, pos=8))
+    try:
+        assert [_ids(b) for b in dst] == control[2:]
+    finally:
+        dst.close()
+
+
+def test_load_state_drains_inflight_pump():
+    loader = _loader(prefetch=3)
+    it = iter(loader)
+    next(it)
+    # Restore while the iterator is alive (its pump has futures in
+    # flight): the OLD iterator must drain — no stale pre-restore
+    # batches — and must NOT advance the epoch as if exhausted.
+    loader.load_state(LoaderState(seed=11, epoch=0, pos=8))
+    stale = list(it)
+    assert stale == [], "pre-restore iterator yielded stale batches"
+    assert (loader.epoch, loader._pos) == (0, 8), \
+        "drained iterator clobbered the restored cursor"
+    control = [_ids(b) for b in _loader()]
+    assert [_ids(b) for b in loader] == control[2:]
+
+
+# -- checkpoint layer: sidecar + commit gate + GC ------------------------
+
+
+class _FakeState:
+    def __init__(self, step):
+        self.step = jnp.asarray(step, jnp.int32)
+        self.params = {"w": jnp.arange(8, dtype=jnp.float32) * step}
+        self.batch_stats = {}
+        self.opt_state = {"m": jnp.zeros(8, jnp.float32)}
+
+    def replace(self, **kw):
+        import copy
+        s = copy.copy(self)
+        for k, v in kw.items():
+            setattr(s, k, v)
+        return s
+
+
+def test_checkpoint_loader_state_round_trip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    st = LoaderState(seed=11, epoch=2, pos=8, sample_retries=1)
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1), loader_state=st)      # LoaderState object
+        c.save(_FakeState(2), loader_state=st.to_dict())   # dict form
+        assert c.loader_state(1) == st.to_dict()
+        assert c.loader_state(2) == st.to_dict()
+        assert LoaderState.from_dict(c.loader_state(1)) == st
+    # The sidecar lives inside the step dir, per process.
+    assert os.path.exists(os.path.join(d, "1", "loader_state_p0.json"))
+
+
+def test_old_format_checkpoint_has_no_loader_state(tmp_path):
+    """A checkpoint saved without loader state (pre-cursor format)
+    restores fine; the reader reports None so callers can warn."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1))
+        assert c.loader_state(1) is None
+        got = c.restore(_FakeState(0))
+        assert int(got.step) == 1
+
+
+def test_unreadable_loader_state_degrades_with_warning(tmp_path, caplog):
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1), loader_state={"seed": 0, "epoch": 0,
+                                            "pos": 4})
+        path = os.path.join(d, "1", "loader_state_p0.json")
+        with open(path, "w") as f:
+            f.write("{garbled")
+        with caplog.at_level(logging.WARNING, "raft_tpu.checkpoint"):
+            assert c.loader_state(1) is None
+        assert "unreadable" in caplog.text
+
+
+def test_loader_state_rolls_back_with_failed_commit(tmp_path):
+    """The sidecar is written before the commit vote: an injected
+    commit failure past the retry budget rolls back the step dir —
+    sidecar included — and the older committed sidecar survives."""
+    from raft_tpu.resilience import FaultInjector, set_injector
+
+    d = str(tmp_path / "ckpt")
+    try:
+        with ckpt_lib.RunCheckpointer(d, save_retries=1,
+                                      retry_delay=0.001) as c:
+            c.save(_FakeState(1), loader_state={"seed": 0, "epoch": 0,
+                                                "pos": 4})
+            set_injector(FaultInjector(ckpt_commit_errors=8))
+            with pytest.raises(OSError,
+                               match="injected checkpoint commit"):
+                c.save(_FakeState(2),
+                       loader_state={"seed": 0, "epoch": 0, "pos": 8})
+            set_injector(None)
+            assert not os.path.isdir(os.path.join(d, "2"))
+            assert c.loader_state(2) is None
+            assert c.loader_state(1)["pos"] == 4
+    finally:
+        set_injector(None)
+
+
+def test_gc_removes_orphans_and_spares_committed(tmp_path, caplog):
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1))
+        c.save(_FakeState(2))
+    # Simulate a crash that left dirt: an uncommitted step dir (vote
+    # never completed) and a half-finalized orbax tmp dir.
+    orphan = os.path.join(d, "7")
+    os.makedirs(orphan)
+    open(os.path.join(orphan, "junk.bin"), "w").write("x")
+    tmp_dir = os.path.join(d, "9.orbax-checkpoint-tmp-123")
+    os.makedirs(tmp_dir)
+
+    with caplog.at_level(logging.INFO, "raft_tpu.checkpoint"):
+        with ckpt_lib.RunCheckpointer(d, gc_orphans=True) as c:
+            assert not os.path.isdir(orphan), "orphan survived GC"
+            assert not os.path.isdir(tmp_dir), "tmp dir survived GC"
+            assert c.latest_step() == 2
+            got = c.restore(_FakeState(0))
+            assert int(got.step) == 2
+    assert "checkpoint GC removed" in caplog.text
+    assert os.path.isdir(os.path.join(d, "1"))
+    assert os.path.isdir(os.path.join(d, "2"))
+
+
+def test_gc_off_by_default_for_readers(tmp_path):
+    """Read-only helpers must never GC: a fresh reader during another
+    writer's in-flight (uncommitted) async save would otherwise delete
+    the step being written."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1))
+    uncommitted = os.path.join(d, "5")
+    os.makedirs(uncommitted)
+    open(os.path.join(uncommitted, "inflight.bin"), "w").write("x")
+    assert ckpt_lib.latest_step(d) == 1     # fresh reader, no GC
+    assert os.path.isdir(uncommitted), \
+        "a read-only helper deleted an in-flight step"
+
+
+def test_legacy_dir_grandfathered_and_survives_gc(tmp_path):
+    """Pre-commit-era checkpoints (no commit.json): every intact step
+    stays visible to latest/restore, and GC must not touch them —
+    nothing there is provably an orphan (satellite: legacy coverage)."""
+    d = str(tmp_path / "ckpt")
+    with ckpt_lib.RunCheckpointer(d) as c:
+        c.save(_FakeState(1))
+        c.save(_FakeState(2))
+    os.remove(os.path.join(d, "commit.json"))       # now "legacy"
+
+    assert ckpt_lib.latest_step(d) == 2
+    with ckpt_lib.RunCheckpointer(d, gc_orphans=True) as c:
+        assert os.path.isdir(os.path.join(d, "1")), \
+            "GC deleted a legacy step"
+        assert os.path.isdir(os.path.join(d, "2"))
+        assert c.latest_step() == 2
+        got = c.restore(_FakeState(0))
+        assert int(got.step) == 2
+        np.testing.assert_array_equal(
+            np.asarray(got.params["w"]),
+            np.arange(8, dtype=np.float32) * 2)

@@ -485,9 +485,10 @@ def test_read_sample_retries_transient_then_succeeds():
                 raise OSError("transient blip")
             return super().__getitem__(i)
 
-    sample, subs = _read_sample(FlakyOnce(), 1, retries=2,
-                                base_delay=0.001)
+    sample, subs, retries = _read_sample(FlakyOnce(), 1, retries=2,
+                                         base_delay=0.001)
     assert subs == 0                             # retried, NOT substituted
+    assert retries == 1                          # ...and counted as such
     assert sample[0][0, 0, 0] == 1.0
 
 

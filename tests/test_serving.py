@@ -119,9 +119,11 @@ class TestMetrics:
         m.record_done(0.010)
         m.record_done(0.030)
         m.record_reject()
+        m.record_shed()
         snap = m.snapshot()
         assert snap["serving_requests"] == 2.0
         assert snap["serving_rejected"] == 1.0
+        assert snap["serving_shed"] == 1.0
         assert snap["serving_responses"] == 2.0
         assert snap["serving_batches"] == 1.0
         assert snap["serving_padded_slots"] == 2.0
@@ -278,8 +280,21 @@ class TestServingEngine:
             with pytest.raises(BacklogFull):
                 eng.submit(*frames[1])
             assert eng.metrics.rejected == 1
+            # A BacklogFull rejection is specifically a load-shed.
+            assert eng.metrics.sheds == 1
+            assert eng.metrics.snapshot()["serving_shed"] == 1.0
         finally:
             eng.close()
+
+    def test_closed_engine_rejection_is_not_a_shed(self, predictor,
+                                                   frames_and_refs):
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=8, max_wait_ms=1.0)
+        eng.start()
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(*frames[0])
+        assert eng.metrics.sheds == 0
 
     def test_queue_timeout_expires_stale_requests(self, predictor,
                                                   frames_and_refs):
@@ -554,7 +569,11 @@ class TestProcessLoader:
                                    worker_timeout=0.5)
         try:
             with pytest.raises(RuntimeError,
-                               match="no result within|died"):
+                               match=r"no result for sample \d+ "
+                                     r"\(batch \d+\)"):
                 next(iter(loader))
+            # The timed-drain event is counted, not only raised.
+            assert loader.stats.worker_timeouts == 1
+            assert loader.state().worker_timeouts == 1
         finally:
             loader.close()
