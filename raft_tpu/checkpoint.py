@@ -447,6 +447,23 @@ class RunCheckpointer:
 
     # -- inspect ---------------------------------------------------------
 
+    def refresh(self) -> None:
+        """Re-scan the checkpoint directory for steps written by
+        *another* process since this manager was constructed.
+
+        Orbax caches its directory listing, so a reader polling
+        ``latest_step()`` across processes (the serving hot-reload
+        watcher, a sidecar evaluator) would never see a trainer's new
+        saves without this. Best-effort: a transiently unreadable
+        directory keeps the previous view rather than killing the
+        poller."""
+        try:
+            self._mngr.reload()
+        except Exception as e:
+            logger.warning("checkpoint directory refresh of %s failed "
+                           "(%s: %s); keeping the cached view",
+                           self.ckpt_dir, type(e).__name__, e)
+
     def all_steps(self):
         return sorted(int(s) for s in self._mngr.all_steps())
 

@@ -203,6 +203,31 @@ class FlowPredictor:
         flow_low, flow_up = fn(self.variables, img1, img2, init)
         return np.asarray(flow_low[0]), np.asarray(flow_up[0])
 
+    def clone_with_variables(self, variables) -> "FlowPredictor":
+        """A predictor serving ``variables`` through *this* predictor's
+        compiled executables.
+
+        Variables enter the jitted forward as a traced argument (never
+        closed over), so a clone sharing ``_cache`` runs new weights
+        with zero fresh XLA compiles — the property hot checkpoint
+        reload stands on: the standby model canaries and then serves
+        through the bucket executables the engine already warmed. The
+        clone shares model/engines/mesh/cache (all weight-independent);
+        ``variables`` must match the current pytree structure (same
+        top-level keys — e.g. include ``batch_stats`` iff the current
+        variables carry it) or the shared cache would retrace."""
+        import copy
+
+        if set(variables) != set(self.variables):
+            raise ValueError(
+                "clone_with_variables needs the same variable "
+                f"collections as the current model ({sorted(self.variables)}), "
+                f"got {sorted(variables)} — a structure change would "
+                "force a recompile through the shared executable cache")
+        clone = copy.copy(self)
+        clone.variables = variables
+        return clone
+
     def dispatch_batch(self, images1: np.ndarray, images2: np.ndarray):
         """Non-blocking batched forward: (B, H, W, 3) stacks →
         ``(flow_low, flow_up)`` *device* arrays, returned as soon as the

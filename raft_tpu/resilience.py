@@ -275,6 +275,16 @@ class FaultInjector:
       commit checks (after the step's data is durably written, before
       the cross-host vote) raise ``OSError`` — the mid-save host-death
       simulation: data on disk, commit never agreed, step rolled back.
+    * ``RAFT_FAULT_SERVING_DISPATCH_ERRORS=N`` — the first N serving
+      dispatch attempts (batched or isolation singles) raise
+      ``RuntimeError`` before reaching the device — the transient
+      device-error simulation the serving circuit breaker and the
+      ``serve_drill.py`` breaker gate are proven against.
+    * ``RAFT_FAULT_SERVING_POISON_NTH=N`` — every Nth submitted serving
+      request (1-based submit order) is marked *poisoned*: any batch
+      containing it fails at dispatch, and on the engine's
+      retry-as-singles isolation pass only the poisoned request itself
+      fails. Exercises batch error isolation without monkeypatching.
     * ``RAFT_FAULT_TARGET_PROCESS=K`` — restrict EVERY host-side fault
       above to the host with ``jax.process_index() == K`` (multi-host
       drills: exactly one simulated host fails while the others
@@ -291,6 +301,8 @@ class FaultInjector:
     corrupt_sample_indices: FrozenSet[int] = frozenset()
     nan_loss_steps: Tuple[int, ...] = ()
     ckpt_commit_errors: int = 0
+    serving_dispatch_errors: int = 0
+    serving_poison_nth: int = 0
     target_process: Optional[int] = None
 
     @staticmethod
@@ -308,6 +320,10 @@ class FaultInjector:
             nan_loss_steps=_ints("RAFT_FAULT_NAN_STEPS"),
             ckpt_commit_errors=int(
                 os.environ.get("RAFT_FAULT_CKPT_COMMIT_ERRORS", "0")),
+            serving_dispatch_errors=int(
+                os.environ.get("RAFT_FAULT_SERVING_DISPATCH_ERRORS", "0")),
+            serving_poison_nth=int(
+                os.environ.get("RAFT_FAULT_SERVING_POISON_NTH", "0")),
             target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
@@ -338,6 +354,26 @@ class FaultInjector:
             raise OSError("injected checkpoint commit failure "
                           f"({self.ckpt_commit_errors} more queued)")
 
+    def maybe_fail_serving_dispatch(self):
+        """Called once per serving dispatch *attempt* (a dynamic batch
+        or an isolation single); burns one unit of the error budget per
+        call until exhausted — the transient-device-error simulation
+        the circuit breaker trips on and recovers from."""
+        if self.serving_dispatch_errors > 0 and self._on_target():
+            self.serving_dispatch_errors -= 1
+            raise RuntimeError(
+                "injected serving dispatch failure "
+                f"({self.serving_dispatch_errors} more queued)")
+
+    def poisons_request(self, submit_seq: int) -> bool:
+        """Whether the ``submit_seq``-th serving submit (1-based) is
+        poisoned. Deterministic by submit order, so the poisoned
+        request keeps failing on the isolation retry while its batch
+        neighbors serve — the one-bad-input-can't-fail-its-neighbors
+        contract."""
+        return (self.serving_poison_nth > 0 and self._on_target()
+                and submit_seq % self.serving_poison_nth == 0)
+
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
@@ -348,7 +384,9 @@ class FaultInjector:
     @property
     def active(self) -> bool:
         return bool(self.ckpt_save_errors or self.corrupt_sample_indices
-                    or self.nan_loss_steps or self.ckpt_commit_errors)
+                    or self.nan_loss_steps or self.ckpt_commit_errors
+                    or self.serving_dispatch_errors
+                    or self.serving_poison_nth)
 
 
 _ACTIVE: Optional[FaultInjector] = None

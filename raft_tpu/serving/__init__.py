@@ -8,29 +8,54 @@ queue level, reusing :class:`raft_tpu.evaluate.FlowPredictor` for the
 forward itself:
 
 * :mod:`~raft_tpu.serving.batcher` — thread-safe shape-bucketed dynamic
-  batcher (close on max-size or deadline, backlog cap).
+  batcher (close on max-size or deadline, two priority classes per
+  bucket, backlog cap with LOW-first shedding).
 * :mod:`~raft_tpu.serving.engine` — warmup (per-bucket pre-compile +
   persistent XLA cache), pipelined async dispatch with donated input
-  buffers, the ``submit() -> Future`` client API.
+  buffers, the ``submit() -> Future`` client API, circuit breaker +
+  batch error isolation + health states + atomic model swap.
+* :mod:`~raft_tpu.serving.health` — engine health states, the dispatch
+  :class:`~raft_tpu.serving.health.CircuitBreaker`, and the
+  :class:`~raft_tpu.serving.health.EngineUnhealthy` fail-fast error.
+* :mod:`~raft_tpu.serving.reload` — hot checkpoint reload: watch the
+  trainer's commit-gated checkpoints, canary-validate a standby model
+  on golden pairs (zero-compile via the shared executable cache), swap
+  atomically or roll back and pin the bad step.
 * :mod:`~raft_tpu.serving.metrics` — p50/p95/p99 latency, batch-size
-  histogram, queue depth, throughput, XLA compile-count probe.
+  histogram, queue depth, throughput, XLA compile-count probe, plus
+  robustness gauges (health state, swaps/rollbacks/breaker trips).
 * :mod:`~raft_tpu.serving.loadgen` — CPU-runnable concurrent load
   generator with bit-exact response checking (drives ``bench.py
   serving`` and ``scripts/serve_drill.py``).
 """
 
-from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
-                                      RequestTimedOut, ShapeBucketBatcher)
+from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
+                                      PRIORITY_LOW, BacklogFull,
+                                      QueuedRequest, RequestTimedOut,
+                                      ShapeBucketBatcher)
 from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
                                      enable_persistent_compile_cache,
                                      make_engine)
+from raft_tpu.serving.health import (CircuitBreaker, EngineUnhealthy,
+                                     HEALTH_CODES)
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
+from raft_tpu.serving.reload import (CanaryResult, HotReloader,
+                                     ReloadConfig)
 
 __all__ = [
     "BacklogFull",
+    "CanaryResult",
+    "CircuitBreaker",
     "CompileWatch",
+    "EngineUnhealthy",
+    "HEALTH_CODES",
+    "HotReloader",
+    "PRIORITIES",
+    "PRIORITY_HIGH",
+    "PRIORITY_LOW",
     "QueuedRequest",
+    "ReloadConfig",
     "RequestTimedOut",
     "ServingConfig",
     "ServingEngine",

@@ -15,7 +15,13 @@ vs 99.0 at batch 128). The batcher closes that gap at the queue level:
   holds ``max_batch`` requests; otherwise the oldest waiting request's
   ``max_wait`` deadline closes its bucket with whatever has arrived
   (the classic dynamic-batching latency/throughput dial).
-* **FIFO within a bucket**, oldest-deadline-first across buckets.
+* **Two priority classes per bucket.** ``PRIORITY_HIGH`` (the default)
+  fills a closing batch before ``PRIORITY_LOW`` — interactive traffic
+  batches ahead of opt-in background/backfill work — FIFO within each
+  class, oldest-deadline-first across buckets. Under a full backlog a
+  HIGH submit evicts the *youngest* queued LOW request (the shed
+  policy: LOW is the first to go) before giving up with
+  :class:`BacklogFull`.
 
 The batcher owns no JAX state — it moves :class:`QueuedRequest` records
 between client threads and the engine's dispatcher thread. Padding
@@ -30,30 +36,69 @@ import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
+
+PRIORITY_HIGH = "high"
+PRIORITY_LOW = "low"
+PRIORITIES = (PRIORITY_HIGH, PRIORITY_LOW)
 
 
 class QueuedRequest:
     """One in-flight request: padded inputs + the padder to undo it,
     submit timestamp (latency accounting + batching deadline), an
     optional queue-timeout deadline (monotonic; ``None`` = wait
-    forever), and the future the client is waiting on."""
+    forever), its priority class, a fault-injection poison mark, and
+    the future the client is waiting on."""
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
-                 "deadline", "future")
+                 "deadline", "priority", "poisoned", "future")
 
     def __init__(self, image1, image2, padder, bucket: Tuple[int, int],
-                 t_submit: float, deadline: Optional[float] = None):
+                 t_submit: float, deadline: Optional[float] = None,
+                 priority: str = PRIORITY_HIGH, poisoned: bool = False):
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
         self.image1 = image1
         self.image2 = image2
         self.padder = padder
         self.bucket = bucket
         self.t_submit = t_submit
         self.deadline = deadline
+        self.priority = priority
+        self.poisoned = poisoned
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
+
+
+class _Bucket:
+    """Two FIFO lanes for one padded shape: HIGH drains first."""
+
+    __slots__ = ("high", "low")
+
+    def __init__(self):
+        self.high: deque = deque()
+        self.low: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self.high) + len(self.low)
+
+    def append(self, req: QueuedRequest) -> None:
+        (self.high if req.priority == PRIORITY_HIGH
+         else self.low).append(req)
+
+    def oldest_t(self) -> float:
+        """Submit time of the oldest request in either lane (the
+        bucket's deadline anchor — priority reorders *within* a closing
+        batch, it does not let a young HIGH reset an old LOW's wait)."""
+        ts = []
+        if self.high:
+            ts.append(self.high[0].t_submit)
+        if self.low:
+            ts.append(self.low[0].t_submit)
+        return min(ts)
 
 
 class ShapeBucketBatcher:
@@ -67,7 +112,8 @@ class ShapeBucketBatcher:
         batch-as-available (every poll drains whatever is queued).
       max_pending: backlog cap across all buckets; ``enqueue`` beyond it
         raises :class:`BacklogFull` (load shedding beats unbounded
-        memory growth and unbounded tail latency).
+        memory growth and unbounded tail latency) — unless the arriving
+        request is HIGH and a LOW request can be shed in its place.
       clock: injectable monotonic clock (tests).
     """
 
@@ -82,26 +128,56 @@ class ShapeBucketBatcher:
         self.max_wait_s = max_wait_s
         self.max_pending = max_pending
         self._clock = clock
+        # bucket key -> _Bucket. OrderedDict so iteration order is
+        # stable (deterministic tests).
+        self._buckets: "OrderedDict[Tuple[int, int], _Bucket]" = \
+            OrderedDict()
         self._cond = threading.Condition()
-        # bucket key -> FIFO of QueuedRequest. OrderedDict so iteration
-        # order is stable (deterministic tests).
-        self._buckets: "OrderedDict[Tuple[int, int], deque]" = OrderedDict()
         self._pending = 0
         self._closed = False
 
     # -- client side ----------------------------------------------------
 
-    def enqueue(self, req: QueuedRequest) -> None:
+    def enqueue(self, req: QueuedRequest) -> Optional[QueuedRequest]:
+        """Queue ``req``. Returns the LOW request shed to make room for
+        it (``None`` normally): under a full backlog a HIGH arrival
+        evicts the youngest queued LOW — the caller owns completing the
+        evicted future (with :class:`BacklogFull`) and counting the
+        shed. A LOW arrival, or a HIGH one with no LOW to shed, raises
+        :class:`BacklogFull`."""
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed to new requests")
+            evicted = None
             if self._pending >= self.max_pending:
-                raise BacklogFull(
-                    f"serving backlog full ({self._pending} pending >= "
-                    f"max_pending={self.max_pending})")
-            self._buckets.setdefault(req.bucket, deque()).append(req)
+                if req.priority == PRIORITY_HIGH:
+                    evicted = self._evict_youngest_low()
+                if evicted is None:
+                    raise BacklogFull(
+                        f"serving backlog full ({self._pending} pending "
+                        f">= max_pending={self.max_pending})")
+            self._buckets.setdefault(req.bucket, _Bucket()).append(req)
             self._pending += 1
             self._cond.notify_all()
+        return evicted
+
+    def _evict_youngest_low(self) -> Optional[QueuedRequest]:
+        """Drop the youngest queued LOW request (the one that has lost
+        the least waiting time) to admit an arriving HIGH. Caller holds
+        the lock."""
+        newest_key, newest_t = None, None
+        for key, bucket in self._buckets.items():
+            if bucket.low and (newest_t is None
+                               or bucket.low[-1].t_submit > newest_t):
+                newest_key, newest_t = key, bucket.low[-1].t_submit
+        if newest_key is None:
+            return None
+        bucket = self._buckets[newest_key]
+        victim = bucket.low.pop()
+        if not len(bucket):
+            del self._buckets[newest_key]
+        self._pending -= 1
+        return victim
 
     def pending(self) -> int:
         with self._cond:
@@ -122,23 +198,26 @@ class ShapeBucketBatcher:
     # -- dispatcher side ------------------------------------------------
 
     def _pop_from(self, key) -> List[QueuedRequest]:
-        q = self._buckets[key]
-        batch = [q.popleft() for _ in range(min(len(q), self.max_batch))]
-        if not q:
+        bucket = self._buckets[key]
+        batch: List[QueuedRequest] = []
+        for lane in (bucket.high, bucket.low):
+            while lane and len(batch) < self.max_batch:
+                batch.append(lane.popleft())
+        if not len(bucket):
             del self._buckets[key]
         self._pending -= len(batch)
         return batch
 
     def _full_bucket(self) -> Optional[Tuple[int, int]]:
-        for key, q in self._buckets.items():
-            if len(q) >= self.max_batch:
+        for key, bucket in self._buckets.items():
+            if len(bucket) >= self.max_batch:
                 return key
         return None
 
     def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
         oldest_key, oldest_t = None, None
-        for key, q in self._buckets.items():
-            t = q[0].t_submit
+        for key, bucket in self._buckets.items():
+            t = bucket.oldest_t()
             if oldest_t is None or t < oldest_t:
                 oldest_key, oldest_t = key, t
         return oldest_key
@@ -165,7 +244,7 @@ class ShapeBucketBatcher:
                 wait = None
                 oldest = self._oldest_bucket()
                 if oldest is not None:
-                    deadline = (self._buckets[oldest][0].t_submit
+                    deadline = (self._buckets[oldest].oldest_t()
                                 + self.max_wait_s)
                     if deadline <= now:
                         return self._pop_from(oldest)
@@ -179,7 +258,8 @@ class ShapeBucketBatcher:
 
 
 class BacklogFull(RuntimeError):
-    """Raised by ``enqueue`` when the pending-request cap is hit."""
+    """Raised by ``enqueue`` when the pending-request cap is hit (and
+    set on the future of a LOW request shed to admit a HIGH one)."""
 
 
 class RequestTimedOut(RuntimeError):

@@ -7,9 +7,10 @@ mechanisms behind one ``submit() -> Future`` API:
 * **Dynamic batching** — client threads pad (InputPadder, client-side so
   pad work rides the producers) and enqueue into the shape-bucketed
   :class:`~raft_tpu.serving.batcher.ShapeBucketBatcher`; batches close
-  on max-size or deadline, and partial batches are tail-padded by
+  on max-size or deadline, partial batches are tail-padded by
   repeating the last request (the batched-eval trick: one executable
-  per bucket, never per partial size).
+  per bucket, never per partial size), and two priority classes per
+  bucket let interactive traffic batch ahead of opt-in background work.
 * **Pipelined dispatch** — a dispatcher thread stacks and *dispatches*
   batch N+1 while the device still computes batch N (`jax.Array`
   dispatch is non-blocking; only the completion thread syncs, via
@@ -26,6 +27,31 @@ mechanisms behind one ``submit() -> Future`` API:
   serving process restart pays seconds, not minutes, before its first
   request.
 
+On top of those sits the **robustness layer** (Clipper-style: degrade
+gracefully, never let one failure take out its co-batched neighbors):
+
+* **Circuit breaker** — ``breaker_threshold`` consecutive dispatch/sync
+  failures trip the :class:`~raft_tpu.serving.health.CircuitBreaker`
+  OPEN: submits (and queued batches) fail fast with
+  :class:`~raft_tpu.serving.health.EngineUnhealthy` instead of queueing
+  doomed work behind a sick device; after ``breaker_cooldown_s`` the
+  next batch through is the half-open probe that closes it again.
+* **Batch error isolation** — when a dispatched batch fails (at
+  dispatch or at sync), the engine retries every member once as a
+  full-padded *single*, so one poisoned input fails alone instead of
+  failing its whole batch (injectable via
+  ``RAFT_FAULT_SERVING_POISON_NTH``).
+* **Health/readiness** — ``health()`` summarizes the engine for a load
+  balancer probe (``starting/warming/ready/degraded/open/closed``),
+  and every robustness signal (swaps, rollbacks, breaker trips, queue
+  depth, in-flight batches) streams through
+  :class:`~raft_tpu.serving.metrics.ServingMetrics`.
+* **Hot model swap** — :meth:`swap_predictor` atomically replaces the
+  predictor between batches (the dispatch path reads it under a lock),
+  the primitive :class:`~raft_tpu.serving.reload.HotReloader` builds
+  canary-validated checkpoint reload on. In-flight batches already
+  captured the old weights at dispatch and complete normally.
+
 The engine *reuses* :class:`raft_tpu.evaluate.FlowPredictor` — including
 its ``corr_impl="auto"`` per-shape engine choice and its compiled-
 executable cache — rather than duplicating the forward; the serve path
@@ -40,12 +66,16 @@ import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from raft_tpu.serving.batcher import (BacklogFull, QueuedRequest,
+from raft_tpu.resilience import active_injector
+from raft_tpu.serving import health as health_mod
+from raft_tpu.serving.batcher import (PRIORITY_HIGH, PRIORITY_LOW,
+                                      BacklogFull, QueuedRequest,
                                       RequestTimedOut, ShapeBucketBatcher)
+from raft_tpu.serving.health import CircuitBreaker, EngineUnhealthy
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
 from raft_tpu.utils.padder import InputPadder
@@ -94,7 +124,8 @@ class ServingConfig:
         vertical padding, "kitti" bottom-pads).
       factor: pad-to multiple (8 for stride-8 RAFT features).
       max_pending: backlog cap; submits beyond it raise
-        :class:`~raft_tpu.serving.batcher.BacklogFull`.
+        :class:`~raft_tpu.serving.batcher.BacklogFull` — except a HIGH
+        submit, which first sheds the youngest queued LOW request.
       queue_timeout_ms: per-request time-in-queue budget. A request
         still undispatched this long after submit has its future
         completed with :class:`~raft_tpu.serving.batcher
@@ -110,6 +141,11 @@ class ServingConfig:
         warn and ignore donation).
       persistent_cache: falsy → leave XLA's cache config alone; True →
         wire the default location; a string → wire that directory.
+      breaker_threshold: consecutive dispatch/sync failures that trip
+        the circuit breaker OPEN (submit then fails fast with
+        :class:`~raft_tpu.serving.health.EngineUnhealthy`).
+      breaker_cooldown_s: seconds OPEN before the breaker half-opens
+        and lets one probe batch test the device again.
     """
 
     max_batch: int = 8
@@ -122,6 +158,8 @@ class ServingConfig:
     pipeline_depth: int = 2
     donate: Optional[bool] = None
     persistent_cache: object = None
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 30.0
 
 
 class ServingEngine:
@@ -136,6 +174,7 @@ class ServingEngine:
         engine.start()                                  # warms buckets
         fut = engine.submit(image1, image2)             # thread-safe
         flow = fut.result()                             # (H, W, 2) numpy
+        engine.health()                                 # LB probe dict
         engine.close()                                  # drains in-flight
 
     Futures resolve to the *unpadded* full-resolution flow, bit-identical
@@ -157,19 +196,42 @@ class ServingEngine:
         if donate is None:
             donate = jax.default_backend() == "tpu"
         predictor.donate_images = donate
+        self._donate = donate
         self.metrics = ServingMetrics()
         self.stages = HostStageTimer()
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s)
         self.batcher = ShapeBucketBatcher(
             max_batch=self.config.max_batch,
             max_wait_s=self.config.max_wait_ms / 1e3,
             max_pending=self.config.max_pending)
         self._inflight: queue.Queue = queue.Queue(
             maxsize=max(self.config.pipeline_depth, 1))
+        self._inflight_batches = 0
         self._dispatcher: Optional[threading.Thread] = None
         self._completer: Optional[threading.Thread] = None
         self._started = False
+        self._warming = False
         self._closed = False
         self._fatal: Optional[BaseException] = None
+        # Serializes predictor reads on the dispatch path against
+        # swap_predictor (hot reload): swaps land *between* batches,
+        # never mid-dispatch.
+        self._swap_lock = threading.Lock()
+        # Degradation flags beyond the breaker (e.g. "canary-rollback"
+        # while the reloader pins the old model past a bad checkpoint).
+        self._degraded_reasons: set = set()
+        self._state_lock = threading.Lock()
+        self._submit_seq = 0
+        m = self.metrics
+        m.set_gauge_source("queue_depth", self.batcher.pending)
+        m.set_gauge_source("inflight_batches",
+                           lambda: self._inflight_batches)
+        m.set_gauge_source("breaker_trips", lambda: self.breaker.trips)
+        m.set_gauge_source(
+            "health_state",
+            lambda: health_mod.HEALTH_CODES[self.health_state()])
 
     # -- lifecycle ------------------------------------------------------
 
@@ -197,20 +259,27 @@ class ServingEngine:
         triggers a fresh XLA compile. Returns per-bucket
         ``{"compiles": n, "seconds": s}`` stats."""
         stats: Dict[Tuple[int, int], Dict[str, float]] = {}
-        for raw_hw in self.config.buckets:
-            padder = InputPadder((*raw_hw, 3), mode=self.config.pad_mode,
-                                 factor=self.config.factor)
-            ph, pw = padder.padded_shape
-            # Two distinct host arrays: with donation on, aliasing one
-            # device buffer into both donated args would be rejected.
-            z1 = np.zeros((self.config.max_batch, ph, pw, 3), np.float32)
-            z2 = np.zeros_like(z1)
-            t0 = time.perf_counter()
-            with CompileWatch() as w:
-                out = self.predictor.dispatch_batch(z1, z2)
-                np.asarray(out[1])            # sync: compile + one run
-            stats[(ph, pw)] = {"compiles": float(w.compiles),
-                               "seconds": time.perf_counter() - t0}
+        self._warming = True
+        try:
+            for raw_hw in self.config.buckets:
+                padder = InputPadder((*raw_hw, 3),
+                                     mode=self.config.pad_mode,
+                                     factor=self.config.factor)
+                ph, pw = padder.padded_shape
+                # Two distinct host arrays: with donation on, aliasing
+                # one device buffer into both donated args would be
+                # rejected.
+                z1 = np.zeros((self.config.max_batch, ph, pw, 3),
+                              np.float32)
+                z2 = np.zeros_like(z1)
+                t0 = time.perf_counter()
+                with CompileWatch() as w:
+                    out = self.predictor.dispatch_batch(z1, z2)
+                    np.asarray(out[1])        # sync: compile + one run
+                stats[(ph, pw)] = {"compiles": float(w.compiles),
+                                   "seconds": time.perf_counter() - t0}
+        finally:
+            self._warming = False
         return stats
 
     def close(self, timeout: Optional[float] = None) -> None:
@@ -232,13 +301,96 @@ class ServingEngine:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- health / hot swap ----------------------------------------------
+
+    def health_state(self) -> str:
+        """The engine's readiness state, one of
+        :mod:`raft_tpu.serving.health`'s ``STARTING / WARMING / READY /
+        DEGRADED / OPEN / CLOSED``. The single string a load balancer
+        routes on: ``ready`` and ``degraded`` take traffic, everything
+        else doesn't."""
+        if self._closed:
+            return health_mod.CLOSED
+        if self._warming:
+            return health_mod.WARMING
+        if not self._started:
+            return health_mod.STARTING
+        b = self.breaker.state
+        if b == CircuitBreaker.OPEN:
+            return health_mod.OPEN
+        with self._state_lock:
+            degraded = bool(self._degraded_reasons)
+        if b == CircuitBreaker.HALF_OPEN or degraded:
+            return health_mod.DEGRADED
+        return health_mod.READY
+
+    def health(self) -> Dict[str, object]:
+        """Readiness probe payload: the state string plus the numbers
+        an operator wants next to it (breaker state/trips/failure
+        streak, degradation reasons, queue depth, in-flight batches,
+        swap/rollback totals)."""
+        state = self.health_state()
+        with self._state_lock:
+            reasons = sorted(self._degraded_reasons)
+        return {
+            "state": state,
+            "ready": state in (health_mod.READY, health_mod.DEGRADED),
+            "breaker": self.breaker.state,
+            "breaker_trips": self.breaker.trips,
+            "consecutive_failures": self.breaker.consecutive_failures,
+            "degraded_reasons": reasons,
+            "queue_depth": self.batcher.pending(),
+            "inflight_batches": self._inflight_batches,
+            "swaps": self.metrics.swaps,
+            "rollbacks": self.metrics.rollbacks,
+        }
+
+    def set_degraded(self, reason: str) -> None:
+        """Flag a non-breaker degradation (e.g. the hot reloader pinned
+        the current model after a canary rollback). The engine keeps
+        serving; ``health()`` reports ``degraded`` until cleared."""
+        with self._state_lock:
+            self._degraded_reasons.add(reason)
+
+    def clear_degraded(self, reason: str) -> None:
+        with self._state_lock:
+            self._degraded_reasons.discard(reason)
+
+    def swap_predictor(self, new_predictor) -> None:
+        """Atomically swap the serving model between batches.
+
+        The dispatch path reads ``self.predictor`` under the swap lock,
+        so the swap waits for an in-progress dispatch call and the next
+        batch runs the new model; batches already in flight captured
+        the old weights at dispatch and complete normally — no request
+        is dropped or torn across models. This is the commit point of
+        :class:`~raft_tpu.serving.reload.HotReloader`; counted in
+        ``metrics.swaps`` and clears any ``canary-rollback``
+        degradation from a previously pinned bad checkpoint."""
+        new_predictor.donate_images = self._donate
+        with self._swap_lock:
+            self.predictor = new_predictor
+        self.metrics.record_swap()
+        self.clear_degraded("canary-rollback")
+
+    def record_rollback(self, reason: str) -> None:
+        """A canary-failed reload was rolled back: count it and mark
+        the engine degraded (serving safely, but refusing a newer
+        committed checkpoint — an operator signal, not an outage)."""
+        self.metrics.record_rollback()
+        self.set_degraded("canary-rollback")
+
     # -- client API -----------------------------------------------------
 
-    def submit(self, image1: np.ndarray, image2: np.ndarray):
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               priority: str = PRIORITY_HIGH):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
         ``image1``/``image2``: (H, W, 3) float arrays in [0, 255], any
-        resolution (padded here, in the caller's thread). Thread-safe.
+        resolution (padded here, in the caller's thread).
+        ``priority``: ``"high"`` (default — batches first) or ``"low"``
+        (background class: batched after HIGH, first shed under a full
+        backlog). Thread-safe.
         """
         if not self._started:
             raise RuntimeError("engine not started (call start())")
@@ -248,6 +400,16 @@ class ServingEngine:
             raise RuntimeError(
                 "serving engine hit a fatal dispatch error") \
                 from self._fatal
+        if not self.breaker.admits():
+            # Fail fast: the device path is failing consistently;
+            # queueing would only delay the same failure.
+            self.metrics.record_breaker_fastfail()
+            self.metrics.record_reject()
+            raise EngineUnhealthy(
+                f"circuit breaker open after "
+                f"{self.breaker.consecutive_failures} consecutive "
+                f"dispatch failures; retrying after "
+                f"{self.config.breaker_cooldown_s:.1f}s cooldown")
         if image1.shape != image2.shape:
             raise ValueError(f"frame shapes differ: {image1.shape} vs "
                              f"{image2.shape}")
@@ -258,20 +420,35 @@ class ServingEngine:
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
+        with self._state_lock:
+            self._submit_seq += 1
+            seq = self._submit_seq
         req = QueuedRequest(im1, im2, padder, bucket=padder.padded_shape,
-                            t_submit=t_submit, deadline=deadline)
+                            t_submit=t_submit, deadline=deadline,
+                            priority=priority,
+                            poisoned=active_injector()
+                            .poisons_request(seq))
         try:
-            self.batcher.enqueue(req)
+            evicted = self.batcher.enqueue(req)
         except BacklogFull:
             # Shed counted on top of the rejection: the shed rate is
             # the capacity signal, the reject total the error rate.
-            self.metrics.record_shed()
+            self.metrics.record_shed(priority)
             self.metrics.record_reject()
             raise
         except RuntimeError:
             self.metrics.record_reject()
             raise
-        self.metrics.record_submit(self.batcher.pending())
+        if evicted is not None:
+            # A queued LOW request was shed to admit this HIGH one; its
+            # client gets the same BacklogFull it would have gotten at
+            # submit time, just later.
+            evicted.future.set_exception(BacklogFull(
+                "shed from the backlog by a higher-priority request"))
+            self.metrics.record_shed(evicted.priority)
+            self.metrics.record_reject()
+        self.metrics.record_submit(self.batcher.pending(),
+                                   priority=priority)
         return req.future
 
     def predict(self, image1: np.ndarray, image2: np.ndarray,
@@ -303,6 +480,33 @@ class ServingEngine:
         finally:
             self._inflight.put(None)
 
+    def _stack(self, batch: List[QueuedRequest]):
+        n = len(batch)
+        with self.stages.stage("stack"):
+            i1 = np.stack([r.image1 for r in batch])
+            i2 = np.stack([r.image2 for r in batch])
+            if n < self.config.max_batch:
+                reps = self.config.max_batch - n
+                # Tail-pad by repeating the last request — same rule as
+                # batched eval; one executable per bucket, never one per
+                # partial size.
+                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
+                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
+        return i1, i2
+
+    def _dispatch_arrays(self, batch: List[QueuedRequest], i1, i2):
+        """The guarded device entry: fault-injection hooks (a poisoned
+        request in the batch, or an injected transient dispatch error)
+        fire before the device is touched; the predictor is read under
+        the swap lock so hot reloads land between batches."""
+        inj = active_injector()
+        if any(r.poisoned for r in batch):
+            raise RuntimeError(
+                "injected poisoned input in dispatched batch")
+        inj.maybe_fail_serving_dispatch()
+        with self._swap_lock:
+            return self.predictor.dispatch_batch(i1, i2)
+
     def _dispatch_one(self, batch: List[QueuedRequest]) -> None:
         # Expire requests whose time-in-queue budget ran out while they
         # waited for a batch slot: complete them with a clear error and
@@ -319,34 +523,67 @@ class ServingEngine:
             batch = [r for r in batch if not r.expired(now)]
             if not batch:
                 return
+        if not self.breaker.admits():
+            # OPEN mid-cooldown: this batch was queued before the trip
+            # (or raced it). Fail it fast rather than feeding a failing
+            # device — the same contract submit gives new requests.
+            exc = EngineUnhealthy(
+                "circuit breaker open; request drained without dispatch")
+            for r in batch:
+                r.future.set_exception(exc)
+            self.metrics.record_breaker_fastfail(len(batch))
+            self.metrics.record_error(len(batch))
+            return
         n = len(batch)
-        with self.stages.stage("stack"):
-            i1 = np.stack([r.image1 for r in batch])
-            i2 = np.stack([r.image2 for r in batch])
-            if n < self.config.max_batch:
-                reps = self.config.max_batch - n
-                # Tail-pad by repeating the last request — same rule as
-                # batched eval; one executable per bucket, never one per
-                # partial size.
-                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
-                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
+        i1, i2 = self._stack(batch)
         c0 = xla_compile_count()
         try:
             with self.stages.stage("dispatch"):
                 # Non-blocking: device_put + async dispatch. The device
                 # computes while this thread loops back to stack the
                 # next batch.
-                out = self.predictor.dispatch_batch(i1, i2)
+                out = self._dispatch_arrays(batch, i1, i2)
         except Exception as e:
-            for r in batch:
-                r.future.set_exception(e)
-            self.metrics.record_error(n)
+            self.breaker.record_failure()
+            self._isolate_failed_batch(batch, e)
             return
         self.metrics.record_batch(n, self.config.max_batch,
                                   compiles=xla_compile_count() - c0)
         # Bounded queue: blocks when pipeline_depth batches are already
         # in flight — backpressure instead of unbounded device queueing.
+        with self._state_lock:
+            self._inflight_batches += 1
         self._inflight.put((batch, out))
+
+    def _isolate_failed_batch(self, batch: List[QueuedRequest],
+                              cause: BaseException) -> None:
+        """Batch error isolation: a failed batch (dispatch or sync) is
+        retried once as full-padded singles, so one poisoned input (or
+        a value-dependent device error) fails alone instead of failing
+        every co-batched neighbor. Singles reuse the bucket's
+        ``max_batch`` executable (self-tail-padded), so isolation never
+        compiles. A lone request has no neighbors to save — it just
+        fails with the original error."""
+        if len(batch) <= 1:
+            for r in batch:
+                r.future.set_exception(cause)
+            self.metrics.record_error(len(batch))
+            return
+        for r in batch:
+            try:
+                i1, i2 = self._stack([r])
+                out = self._dispatch_arrays([r], i1, i2)
+                with self.stages.stage("sync"):
+                    flow_up = np.asarray(out[1])
+            except Exception as e:
+                r.future.set_exception(e)
+                self.metrics.record_error(1)
+                self.breaker.record_failure()
+                continue
+            r.future.set_result(r.padder.unpad(flow_up[0]))
+            self.metrics.record_done(time.monotonic() - r.t_submit)
+            self.metrics.record_isolated_retry()
+            self.breaker.record_success()
 
     def _completion_loop(self) -> None:
         while True:
@@ -358,10 +595,14 @@ class ServingEngine:
                 with self.stages.stage("sync"):
                     flow_up = np.asarray(out[1])   # blocks until done
             except Exception as e:
-                for r in batch:
-                    r.future.set_exception(e)
-                self.metrics.record_error(len(batch))
+                with self._state_lock:
+                    self._inflight_batches -= 1
+                self.breaker.record_failure()
+                self._isolate_failed_batch(batch, e)
                 continue
+            with self._state_lock:
+                self._inflight_batches -= 1
+            self.breaker.record_success()
             now = time.monotonic()
             with self.stages.stage("unpad"):
                 for j, r in enumerate(batch):

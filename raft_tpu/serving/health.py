@@ -1,0 +1,178 @@
+"""Serving health model: engine states and the dispatch circuit breaker.
+
+A load balancer (or an operator) needs one question answered per
+replica: *should traffic go here?* This module gives the serving engine
+a first-class answer instead of "it hasn't crashed yet":
+
+* **Health states** — the engine's lifecycle and degradation summary,
+  exposed via ``ServingEngine.health()`` and streamed as a numeric
+  gauge through :class:`~raft_tpu.serving.metrics.ServingMetrics`:
+
+  - ``STARTING`` — constructed, worker threads not yet running (not
+    ready; don't route).
+  - ``WARMING``  — pre-compiling bucket executables (not ready yet).
+  - ``READY``    — serving normally.
+  - ``DEGRADED`` — serving, but something is off: the breaker is
+    half-open (probing after a failure burst) or the hot-reloader
+    pinned the current model after a canary rollback (a newer
+    committed checkpoint exists but failed validation). Traffic is
+    safe; page a human.
+  - ``OPEN``     — the circuit breaker tripped: dispatch is failing
+    consistently, submits fail fast with :class:`EngineUnhealthy`.
+    Route elsewhere.
+  - ``CLOSED``   — the engine was shut down (terminal).
+
+* **:class:`CircuitBreaker`** — the classic three-state breaker
+  (Nygard, *Release It!*; the same shape Clipper puts in front of
+  model containers) around the device dispatch path. ``threshold``
+  consecutive dispatch/sync failures trip it OPEN: submits and queued
+  batches fail fast with :class:`EngineUnhealthy` instead of queueing
+  doomed work behind a sick accelerator. After ``cooldown_s`` it
+  half-opens: the next batch through is the probe — one success closes
+  the breaker, one failure re-opens it and re-arms the cooldown.
+
+The breaker is deliberately JAX-free and clock-injectable so every
+transition is unit-testable without a device or a sleep.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+# -- health states ------------------------------------------------------
+
+STARTING = "starting"
+WARMING = "warming"
+READY = "ready"
+DEGRADED = "degraded"
+OPEN = "open"
+CLOSED = "closed"
+
+# Numeric encoding for the scalar stream (TrainLogger/JSONL want
+# floats): ordered roughly by "how routable is this replica".
+HEALTH_CODES: Dict[str, int] = {
+    STARTING: 0,
+    WARMING: 1,
+    READY: 2,
+    DEGRADED: 3,
+    OPEN: 4,
+    CLOSED: 5,
+}
+
+
+class EngineUnhealthy(RuntimeError):
+    """Fail-fast rejection while the dispatch circuit breaker is open.
+
+    Raised by ``ServingEngine.submit`` (and set on already-queued
+    requests the dispatcher drains while open): the device path is
+    failing consistently, so queueing more work would only grow tail
+    latency on requests that are going to fail anyway. Clients should
+    back off and retry elsewhere; the breaker half-opens after its
+    cooldown and recovers on the first healthy probe batch.
+    """
+
+
+class CircuitBreaker:
+    """Three-state breaker around the serving dispatch path.
+
+    States (``.state``): ``"closed"`` (normal — everything admitted),
+    ``"open"`` (tripped — nothing admitted until ``cooldown_s``
+    elapses), ``"half-open"`` (cooldown elapsed — requests are admitted
+    again and the next dispatch is the probe: its success closes the
+    breaker, its failure re-opens it and re-arms the cooldown).
+
+    The owner reports device-path outcomes with :meth:`record_failure`
+    / :meth:`record_success`; ``threshold`` *consecutive* failures trip
+    the breaker (a single success resets the streak). ``trips`` counts
+    every transition into OPEN (first trip and every failed probe), the
+    alerting signal :class:`~raft_tpu.serving.metrics.ServingMetrics`
+    streams.
+
+    Thread-safe; ``clock`` is injectable so tests drive the cooldown
+    without sleeping.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self.trips = 0              # transitions into OPEN, monotonic
+
+    # -- internal (caller holds the lock) --------------------------------
+
+    def _tick(self) -> None:
+        """Lazy OPEN -> HALF_OPEN transition once the cooldown elapsed
+        (no timer thread: the state is re-derived on every inquiry)."""
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            self._state = self.HALF_OPEN
+
+    def _trip(self) -> None:
+        if self._state != self.OPEN:
+            self.trips += 1
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+
+    # -- owner API -------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick()
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    def admits(self) -> bool:
+        """Whether new work may enter the dispatch path right now.
+
+        False only while OPEN with the cooldown still running; a
+        half-open breaker admits (the admitted work is the probe).
+        Shared by ``submit`` (fail fast with :class:`EngineUnhealthy`)
+        and the dispatcher (drain already-queued batches fast instead
+        of feeding them to a failing device).
+        """
+        return self.state != self.OPEN
+
+    def record_failure(self) -> None:
+        """One device-path attempt (batch or isolation single) failed.
+
+        In HALF_OPEN this is the probe failing: re-open immediately and
+        re-arm the cooldown. In CLOSED, trip once the consecutive
+        streak reaches ``threshold``.
+        """
+        with self._lock:
+            self._tick()
+            self._consecutive_failures += 1
+            if self._state == self.HALF_OPEN:
+                self._trip()
+            elif (self._state == self.CLOSED
+                  and self._consecutive_failures >= self.threshold):
+                self._trip()
+
+    def record_success(self) -> None:
+        """One device-path attempt succeeded: reset the failure streak;
+        a half-open probe success closes the breaker."""
+        with self._lock:
+            self._tick()
+            self._consecutive_failures = 0
+            if self._state != self.CLOSED:
+                self._state = self.CLOSED

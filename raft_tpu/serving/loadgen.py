@@ -114,6 +114,7 @@ def sequential_baseline(predictor, frames, n_requests: int,
 
 def run_load(engine, frames, n_requests: int, concurrency: int = 8,
              references: Optional[List[np.ndarray]] = None,
+             alt_references: Optional[List[np.ndarray]] = None,
              timeout: float = 300.0) -> Dict[str, object]:
     """Fire ``n_requests`` through ``engine`` from ``concurrency`` client
     threads (request i uses ``frames[i % len(frames)]``; each thread
@@ -121,16 +122,27 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     closed-loop clients, so ``concurrency`` bounds in-flight requests).
 
     With ``references`` (aligned to ``frames``), every response is
-    checked bit-for-bit. Returns a dict with ``ok``, ``completed``,
+    checked bit-for-bit. ``alt_references`` names a second acceptable
+    model's outputs (aligned the same way): a response is correct when
+    it bit-matches EITHER list — the hot-reload drill's contract, where
+    a request is served by exactly the old or the new model, never a
+    blend, and never garbage. Returns a dict with ``ok``, ``completed``,
     ``dropped`` (exceptions, by request index), ``mismatched`` (request
-    indices whose flow differed), ``seconds``, ``throughput_rps``, and
-    the engine's metrics snapshot/histogram.
+    indices whose flow matched neither reference), ``matched_primary``/
+    ``matched_alt`` counts, ``seconds``, ``throughput_rps``, and the
+    engine's metrics snapshot/histogram.
     """
     lock = threading.Lock()
     next_req = [0]
     dropped: List[int] = []
     mismatched: List[int] = []
     completed = [0]
+    matched_primary = [0]
+    matched_alt = [0]
+
+    def _matches(flow, ref) -> bool:
+        return (ref is not None and flow.shape == ref.shape
+                and np.array_equal(flow, ref))
 
     def client():
         while True:
@@ -150,8 +162,15 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
                 completed[0] += 1
             if references is not None:
                 ref = references[i % len(frames)]
-                if flow.shape != ref.shape or not np.array_equal(flow,
-                                                                 ref):
+                alt = (alt_references[i % len(frames)]
+                       if alt_references is not None else None)
+                if _matches(flow, ref):
+                    with lock:
+                        matched_primary[0] += 1
+                elif _matches(flow, alt):
+                    with lock:
+                        matched_alt[0] += 1
+                else:
                     with lock:
                         mismatched.append(i)
 
@@ -171,6 +190,8 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
         "completed": completed[0],
         "dropped": sorted(dropped),
         "mismatched": sorted(mismatched),
+        "matched_primary": matched_primary[0],
+        "matched_alt": matched_alt[0],
         "seconds": dt,
         "throughput_rps": n_requests / dt if dt > 0 else 0.0,
         "latency_ms": engine.metrics.latency_ms(),

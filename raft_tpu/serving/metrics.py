@@ -12,13 +12,20 @@ in one thread-safe place:
   *hits*, persistent or in-memory, don't emit it). The warmup routine
   uses it to prove the configured buckets compiled, tests use it to
   prove post-warmup requests didn't.
-* :class:`ServingMetrics` — request/response counters, a rolling
-  latency window (p50/p95/p99), the batch-size histogram (how well the
-  dynamic batcher is filling batches), padded-slot waste, queue-depth
-  peak, and wall-clock throughput. ``snapshot()`` returns a flat dict
-  of floats shaped for :meth:`raft_tpu.utils.logger.TrainLogger
-  .write_dict`, so serving metrics stream to the same JSONL/TensorBoard
-  sinks as training scalars.
+* :class:`ServingMetrics` — request/response counters (per priority
+  class), a rolling latency window (p50/p95/p99), the batch-size
+  histogram (how well the dynamic batcher is filling batches),
+  padded-slot waste, queue-depth peak, wall-clock throughput, and the
+  robustness-layer counters: model ``swaps`` / canary ``rollbacks``
+  (hot reload), ``isolated_retries`` (batch error isolation singles),
+  ``breaker_fastfails`` (requests rejected while the circuit breaker
+  was open). Live *gauges* — current queue depth, in-flight batch
+  count, health-state code, breaker trip count — are wired by the
+  engine as callables (:meth:`ServingMetrics.set_gauge_source`) so
+  every snapshot reads the instantaneous value. ``snapshot()`` returns
+  a flat dict of floats shaped for :meth:`raft_tpu.utils.logger
+  .TrainLogger.write_dict`, so serving metrics stream to the same
+  JSONL/TensorBoard sinks as training scalars.
 """
 
 from __future__ import annotations
@@ -117,8 +124,10 @@ class ServingMetrics:
         self._lat: deque = deque(maxlen=latency_window)
         self.batch_hist: Counter = Counter()
         self.requests = 0          # accepted submits
+        self.requests_by_class = Counter()   # priority -> accepted
         self.rejected = 0          # backlog-full / closed rejections
         self.sheds = 0             # BacklogFull load-sheds specifically
+        self.sheds_by_class = Counter()      # priority -> sheds
         self.responses = 0         # futures resolved with a result
         self.errors = 0            # futures resolved with an exception
         self.timeouts = 0          # futures resolved with RequestTimedOut
@@ -126,14 +135,31 @@ class ServingMetrics:
         self.padded_slots = 0
         self.compiles = 0          # fresh XLA compiles on the serve path
         self.queue_depth_peak = 0
+        self.swaps = 0             # hot checkpoint reloads served live
+        self.rollbacks = 0         # canary-failed reloads rolled back
+        self.isolated_retries = 0  # batch-failure singles that served
+        self.breaker_fastfails = 0  # requests failed fast while OPEN
+        # name -> zero-arg callable; the engine wires live gauges
+        # (queue depth, in-flight batches, health code, breaker trips)
+        # so snapshot() reads the instantaneous value.
+        self._gauge_sources: Dict[str, object] = {}
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
 
     # -- recording (engine-internal) -----------------------------------
 
-    def record_submit(self, queue_depth: int) -> None:
+    def set_gauge_source(self, name: str, fn) -> None:
+        """Register a live gauge: ``snapshot()`` emits
+        ``serving_<name> = float(fn())`` (0.0 if the callable raises —
+        a gauge must never take the scalar stream down)."""
+        with self._lock:
+            self._gauge_sources[name] = fn
+
+    def record_submit(self, queue_depth: int,
+                      priority: str = "high") -> None:
         with self._lock:
             self.requests += 1
+            self.requests_by_class[priority] += 1
             if self._t_first is None:
                 self._t_first = time.perf_counter()
             if queue_depth > self.queue_depth_peak:
@@ -143,13 +169,43 @@ class ServingMetrics:
         with self._lock:
             self.rejected += 1
 
-    def record_shed(self) -> None:
-        """A ``BacklogFull`` load-shed. Counted on top of
+    def record_shed(self, priority: str = "high") -> None:
+        """A ``BacklogFull`` load-shed (a rejected submit, or a queued
+        LOW request evicted for an arriving HIGH). Counted on top of
         ``record_reject`` (every shed is a rejection; closed-engine
         rejections are not sheds): the shed rate is the capacity-planning
         signal, the reject total is the client-visible error rate."""
         with self._lock:
             self.sheds += 1
+            self.sheds_by_class[priority] += 1
+
+    def record_swap(self) -> None:
+        """A hot checkpoint reload passed its canary and was swapped
+        into the live engine."""
+        with self._lock:
+            self.swaps += 1
+
+    def record_rollback(self) -> None:
+        """A hot checkpoint reload FAILED its canary and was rolled
+        back (the previous model stays pinned). Page-worthy: newer
+        committed checkpoints exist that this replica refuses to
+        serve."""
+        with self._lock:
+            self.rollbacks += 1
+
+    def record_isolated_retry(self, n: int = 1) -> None:
+        """Requests from a failed batch that served successfully on the
+        retry-as-singles isolation pass (their batch neighbor — e.g. a
+        poisoned input — would otherwise have failed them)."""
+        with self._lock:
+            self.isolated_retries += n
+
+    def record_breaker_fastfail(self, n: int = 1) -> None:
+        """Requests failed fast with ``EngineUnhealthy`` while the
+        dispatch circuit breaker was open (at submit or drained from
+        the queue)."""
+        with self._lock:
+            self.breaker_fastfails += n
 
     def record_batch(self, size: int, padded_to: int,
                      compiles: int = 0) -> None:
@@ -210,8 +266,14 @@ class ServingMetrics:
         with self._lock:
             out = {
                 "serving_requests": float(self.requests),
+                "serving_requests_high": float(
+                    self.requests_by_class["high"]),
+                "serving_requests_low": float(
+                    self.requests_by_class["low"]),
                 "serving_rejected": float(self.rejected),
                 "serving_shed": float(self.sheds),
+                "serving_shed_high": float(self.sheds_by_class["high"]),
+                "serving_shed_low": float(self.sheds_by_class["low"]),
                 "serving_responses": float(self.responses),
                 "serving_errors": float(self.errors),
                 "serving_timeouts": float(self.timeouts),
@@ -219,7 +281,18 @@ class ServingMetrics:
                 "serving_padded_slots": float(self.padded_slots),
                 "serving_compiles": float(self.compiles),
                 "serving_queue_depth_peak": float(self.queue_depth_peak),
+                "serving_swaps": float(self.swaps),
+                "serving_rollbacks": float(self.rollbacks),
+                "serving_isolated_retries": float(self.isolated_retries),
+                "serving_breaker_fastfails": float(
+                    self.breaker_fastfails),
             }
+            gauges = dict(self._gauge_sources)
+        for name, fn in gauges.items():
+            try:
+                out[f"serving_{name}"] = float(fn())
+            except Exception:
+                out[f"serving_{name}"] = 0.0
         out["serving_latency_p50_ms"] = lat["p50"]
         out["serving_latency_p95_ms"] = lat["p95"]
         out["serving_latency_p99_ms"] = lat["p99"]
@@ -241,8 +314,10 @@ class ServingMetrics:
         lat = self.latency_ms()
         hist = ", ".join(f"{k}:{v}" for k, v in
                          sorted(self.batch_histogram().items()))
-        return (f"requests {self.requests} (rejected {self.rejected}, "
-                f"shed {self.sheds}) "
+        return (f"requests {self.requests} "
+                f"(hi {self.requests_by_class['high']} / "
+                f"lo {self.requests_by_class['low']}, "
+                f"rejected {self.rejected}, shed {self.sheds}) "
                 f"responses {self.responses} errors {self.errors} "
                 f"timeouts {self.timeouts} | "
                 f"{self.throughput():.2f} req/s, mean batch "
@@ -250,4 +325,7 @@ class ServingMetrics:
                 f"{lat['p50']:.1f} p95 {lat['p95']:.1f} p99 "
                 f"{lat['p99']:.1f} | batch hist {{{hist}}} | padded "
                 f"slots {self.padded_slots}, compiles {self.compiles}, "
-                f"queue peak {self.queue_depth_peak}")
+                f"queue peak {self.queue_depth_peak} | swaps "
+                f"{self.swaps}, rollbacks {self.rollbacks}, isolated "
+                f"retries {self.isolated_retries}, breaker fastfails "
+                f"{self.breaker_fastfails}")

@@ -1,0 +1,298 @@
+"""Hot checkpoint reload with canary validation and rollback.
+
+A serving replica should pick up the trainer's newly committed
+checkpoints without a restart (a restart costs the warmup compiles and
+drops its queue), but it must not blindly serve whatever appeared on
+disk — a checkpoint can be committed yet *bad* (a run that diverged, a
+mis-exported fine-tune, corrupted values). The
+:class:`HotReloader` closes that loop:
+
+* **Watch** — poll the run's :class:`~raft_tpu.checkpoint
+  .RunCheckpointer` for a newer *committed* step (commit gating means a
+  half-written multi-host save is never visible here; ``refresh()``
+  re-scans the directory another process is writing).
+* **Stage** — load the step's params into a standby
+  :class:`~raft_tpu.evaluate.FlowPredictor` built with
+  ``clone_with_variables``: it shares the serving predictor's compiled
+  executable cache, so the new weights run through the already-warmed
+  bucket executables with **zero fresh XLA compiles** (variables are a
+  traced argument of the jitted forward, not baked into it).
+* **Canary** — before any traffic sees the new model, run it on golden
+  fixture pairs and require: finite flow, mean end-point difference vs
+  the *currently serving* model within ``canary_max_epe`` (the two
+  models run the same inputs back to back — a drift band, not a
+  ground-truth benchmark), and no fresh compiles (``CompileWatch``)
+  beyond ``max_canary_compiles``.
+* **Swap or roll back** — on a passing canary,
+  ``engine.swap_predictor`` installs the standby atomically between
+  batches (in-flight batches complete on the old weights; nothing is
+  dropped). On a failing canary the step is **pinned** — recorded as
+  rejected so the watcher doesn't retry it every poll — the engine
+  keeps serving the old model, is marked ``degraded``
+  (``canary-rollback``), and ``metrics.rollbacks`` ticks for the
+  operator. A *newer* committed step is still eligible: one bad export
+  doesn't wedge the replica forever.
+
+Driven either deterministically (:meth:`HotReloader.poll_once`, what
+the drill and tests use) or by the background watcher thread
+(:meth:`start` / :meth:`stop`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.serving.metrics import CompileWatch
+from raft_tpu.utils.padder import InputPadder
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReloadConfig:
+    """Knobs for one :class:`HotReloader`.
+
+    Attributes:
+      poll_interval_s: watcher-thread poll cadence (ignored when the
+        owner drives ``poll_once`` directly).
+      canary_max_epe: max mean end-point difference (pixels) between
+        the candidate's and the serving model's flow on the canary
+        pairs. A *drift band*: consecutive training checkpoints move
+        outputs a little, a diverged or corrupted one moves them a lot
+        (or to NaN, which fails the finite check first). ``None``
+        disables the band (finite + compile checks still apply).
+      max_canary_compiles: fresh XLA compiles the canary may trigger
+        (default 0 — the standby must reuse the warmed executables;
+        a recompile would mean the checkpoint changed the variable
+        structure and every post-swap request would pay it again).
+    """
+
+    poll_interval_s: float = 5.0
+    canary_max_epe: Optional[float] = 5.0
+    max_canary_compiles: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CanaryResult:
+    """Outcome of validating one candidate checkpoint."""
+
+    passed: bool
+    reason: str
+    epe: float              # mean EPE vs the serving model (nan if n/a)
+    compiles: int
+
+
+class HotReloader:
+    """Watches a checkpoint directory and hot-swaps the serving model.
+
+    Args:
+      engine: the :class:`~raft_tpu.serving.engine.ServingEngine` to
+        feed (must expose ``predictor``, ``config``,
+        ``swap_predictor``, ``record_rollback``).
+      ckpt_dir: the trainer's checkpoint directory (commit-gated).
+      canary_frames: golden fixture pairs ``[(image1, image2), ...]``,
+        raw (H, W, 3) float frames — padded here with the engine's pad
+        mode and tail-padded to its ``max_batch`` so the canary runs
+        the exact serving executables.
+      config: :class:`ReloadConfig`.
+      checkpointer: injectable read-only
+        :class:`~raft_tpu.checkpoint.RunCheckpointer` (tests/drills
+        share one); constructed from ``ckpt_dir`` when omitted. Owned
+        (and closed) by the reloader only when it constructed it.
+    """
+
+    def __init__(self, engine, ckpt_dir: str,
+                 canary_frames: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 config: Optional[ReloadConfig] = None,
+                 checkpointer=None):
+        if not canary_frames:
+            raise ValueError("canary_frames must hold at least one "
+                             "(image1, image2) fixture pair")
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.canary_frames = list(canary_frames)
+        self.config = config or ReloadConfig()
+        self._owns_ckptr = checkpointer is None
+        if checkpointer is None:
+            from raft_tpu.checkpoint import RunCheckpointer
+            # Read-only: never gc_orphans (that is the trainer's job;
+            # a reader GCing would race the trainer's in-flight saves).
+            checkpointer = RunCheckpointer(ckpt_dir, gc_orphans=False)
+        self._ckptr = checkpointer
+        # Step currently being served (None until the first swap: the
+        # engine may have been constructed from a torch export or
+        # "random" rather than from this directory).
+        self.current_step: Optional[int] = None
+        # Canary-rejected steps, never retried (a newer step is still
+        # eligible — one bad export must not wedge the replica).
+        self.pinned_steps: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- canary ----------------------------------------------------------
+
+    def _canary_batches(self):
+        """Pad + stack every fixture pair to the engine's serving shape
+        (full ``max_batch`` via tail-repeat) so the canary exercises
+        exactly the executables traffic uses."""
+        cfg = self.engine.config
+        for image1, image2 in self.canary_frames:
+            padder = InputPadder(image1.shape, mode=cfg.pad_mode,
+                                 factor=cfg.factor)
+            im1, im2 = padder.pad(image1, image2)
+            b1 = np.repeat(im1[None], cfg.max_batch, 0)
+            b2 = np.repeat(im2[None], cfg.max_batch, 0)
+            yield b1, b2
+
+    def run_canary(self, standby) -> CanaryResult:
+        """Validate ``standby`` against the currently serving model on
+        the golden pairs: finite flow, mean-EPE drift within the band,
+        zero (configurable) fresh compiles."""
+        cfg = self.config
+        epes = []
+        with CompileWatch() as watch:
+            for b1, b2 in self._canary_batches():
+                # Same inputs through both models; slot 0 is the real
+                # fixture (the rest is tail padding).
+                _, cur_up = self.engine.predictor.predict_batch(b1, b2)
+                _, new_up = standby.predict_batch(b1, b2)
+                new0 = new_up[0]
+                if not np.isfinite(new0).all():
+                    return CanaryResult(
+                        False, "non-finite flow from candidate model",
+                        float("nan"), watch.compiles)
+                epes.append(float(np.mean(np.sqrt(np.sum(
+                    (new0 - cur_up[0]) ** 2, axis=-1)))))
+        epe = float(np.mean(epes))
+        if watch.compiles > cfg.max_canary_compiles:
+            return CanaryResult(
+                False,
+                f"canary triggered {watch.compiles} fresh compiles "
+                f"(max {cfg.max_canary_compiles}) — candidate does not "
+                "share the warmed executables", epe, watch.compiles)
+        if cfg.canary_max_epe is not None and epe > cfg.canary_max_epe:
+            return CanaryResult(
+                False,
+                f"mean EPE vs serving model {epe:.3f} px exceeds the "
+                f"drift band ({cfg.canary_max_epe} px)", epe,
+                watch.compiles)
+        return CanaryResult(True, "ok", epe, watch.compiles)
+
+    # -- polling ---------------------------------------------------------
+
+    def _stage(self, step: int):
+        """Load step's params into a standby predictor sharing the
+        serving predictor's executable cache. The variables pytree
+        mirrors the serving model's top-level collections (include
+        ``batch_stats`` only if the current model carries it) so the
+        shared cache never retraces."""
+        import jax
+
+        from raft_tpu.checkpoint import load_params
+
+        params, batch_stats = load_params(self.ckpt_dir, step=step)
+        # Orbax hands back device-COMMITTED arrays; jit specializes on
+        # committed-ness, so feeding them straight into the shared
+        # executables would retrace (one fresh compile — exactly what
+        # the canary's zero-compile check catches). Host numpy leaves
+        # are placement-neutral and hit the warmed executables.
+        params = jax.tree_util.tree_map(np.asarray, params)
+        batch_stats = jax.tree_util.tree_map(np.asarray, batch_stats)
+        current = self.engine.predictor.variables
+        variables = {"params": params}
+        if "batch_stats" in current:
+            variables["batch_stats"] = batch_stats
+        for key in current:
+            if key not in variables:
+                variables[key] = current[key]
+        return self.engine.predictor.clone_with_variables(variables)
+
+    def poll_once(self) -> Dict[str, object]:
+        """One watch cycle: refresh the directory view, and if a newer
+        committed, un-pinned step exists, stage → canary → swap (or
+        pin + roll back). Returns an action record::
+
+            {"action": "none"}                            # nothing new
+            {"action": "swapped", "step": s, "epe": e}
+            {"action": "rolled_back", "step": s, "reason": r, "epe": e}
+
+        Exceptions while *loading* a step are treated as a failed
+        canary (pin + roll back) — a torn read must not kill the
+        watcher or leave the step retried forever.
+        """
+        self._ckptr.refresh()
+        step = self._ckptr.latest_step()
+        if (step is None or step in self.pinned_steps
+                or (self.current_step is not None
+                    and step <= self.current_step)):
+            return {"action": "none"}
+        try:
+            standby = self._stage(step)
+            result = self.run_canary(standby)
+        except Exception as e:
+            result = CanaryResult(
+                False, f"load/canary raised {type(e).__name__}: {e}",
+                float("nan"), 0)
+        if not result.passed:
+            self.pinned_steps.add(step)
+            self.engine.record_rollback(result.reason)
+            logger.warning(
+                "hot reload of step %d rolled back: %s (still serving "
+                "step %s)", step, result.reason, self.current_step)
+            return {"action": "rolled_back", "step": step,
+                    "reason": result.reason, "epe": result.epe}
+        self.engine.swap_predictor(standby)
+        self.current_step = step
+        logger.info("hot reload: now serving checkpoint step %d "
+                    "(canary EPE %.3f px, %d compiles)", step,
+                    result.epe, result.compiles)
+        return {"action": "swapped", "step": step, "epe": result.epe}
+
+    # -- background watcher ----------------------------------------------
+
+    def start(self) -> "HotReloader":
+        """Run :meth:`poll_once` every ``poll_interval_s`` in a daemon
+        thread until :meth:`stop`. A poll that raises is logged and
+        retried next interval (the watcher must outlive transient
+        filesystem hiccups)."""
+        if self._thread is not None:
+            raise RuntimeError("reloader already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.config.poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception as e:     # pragma: no cover - defensive
+                    logger.warning("hot-reload poll failed (%s: %s); "
+                                   "retrying next interval",
+                                   type(e).__name__, e)
+
+        self._thread = threading.Thread(
+            target=loop, name="serving-hot-reload", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop the watcher thread (if running) and release the
+        checkpointer this reloader constructed."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if self._owns_ckptr:
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "HotReloader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,30 +1,48 @@
 #!/usr/bin/env python
-"""CPU smoke drill for the serving engine (CI gate, runs in minutes).
+"""CPU drills for the serving engine (CI gates, run in minutes).
 
-Warms two buckets, fires 50 concurrent requests through
-:class:`raft_tpu.serving.engine.ServingEngine`, and exits nonzero on
-ANY dropped or incorrect response. Correctness is bit-exact: every
-served flow must equal the direct ``FlowPredictor`` output for the same
-pair — on this script's single-process default topology the batch-1
-``__call__`` path and the batched serve path are bit-identical (the
-acceptance criterion's wording); under a forced multi-device topology
-(``XLA_FLAGS=--xla_force_host_platform_device_count=N``) the check
-automatically uses the same-executable batched reference instead, which
-is exact on any topology (see loadgen docstring).
+1. smoke (``--drill smoke``) — warms two buckets, fires 50 concurrent
+   requests, exits nonzero on ANY dropped or bit-incorrect response or
+   any post-warmup XLA compile (the original serving gate).
+2. breaker-isolation (``--drill breaker-isolation``) — a poisoned
+   request (``RAFT_FAULT_SERVING_POISON_NTH``) fails ALONE while its
+   batch neighbors serve bit-exact via the retry-as-singles isolation
+   pass; then injected dispatch errors
+   (``RAFT_FAULT_SERVING_DISPATCH_ERRORS``) trip the circuit breaker
+   OPEN (submit fails fast with ``EngineUnhealthy``), a failed half-open
+   probe re-opens it, and a healthy probe closes it again.
+3. reload-under-load (``--drill reload-under-load``) — the headline
+   drill: 50 concurrent clients stream requests while a background
+   trainer commits two checkpoints — one good (passes the canary, hot
+   swap) and one fault-injected bad (NaN params, canary rollback). The
+   gate: zero dropped and zero bit-incorrect responses across the swap
+   (every response bit-matches exactly the old OR the new model — never
+   a blend, never garbage), exactly one swap, exactly one rollback,
+   zero fresh XLA compiles after warmup (the standby serves through the
+   shared bucket executables), and the breaker provably opens and
+   recovers under injected dispatch errors on the same engine.
 
-Also asserts the warmup contract — after the two buckets pre-compile,
-the 50 requests trigger ZERO fresh XLA compiles — and prints a one-line
-summary plus the engine's metrics report.
+Correctness is bit-exact: on this script's single-process default
+topology the batch-1 ``__call__`` path and the batched serve path are
+bit-identical; under a forced multi-device topology
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``) the checks
+automatically use the same-executable batched reference instead, exact
+on any topology (see loadgen docstring).
 
 Usage::
 
-    JAX_PLATFORMS=cpu python scripts/serve_drill.py
+    JAX_PLATFORMS=cpu python scripts/serve_drill.py [--drill NAME|--list]
 """
 
 from __future__ import annotations
 
+import argparse
 import os
 import sys
+import tempfile
+import threading
+import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -37,23 +55,36 @@ SHAPES = [(36, 60), (33, 57), (52, 76)]
 BUCKETS = ((36, 60), (52, 76))
 
 
-def main() -> int:
-    import jax
-
+def _make_predictor():
     from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+def _references(predictor, frames, max_batch: int):
+    """(references, description): bit-exact ground truth for this
+    topology — direct batch-1 on a single device, same-executable
+    batched elsewhere."""
+    import jax
+    from raft_tpu.serving import loadgen
+
+    if jax.device_count() == 1:
+        return (loadgen.reference_flows(predictor, frames),
+                "direct __call__ (batch-1, bit-exact single-device)")
+    return (loadgen.batched_reference_flows(predictor, frames,
+                                            max_batch=max_batch),
+            f"same-executable batched ({jax.device_count()} devices: "
+            "cross-executable float order differs)")
+
+
+def drill_smoke(root):
+    """50 concurrent requests: all served, all bit-exact, zero
+    post-warmup compiles."""
     from raft_tpu.serving import (CompileWatch, ServingConfig,
                                   ServingEngine, loadgen)
 
-    predictor = load_predictor("random", small=True, iters=2)
+    predictor = _make_predictor()
     frames = loadgen.make_frames(SHAPES, per_shape=2, seed=11)
-    if jax.device_count() == 1:
-        refs = loadgen.reference_flows(predictor, frames)
-        ref_kind = "direct __call__ (batch-1, bit-exact single-device)"
-    else:
-        refs = loadgen.batched_reference_flows(predictor, frames,
-                                               max_batch=4)
-        ref_kind = (f"same-executable batched ({jax.device_count()} "
-                    "devices: cross-executable float order differs)")
+    refs, ref_kind = _references(predictor, frames, max_batch=4)
 
     engine = ServingEngine(predictor, ServingConfig(
         max_batch=4, max_wait_ms=3.0, buckets=BUCKETS))
@@ -67,34 +98,357 @@ def main() -> int:
     finally:
         engine.close()
 
-    failures = []
-    if res["completed"] != N_REQUESTS:
-        failures.append(f"completed {res['completed']}/{N_REQUESTS}")
-    if res["dropped"]:
-        failures.append(f"dropped requests: {res['dropped']}")
-    if res["mismatched"]:
-        failures.append(f"incorrect responses: {res['mismatched']}")
-    if len(warm) != len(BUCKETS):
-        failures.append(f"warmup covered {len(warm)} of "
-                        f"{len(BUCKETS)} buckets")
-    if watch.compiles:
-        failures.append(f"{watch.compiles} fresh XLA compile(s) after "
-                        "warmup (warmup contract broken)")
-
-    print(f"serve_drill: {res['completed']}/{N_REQUESTS} responses, "
+    print(f"  {res['completed']}/{N_REQUESTS} responses, "
           f"{res['throughput_rps']:.1f} req/s at concurrency "
           f"{CONCURRENCY}; reference = {ref_kind}")
     warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
                           for k, v in warm.items())
-    print(f"warmup: {{bucket: compiles}} = {{{warm_desc}}}")
-    print("metrics:", engine.metrics.report())
-    print("host stages:", engine.stages.report())
-    if failures:
-        for f in failures:
-            print("FAIL:", f)
-        return 1
-    print("PASS: all responses bit-exact, no post-warmup compiles")
-    return 0
+    print(f"  warmup: {{bucket: compiles}} = {{{warm_desc}}}")
+    print("  metrics:", engine.metrics.report())
+    print("  host stages:", engine.stages.report())
+    assert res["completed"] == N_REQUESTS, \
+        f"completed {res['completed']}/{N_REQUESTS}"
+    assert not res["dropped"], f"dropped requests: {res['dropped']}"
+    assert not res["mismatched"], \
+        f"incorrect responses: {res['mismatched']}"
+    assert len(warm) == len(BUCKETS), \
+        f"warmup covered {len(warm)} of {len(BUCKETS)} buckets"
+    assert not watch.compiles, \
+        f"{watch.compiles} fresh XLA compile(s) after warmup"
+
+
+def _await_metric(read, target, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while read() < target:
+        if time.monotonic() >= deadline:
+            raise AssertionError(
+                f"timed out waiting for {what} >= {target} "
+                f"(at {read()})")
+        time.sleep(0.01)
+
+
+def _prove_breaker(engine, im1, im2, expected):
+    """Shared breaker proof (run on a live engine): 2 injected dispatch
+    errors trip the threshold-2 breaker OPEN, submit fails fast, a
+    failed half-open probe re-trips, a healthy probe closes it and
+    serves ``expected`` bit-exact."""
+    import numpy as np
+
+    from raft_tpu.resilience import FaultInjector, set_injector
+    from raft_tpu.serving import CircuitBreaker, EngineUnhealthy
+
+    trips_before = engine.breaker.trips
+    cooldown = engine.config.breaker_cooldown_s
+    set_injector(FaultInjector(serving_dispatch_errors=3))
+    try:
+        # Failures 1+2: consecutive injected dispatch errors -> OPEN.
+        for i in range(2):
+            try:
+                engine.submit(im1, im2).result(60)
+            except RuntimeError as e:
+                assert "injected serving dispatch" in str(e), e
+            else:
+                raise AssertionError("injected dispatch error not "
+                                     "surfaced to the client")
+        assert engine.breaker.state == CircuitBreaker.OPEN, \
+            f"breaker not OPEN after 2 failures: {engine.breaker.state}"
+        assert engine.health()["state"] == "open"
+        # OPEN: submit fails fast without touching the queue.
+        try:
+            engine.submit(im1, im2)
+        except EngineUnhealthy:
+            pass
+        else:
+            raise AssertionError("submit admitted while breaker OPEN")
+        # Half-open probe burns the 3rd injected error -> re-trips.
+        time.sleep(cooldown + 0.05)
+        assert engine.breaker.state == CircuitBreaker.HALF_OPEN
+        try:
+            engine.submit(im1, im2).result(60)
+        except RuntimeError as e:
+            assert "injected serving dispatch" in str(e), e
+        else:
+            raise AssertionError("failed probe did not fail the client")
+        assert engine.breaker.state == CircuitBreaker.OPEN, \
+            "failed half-open probe did not re-open the breaker"
+        # Healthy probe (injector exhausted) closes it.
+        time.sleep(cooldown + 0.05)
+        flow = engine.submit(im1, im2).result(60)
+        assert engine.breaker.state == CircuitBreaker.CLOSED, \
+            "healthy probe did not close the breaker"
+        assert np.array_equal(flow, expected), \
+            "post-recovery response not bit-exact"
+    finally:
+        set_injector(None)
+    assert engine.breaker.trips == trips_before + 2, \
+        f"expected 2 new trips, got {engine.breaker.trips - trips_before}"
+    print(f"  breaker: opened, fast-failed, re-opened on failed probe, "
+          f"closed on healthy probe (trips {engine.breaker.trips})")
+
+
+def drill_breaker_isolation(root):
+    """A poisoned request fails alone (neighbors served bit-exact via
+    isolation singles); injected dispatch errors open -> half-open ->
+    close the circuit breaker."""
+    import numpy as np
+
+    from raft_tpu.resilience import FaultInjector, set_injector
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+
+    predictor = _make_predictor()
+    frames = loadgen.make_frames([(36, 60)], per_shape=3, seed=23)
+    refs, _ = _references(predictor, frames, max_batch=4)
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=4, max_wait_ms=40.0, buckets=((36, 60),),
+        breaker_threshold=2, breaker_cooldown_s=0.3))
+    engine.start()
+    try:
+        # Poison every 3rd submit: requests 1..3 batch together (the
+        # 40ms deadline lets all three queue), the batch dispatch sees
+        # the poison and fails, isolation retries each as a single —
+        # 1 and 2 serve bit-exact, 3 fails alone.
+        set_injector(FaultInjector(serving_poison_nth=3))
+        futs = [engine.submit(*frames[i]) for i in range(3)]
+        set_injector(None)
+        for i in (0, 1):
+            assert np.array_equal(futs[i].result(60), refs[i]), \
+                f"isolated neighbor {i} not bit-exact"
+        try:
+            futs[2].result(60)
+        except RuntimeError as e:
+            assert "poisoned" in str(e), e
+        else:
+            raise AssertionError("poisoned request did not fail")
+        assert engine.metrics.isolated_retries == 2, \
+            f"isolated_retries={engine.metrics.isolated_retries}, want 2"
+        assert engine.metrics.errors >= 1
+        print("  isolation: poisoned request failed alone, 2 neighbors "
+              "served bit-exact on the singles pass")
+
+        # One clean request resets the failure streak (the poisoned
+        # single failed last, leaving it at 1) so the threshold-2
+        # breaker proof below starts from a clean slate.
+        assert np.array_equal(engine.submit(*frames[0]).result(60),
+                              refs[0])
+        _prove_breaker(engine, *frames[0], expected=refs[0])
+        print("  metrics:", engine.metrics.report())
+    finally:
+        set_injector(None)
+        engine.close()
+
+
+def drill_reload_under_load(root):
+    """Hot reload under 50 concurrent clients: good checkpoint swaps
+    (canary pass), bad checkpoint rolls back (canary fail), zero
+    dropped/incorrect responses, zero post-warmup compiles, breaker
+    opens and recovers on the same engine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.checkpoint import RunCheckpointer
+    from raft_tpu.serving import (CompileWatch, HotReloader,
+                                  ReloadConfig, ServingConfig,
+                                  ServingEngine, loadgen)
+
+    predictor = _make_predictor()
+    frames = loadgen.make_frames(SHAPES, per_shape=2, seed=31)
+    refs_old, ref_kind = _references(predictor, frames, max_batch=4)
+
+    # The two checkpoints the background "trainer" will commit: step 1
+    # nudges every param by 0.1% (a plausible consecutive-training
+    # delta — must pass the canary), step 2 is NaN-filled (a diverged
+    # run's export — must fail the finite check and roll back).
+    vars_cur = predictor.variables
+    params_good = jax.tree_util.tree_map(
+        lambda x: x * (1 + 1e-3), vars_cur["params"])
+    params_bad = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), vars_cur["params"])
+    vars_good = dict(vars_cur, params=params_good)
+    refs_new, _ = _references(predictor.clone_with_variables(vars_good),
+                              frames, max_batch=4)
+
+    class _ServeState:
+        """Checkpointable trainer state carrying the predictor's real
+        param tree (what load_params will hand the reloader)."""
+
+        def __init__(self, step, params):
+            self.step = jnp.asarray(step, jnp.int32)
+            self.params = params
+            self.batch_stats = vars_cur.get("batch_stats", {})
+            self.opt_state = {"m": jnp.zeros(4, jnp.float32)}
+
+    ckpt_dir = os.path.join(root, "ckpts")
+    # Warm orbax's one-time internal jit (first save in a process
+    # compiles once) against a scratch dir, so the zero-compile watch
+    # below measures only the serving path. A production trainer is a
+    # separate process; this drill shares one.
+    scratch = RunCheckpointer(os.path.join(root, "scratch"))
+    scratch.save(_ServeState(1, params_good))
+    scratch.close()
+    trainer = RunCheckpointer(ckpt_dir)
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=BUCKETS,
+        breaker_threshold=2, breaker_cooldown_s=0.3))
+    warm = engine.warmup()
+    assert len(warm) == len(BUCKETS)
+    engine.start(warmup=False)
+    reloader = HotReloader(
+        engine, ckpt_dir, canary_frames=[frames[0]],
+        config=ReloadConfig(canary_max_epe=50.0))
+    # Two waves keep the stream saturated across the swap without
+    # racing it: wave 1 (old-or-new acceptance) is in flight while the
+    # good checkpoint lands — on a slow box it may even drain entirely
+    # during the canary — and wave 2 starts only after the swap is
+    # confirmed, so every one of its responses must bit-match the NEW
+    # model exactly.
+    n_wave1, n_wave2, concurrency = 120, 80, 50
+    n_requests = n_wave1 + n_wave2
+    wave1_out, wave2_out = {}, {}
+
+    def load_wave1():
+        wave1_out.update(loadgen.run_load(
+            engine, frames, n_requests=n_wave1,
+            concurrency=concurrency, references=refs_old,
+            alt_references=refs_new, timeout=120.0))
+
+    def load_wave2():
+        wave2_out.update(loadgen.run_load(
+            engine, frames, n_requests=n_wave2,
+            concurrency=concurrency, references=refs_new,
+            timeout=120.0))
+
+    try:
+        with CompileWatch() as watch:
+            loader1 = threading.Thread(target=load_wave1,
+                                       name="drill-load-1")
+            loader1.start()
+            # Phase 1: let the old model serve a chunk of traffic, then
+            # commit the good checkpoint and reload mid-stream.
+            _await_metric(lambda: engine.metrics.responses, 30, 60,
+                          "responses before good checkpoint")
+            trainer.save(_ServeState(1, params_good))
+            act = reloader.poll_once()
+            assert act["action"] == "swapped", \
+                f"good checkpoint did not swap: {act}"
+            assert reloader.current_step == 1
+            # Phase 2: serve wave 2 on the new model, then commit the
+            # bad checkpoint — canary must catch it and roll back while
+            # traffic keeps flowing.
+            served_at_swap = engine.metrics.responses
+            loader2 = threading.Thread(target=load_wave2,
+                                       name="drill-load-2")
+            loader2.start()
+            _await_metric(lambda: engine.metrics.responses,
+                          served_at_swap + 30, 60,
+                          "responses after swap")
+            trainer.save(_ServeState(2, params_bad))
+            act = reloader.poll_once()
+            assert act["action"] == "rolled_back", \
+                f"bad checkpoint was not rolled back: {act}"
+            assert "non-finite" in act["reason"], act["reason"]
+            # Pinned: the same bad step is never retried.
+            assert reloader.poll_once()["action"] == "none"
+            loader1.join(180)
+            loader2.join(180)
+            assert not (loader1.is_alive() or loader2.is_alive()), \
+                "load generator wedged"
+    finally:
+        reloader.stop()
+        trainer.close()
+
+    m = engine.metrics
+    completed = wave1_out["completed"] + wave2_out["completed"]
+    dropped = wave1_out["dropped"] + wave2_out["dropped"]
+    mismatched = wave1_out["mismatched"] + wave2_out["mismatched"]
+    print(f"  {completed}/{n_requests} responses at concurrency "
+          f"{concurrency} across 1 swap + 1 rollback; wave 1: "
+          f"{wave1_out['matched_primary']} old-model + "
+          f"{wave1_out['matched_alt']} new-model matches, wave 2 "
+          f"(post-swap): {wave2_out['matched_primary']} new-model "
+          f"matches; reference = {ref_kind}")
+    print("  metrics:", m.report())
+    assert completed == n_requests, f"completed {completed}/{n_requests}"
+    assert not dropped, f"dropped across reload: {dropped}"
+    assert not mismatched, f"bit-incorrect responses: {mismatched}"
+    # Both models actually served: wave 1's first 30 responses were
+    # awaited on the old model before the checkpoint even existed, and
+    # wave 2 ran entirely post-swap against the new model's references.
+    assert wave1_out["matched_primary"] > 0, "no request served pre-swap"
+    assert wave2_out["matched_primary"] == n_wave2, \
+        "post-swap traffic did not all bit-match the new model"
+    assert m.swaps == 1, f"swaps={m.swaps}, want exactly 1"
+    assert m.rollbacks == 1, f"rollbacks={m.rollbacks}, want exactly 1"
+    assert watch.compiles == 0, \
+        f"{watch.compiles} fresh compile(s) across reload under load"
+    # The engine serves the GOOD step's weights (bit-exact through the
+    # orbax round-trip) and reports degraded (pinned rollback).
+    for got, want in zip(
+            jax.tree_util.tree_leaves(engine.predictor.variables["params"]),
+            jax.tree_util.tree_leaves(params_good)):
+        assert np.array_equal(np.asarray(got), np.asarray(want)), \
+            "serving params are not the good checkpoint's"
+    health = engine.health()
+    assert health["state"] == "degraded" and health["ready"], health
+    assert health["degraded_reasons"] == ["canary-rollback"], health
+
+    # Phase 3: breaker proof on the same still-live engine (expected
+    # output = the NEW model's, since the good swap is serving).
+    _prove_breaker(engine, *frames[0], expected=refs_new[0])
+    engine.close()
+    assert engine.health()["state"] == "closed"
+
+
+DRILLS = [
+    drill_smoke,
+    drill_breaker_isolation,
+    drill_reload_under_load,
+]
+
+
+def _drill_name(fn) -> str:
+    return fn.__name__[len("drill_"):].replace("_", "-")
+
+
+def main(argv=None) -> int:
+    from raft_tpu.resilience import set_injector
+
+    by_name = {_drill_name(fn): fn for fn in DRILLS}
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--drill", default="all",
+                    choices=["all", *by_name],
+                    help="run one drill (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="print available drills and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for fn in DRILLS:
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{_drill_name(fn):24s} {doc}")
+        return 0
+    selected = DRILLS if args.drill == "all" else [by_name[args.drill]]
+
+    failures = 0
+    for drill in selected:
+        name = drill.__name__
+        set_injector(None)
+        with tempfile.TemporaryDirectory(prefix=f"{name}_") as root:
+            print(f"=== {name} ===", flush=True)
+            try:
+                drill(root)
+            except Exception:
+                failures += 1
+                print(f"FAIL {name}", flush=True)
+                traceback.print_exc()
+            else:
+                print(f"PASS {name}", flush=True)
+            finally:
+                set_injector(None)
+    print(f"\n{len(selected) - failures}/{len(selected)} drills passed",
+          flush=True)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

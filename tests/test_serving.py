@@ -25,6 +25,11 @@ def _req(bucket=(40, 64), t=0.0):
     return QueuedRequest(None, None, None, bucket=bucket, t_submit=t)
 
 
+def _req_p(priority, bucket=(40, 64), t=0.0):
+    return QueuedRequest(None, None, None, bucket=bucket, t_submit=t,
+                         priority=priority)
+
+
 class _FakeClock:
     def __init__(self, t=0.0):
         self.t = t
@@ -272,7 +277,7 @@ class TestServingEngine:
 
     def test_backlog_rejection_counted(self, predictor, frames_and_refs):
         frames, _ = frames_and_refs
-        eng = _engine(predictor, max_batch=8, max_wait_ms=5_000.0,
+        eng = _engine(predictor, max_batch=4, max_wait_ms=5_000.0,
                       max_pending=1)
         eng.start()
         try:
@@ -577,3 +582,469 @@ class TestProcessLoader:
             assert loader.state().worker_timeouts == 1
         finally:
             loader.close()
+
+
+# -- robustness layer: priorities, breaker, isolation, health, reload --
+
+
+def _save_params_ckpt(ckpt_dir, step, params, batch_stats=None):
+    """Commit ``params`` under ``step`` the way a trainer would (full
+    RunCheckpointer save → commit record), for the hot-reload tests."""
+    import jax.numpy as jnp
+
+    from raft_tpu.checkpoint import RunCheckpointer
+
+    class _S:
+        def __init__(self):
+            self.step = jnp.asarray(step, jnp.int32)
+            self.params = params
+            self.batch_stats = batch_stats or {}
+            self.opt_state = {"m": jnp.zeros(2, jnp.float32)}
+
+    with RunCheckpointer(ckpt_dir) as c:
+        c.save(_S())
+
+
+class TestPriorities:
+    def test_invalid_priority_rejected(self):
+        with pytest.raises(ValueError, match="priority"):
+            _req_p("urgent")
+
+    def test_high_drains_before_low_within_bucket(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=2, max_wait_s=100.0, clock=clock)
+        b.enqueue(_req_p("low", t=0.0))
+        b.enqueue(_req_p("low", t=0.1))
+        b.enqueue(_req_p("high", t=0.2))
+        clock.t = 200.0
+        batch = b.next_batch(timeout=0)
+        # The younger HIGH preempts the older LOWs in the closing batch;
+        # FIFO within each class.
+        assert [r.priority for r in batch] == ["high", "low"]
+        assert batch[1].t_submit == 0.0
+
+    def test_deadline_anchored_on_oldest_of_either_class(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=1.0, clock=clock)
+        b.enqueue(_req_p("low", t=0.0))
+        b.enqueue(_req_p("high", t=0.9))     # young HIGH must not reset
+        clock.t = 1.1                        # the old LOW's deadline
+        batch = b.next_batch(timeout=0)
+        assert len(batch) == 2               # closed on the LOW's wait
+
+    def test_high_evicts_youngest_low_under_full_backlog(self):
+        b = ShapeBucketBatcher(max_batch=8, max_pending=2)
+        b.enqueue(_req_p("low", t=0.0))
+        victim = _req_p("low", t=5.0)        # youngest LOW
+        b.enqueue(victim)
+        high = _req_p("high", t=6.0)
+        evicted = b.enqueue(high)
+        assert evicted is victim
+        assert b.pending() == 2              # HIGH took the slot
+        with pytest.raises(BacklogFull):     # LOW never evicts
+            b.enqueue(_req_p("low", t=7.0))
+
+    def test_all_high_backlog_still_rejects_high(self):
+        b = ShapeBucketBatcher(max_batch=8, max_pending=1)
+        b.enqueue(_req_p("high"))
+        with pytest.raises(BacklogFull):
+            b.enqueue(_req_p("high"))
+
+    def test_engine_counts_classes_and_evicts(self, predictor,
+                                              frames_and_refs):
+        from raft_tpu.serving import PRIORITY_LOW
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=5_000.0,
+                      max_pending=1)
+        eng.start()
+        try:
+            low_fut = eng.submit(*frames[0], priority=PRIORITY_LOW)
+            high_fut = eng.submit(*frames[1])     # default HIGH, evicts
+            with pytest.raises(BacklogFull):
+                low_fut.result(timeout=5)
+            eng.close(timeout=120)
+            assert np.array_equal(high_fut.result(1), refs[1])
+        finally:
+            eng.close()
+        m = eng.metrics
+        assert m.requests_by_class["low"] == 1
+        assert m.requests_by_class["high"] == 1
+        assert m.sheds_by_class["low"] == 1 and m.sheds == 1
+        snap = m.snapshot()
+        assert snap["serving_requests_low"] == 1.0
+        assert snap["serving_shed_low"] == 1.0
+
+
+class TestCircuitBreaker:
+    def test_transitions_with_fake_clock(self):
+        from raft_tpu.serving import CircuitBreaker
+        clock = _FakeClock()
+        b = CircuitBreaker(threshold=3, cooldown_s=10.0, clock=clock)
+        assert b.state == CircuitBreaker.CLOSED and b.admits()
+        b.record_failure()
+        b.record_failure()
+        b.record_success()                     # streak resets
+        assert b.consecutive_failures == 0
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == CircuitBreaker.OPEN and not b.admits()
+        assert b.trips == 1
+        clock.t = 9.9
+        assert not b.admits()                  # cooldown still running
+        clock.t = 10.0
+        assert b.state == CircuitBreaker.HALF_OPEN and b.admits()
+        b.record_failure()                     # failed probe
+        assert b.state == CircuitBreaker.OPEN and b.trips == 2
+        clock.t = 25.0
+        assert b.state == CircuitBreaker.HALF_OPEN
+        b.record_success()                     # healthy probe
+        assert b.state == CircuitBreaker.CLOSED and b.trips == 2
+
+    def test_validation(self):
+        from raft_tpu.serving import CircuitBreaker
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker(threshold=0)
+        with pytest.raises(ValueError, match="cooldown"):
+            CircuitBreaker(cooldown_s=-1.0)
+
+    def test_engine_opens_fails_fast_and_recovers(self, predictor,
+                                                  frames_and_refs):
+        """Injected dispatch errors trip the breaker; submit fails fast
+        with EngineUnhealthy; after the cooldown a healthy probe closes
+        it and serving resumes bit-exact."""
+        from raft_tpu.resilience import FaultInjector, set_injector
+        from raft_tpu.serving import EngineUnhealthy
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=2.0,
+                      breaker_threshold=1, breaker_cooldown_s=0.2)
+        eng.start()
+        try:
+            set_injector(FaultInjector(serving_dispatch_errors=1))
+            with pytest.raises(RuntimeError,
+                               match="injected serving dispatch"):
+                eng.submit(*frames[0]).result(60)
+            assert eng.health()["state"] == "open"
+            with pytest.raises(EngineUnhealthy, match="breaker open"):
+                eng.submit(*frames[0])
+            assert eng.metrics.breaker_fastfails >= 1
+            time.sleep(0.25)                   # past the cooldown
+            flow = eng.submit(*frames[0]).result(60)
+            assert np.array_equal(flow, refs[0])
+            assert eng.breaker.state == "closed"
+            assert eng.breaker.trips == 1
+            assert eng.health()["state"] == "ready"
+        finally:
+            set_injector(None)
+            eng.close()
+
+
+class TestBatchIsolation:
+    def test_poisoned_request_fails_alone(self, predictor,
+                                          frames_and_refs):
+        """One poisoned input fails its own request only: batch
+        neighbors are retried as singles and serve bit-exact."""
+        from raft_tpu.resilience import FaultInjector, set_injector
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=60.0,
+                      breaker_threshold=10)
+        eng.start()
+        try:
+            set_injector(FaultInjector(serving_poison_nth=2))
+            futs = [eng.submit(*frames[i]) for i in range(3)]
+            set_injector(None)
+            assert np.array_equal(futs[0].result(120), refs[0])
+            assert np.array_equal(futs[2].result(120), refs[2])
+            with pytest.raises(RuntimeError, match="poisoned"):
+                futs[1].result(120)            # submit seq 2 = poisoned
+            assert eng.metrics.isolated_retries == 2
+            assert eng.metrics.errors == 1
+            assert eng.metrics.responses == 2
+            snap = eng.metrics.snapshot()
+            assert snap["serving_isolated_retries"] == 2.0
+        finally:
+            set_injector(None)
+            eng.close()
+
+    def test_lone_failed_request_gets_original_error(self, predictor,
+                                                     frames_and_refs):
+        from raft_tpu.resilience import FaultInjector, set_injector
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=2.0,
+                      breaker_threshold=10)
+        eng.start()
+        try:
+            set_injector(FaultInjector(serving_dispatch_errors=1))
+            with pytest.raises(RuntimeError,
+                               match="injected serving dispatch"):
+                eng.submit(*frames[0]).result(60)
+            assert eng.metrics.isolated_retries == 0
+        finally:
+            set_injector(None)
+            eng.close()
+
+
+class TestHealth:
+    def test_lifecycle_states(self, predictor, frames_and_refs):
+        eng = _engine(predictor, max_batch=2, max_wait_ms=2.0)
+        assert eng.health()["state"] == "starting"
+        assert not eng.health()["ready"]
+        eng.start()
+        try:
+            assert eng.health()["state"] == "ready"
+            eng.set_degraded("canary-rollback")
+            h = eng.health()
+            assert h["state"] == "degraded" and h["ready"]
+            assert h["degraded_reasons"] == ["canary-rollback"]
+            eng.clear_degraded("canary-rollback")
+            assert eng.health()["state"] == "ready"
+        finally:
+            eng.close()
+        assert eng.health()["state"] == "closed"
+
+    def test_gauges_stream_through_snapshot(self, predictor):
+        from raft_tpu.serving.health import HEALTH_CODES
+        eng = _engine(predictor, max_batch=2, max_wait_ms=2.0)
+        snap = eng.metrics.snapshot()
+        assert snap["serving_queue_depth"] == 0.0
+        assert snap["serving_inflight_batches"] == 0.0
+        assert snap["serving_breaker_trips"] == 0.0
+        assert snap["serving_health_state"] == float(
+            HEALTH_CODES["starting"])
+        eng.start()
+        try:
+            assert eng.metrics.snapshot()["serving_health_state"] == \
+                float(HEALTH_CODES["ready"])
+        finally:
+            eng.close()
+
+    def test_gauge_source_failure_is_safe(self):
+        m = ServingMetrics()
+        m.set_gauge_source("broken", lambda: 1 / 0)
+        assert m.snapshot()["serving_broken"] == 0.0
+
+
+class TestHotReload:
+    def _reload_setup(self, predictor, frames, tmp_path, **cfg_kw):
+        import jax
+
+        from raft_tpu.serving import HotReloader, ReloadConfig
+        eng = _engine(predictor, max_batch=4, max_wait_ms=3.0,
+                      buckets=(SHAPES[0],))
+        eng.warmup()
+        eng.start(warmup=False)
+        reloader = HotReloader(
+            eng, str(tmp_path / "ckpts"), canary_frames=[frames[0]],
+            config=ReloadConfig(**{"canary_max_epe": None, **cfg_kw}))
+        good = jax.tree_util.tree_map(lambda x: x * (1 + 1e-3),
+                                      predictor.variables["params"])
+        return eng, reloader, good
+
+    def test_good_canary_swaps_with_zero_compiles(self, predictor,
+                                                  frames_and_refs,
+                                                  tmp_path):
+        from raft_tpu.serving import CompileWatch
+        frames, _ = frames_and_refs
+        eng, reloader, good = self._reload_setup(predictor, frames,
+                                                 tmp_path)
+        try:
+            assert reloader.poll_once()["action"] == "none"  # empty dir
+            _save_params_ckpt(str(tmp_path / "ckpts"), 3, good)
+            with CompileWatch() as w:
+                act = reloader.poll_once()
+            assert act["action"] == "swapped" and act["step"] == 3
+            assert w.compiles == 0       # standby reused warmed execs
+            assert reloader.current_step == 3
+            assert eng.metrics.swaps == 1
+            assert eng.health()["state"] == "ready"
+            # The engine now serves the checkpoint's weights bit-exact.
+            import jax
+            for got, want in zip(
+                    jax.tree_util.tree_leaves(
+                        eng.predictor.variables["params"]),
+                    jax.tree_util.tree_leaves(good)):
+                np.testing.assert_array_equal(np.asarray(got),
+                                              np.asarray(want))
+            # Same step never reloads twice.
+            assert reloader.poll_once()["action"] == "none"
+        finally:
+            reloader.stop()
+            eng.close()
+
+    def test_nan_canary_rolls_back_and_pins(self, predictor,
+                                            frames_and_refs, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        frames, refs = frames_and_refs
+        eng, reloader, _ = self._reload_setup(predictor, frames,
+                                              tmp_path)
+        bad = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan),
+            predictor.variables["params"])
+        try:
+            _save_params_ckpt(str(tmp_path / "ckpts"), 5, bad)
+            act = reloader.poll_once()
+            assert act["action"] == "rolled_back" and act["step"] == 5
+            assert "non-finite" in act["reason"]
+            assert eng.metrics.rollbacks == 1
+            h = eng.health()
+            assert h["state"] == "degraded" and h["ready"]
+            assert 5 in reloader.pinned_steps
+            assert reloader.poll_once()["action"] == "none"  # pinned
+            # Old model still serves, bit-exact.
+            flow = eng.submit(*frames[0]).result(120)
+            assert np.array_equal(flow, refs[0])
+        finally:
+            reloader.stop()
+            eng.close()
+
+    def test_epe_band_rolls_back(self, predictor, frames_and_refs,
+                                 tmp_path):
+        import jax
+        frames, _ = frames_and_refs
+        eng, reloader, good = self._reload_setup(
+            predictor, frames, tmp_path, canary_max_epe=1e-9)
+        shifted = jax.tree_util.tree_map(lambda x: x * 1.05,
+                                         predictor.variables["params"])
+        try:
+            _save_params_ckpt(str(tmp_path / "ckpts"), 7, shifted)
+            act = reloader.poll_once()
+            assert act["action"] == "rolled_back"
+            assert "drift band" in act["reason"]
+            assert act["epe"] > 0
+        finally:
+            reloader.stop()
+            eng.close()
+
+    def test_newer_step_still_eligible_after_pin(self, predictor,
+                                                 frames_and_refs,
+                                                 tmp_path):
+        """One bad export must not wedge the replica: after pinning a
+        canary-failed step, the NEXT committed step swaps (and clears
+        the degraded flag)."""
+        import jax
+        import jax.numpy as jnp
+        frames, _ = frames_and_refs
+        eng, reloader, good = self._reload_setup(predictor, frames,
+                                                 tmp_path)
+        bad = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan),
+            predictor.variables["params"])
+        try:
+            _save_params_ckpt(str(tmp_path / "ckpts"), 1, bad)
+            assert reloader.poll_once()["action"] == "rolled_back"
+            assert eng.health()["state"] == "degraded"
+            _save_params_ckpt(str(tmp_path / "ckpts"), 2, good)
+            assert reloader.poll_once()["action"] == "swapped"
+            assert eng.health()["state"] == "ready"   # rollback cleared
+            assert eng.metrics.swaps == 1 and eng.metrics.rollbacks == 1
+        finally:
+            reloader.stop()
+            eng.close()
+
+    def test_swap_under_load_bit_consistent(self, predictor,
+                                            frames_and_refs, tmp_path):
+        """The drill's core invariant at pytest scale: every response
+        during a mid-stream swap bit-matches exactly the old or the new
+        model, and both models actually serve."""
+        from raft_tpu.serving import loadgen
+        frames, refs_old = frames_and_refs
+        eng, reloader, good = self._reload_setup(predictor, frames,
+                                                 tmp_path)
+        refs_new = loadgen.batched_reference_flows(
+            predictor.clone_with_variables(
+                dict(predictor.variables, params=good)),
+            frames, max_batch=4)
+        out = {}
+
+        def load():
+            out.update(loadgen.run_load(
+                eng, frames, n_requests=60, concurrency=8,
+                references=refs_old, alt_references=refs_new,
+                timeout=120.0))
+
+        th = threading.Thread(target=load)
+        try:
+            th.start()
+            deadline = time.monotonic() + 60
+            while eng.metrics.responses < 10:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            _save_params_ckpt(str(tmp_path / "ckpts"), 9, good)
+            assert reloader.poll_once()["action"] == "swapped"
+            th.join(120)
+            assert not th.is_alive()
+            # Post-swap traffic must bit-match the NEW model — issued
+            # after the join so it cannot race the swap (on a slow box
+            # the whole mixed load can drain before the canary ends,
+            # which is why "matched_alt > 0" would be flaky here).
+            post = loadgen.run_load(eng, frames, n_requests=8,
+                                    concurrency=4, references=refs_new,
+                                    timeout=120.0)
+        finally:
+            reloader.stop()
+            eng.close()
+        assert out["completed"] == 60
+        assert out["dropped"] == [] and out["mismatched"] == []
+        assert out["matched_primary"] > 0     # old model served
+        assert post["completed"] == 8         # new model serves, exactly
+        assert post["dropped"] == [] and post["mismatched"] == []
+        assert eng.metrics.swaps == 1
+
+    def test_watcher_thread_polls_and_swaps(self, predictor,
+                                            frames_and_refs, tmp_path):
+        frames, _ = frames_and_refs
+        eng, reloader, good = self._reload_setup(
+            predictor, frames, tmp_path, poll_interval_s=0.05)
+        try:
+            reloader.start()
+            with pytest.raises(RuntimeError, match="already started"):
+                reloader.start()
+            _save_params_ckpt(str(tmp_path / "ckpts"), 11, good)
+            deadline = time.monotonic() + 30
+            while eng.metrics.swaps < 1:
+                assert time.monotonic() < deadline, \
+                    "watcher never picked up the committed step"
+                time.sleep(0.02)
+            assert reloader.current_step == 11
+        finally:
+            reloader.stop()
+            eng.close()
+
+    def test_clone_rejects_structure_change(self, predictor):
+        with pytest.raises(ValueError, match="variable"):
+            predictor.clone_with_variables(
+                {"params": predictor.variables["params"],
+                 "unexpected": {}})
+
+
+class TestLoadgenAltReferences:
+    def test_alt_match_counts_as_correct(self):
+        """A response bit-matching the alternate reference is correct,
+        one matching neither is a mismatch."""
+        from concurrent.futures import Future
+
+        from raft_tpu.serving import loadgen
+
+        primary = [np.zeros((4, 4, 2), np.float32)]
+        alt = [np.ones((4, 4, 2), np.float32)]
+        frames = [(np.zeros((4, 4, 3), np.float32),) * 2]
+
+        class _FakeEngine:
+            def __init__(self, value):
+                self.value = value
+                self.metrics = ServingMetrics()
+
+            def submit(self, im1, im2, priority="high"):
+                f = Future()
+                f.set_result(self.value)
+                return f
+
+        res = loadgen.run_load(_FakeEngine(alt[0]), frames, 4,
+                               concurrency=2, references=primary,
+                               alt_references=alt)
+        assert res["ok"] and res["matched_alt"] == 4
+        assert res["matched_primary"] == 0
+        res = loadgen.run_load(
+            _FakeEngine(np.full((4, 4, 2), 7.0, np.float32)), frames, 4,
+            concurrency=2, references=primary, alt_references=alt)
+        assert not res["ok"] and len(res["mismatched"]) == 4
