@@ -54,6 +54,7 @@ driver artifact to backend-init hangs):
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -545,8 +546,9 @@ def _sparse_metrics() -> dict:
 SERVING_METRIC = "serving_vs_sequential_batch1_speedup"
 
 
-def serving_main():
-    """``python bench.py serving`` — dynamic-batching serving benchmark.
+def serving_main(replicas: int = 1):
+    """``python bench.py serving [--replicas N]`` — dynamic-batching
+    serving benchmark.
 
     Drives the serving engine (raft_tpu/serving/) with concurrent
     closed-loop clients and publishes its sustained throughput against
@@ -561,11 +563,21 @@ def serving_main():
     STILL verifies every response bit-for-bit. CPU hosts with one core
     (this container) have no dispatch gap to recover — the artifact says
     so explicitly in ``criterion_note`` instead of faking a speedup.
+
+    ``--replicas N`` (default 1) serves through an N-replica
+    :class:`~raft_tpu.serving.fleet.ServingFleet` instead of one
+    engine. The artifact records ``replicas``, a ``topology`` label
+    (``single-replica`` keeps the existing single-engine trajectory
+    comparable across rounds) and per-replica warmup time/compiles —
+    on one host extra replicas add routing, not compute, so the
+    interesting numbers are the warmup-sharing and failover machinery,
+    not the throughput.
     """
     import jax
 
     from raft_tpu.evaluate import load_predictor
-    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+    from raft_tpu.serving import (ServingConfig, ServingEngine, loadgen,
+                                  make_fleet)
 
     platform = jax.devices()[0].platform
     ncores = os.cpu_count() or 1
@@ -588,15 +600,56 @@ def serving_main():
     seq = loadgen.sequential_baseline(predictor, frames,
                                       n_requests=max(n_requests // 4, 8))
 
-    engine = ServingEngine(predictor, ServingConfig(
+    cfg = ServingConfig(
         max_batch=max_batch, max_wait_ms=max_wait_ms,
-        buckets=tuple(shapes), persistent_cache=True))
-    engine.start()                        # warms every bucket
+        buckets=tuple(shapes), persistent_cache=True)
+    if replicas <= 1:
+        engine = ServingEngine(predictor, cfg)
+        t0 = time.perf_counter()
+        warm = engine.warmup()
+        warmup_per_replica = {"r0": {
+            "seconds": round(time.perf_counter() - t0, 3),
+            "compiles": int(sum(v["compiles"] for v in warm.values()))}}
+        engine.start(warmup=False)
+        server, metrics_owner = engine, engine.metrics
+        host_stage_ms = engine.stages.summary()
+        mean_batch = engine.metrics.mean_batch_size
+        padded_slots = lambda: engine.metrics.padded_slots  # noqa: E731
+        queue_peak = lambda: engine.metrics.queue_depth_peak  # noqa: E731
+        compiles = lambda: engine.metrics.compiles  # noqa: E731
+        close = engine.close
+    else:
+        fleet = make_fleet(predictor, replicas, cfg)
+        fleet.start(warm_spares=True)
+        warmup_per_replica = {
+            rid: {k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in stats.items()}
+            for rid, stats in fleet.warmup_stats.items()}
+        engines = fleet.engines.values()
+        server, metrics_owner = fleet, fleet.metrics
+
+        def mean_batch():
+            hist = fleet.metrics.batch_histogram()
+            n = sum(hist.values())
+            return (sum(k * v for k, v in hist.items()) / n) if n else 0.0
+
+        host_stage_ms = None   # filled post-run, per replica
+        padded_slots = lambda: sum(  # noqa: E731
+            e.metrics.padded_slots for e in engines)
+        queue_peak = lambda: max(  # noqa: E731
+            e.metrics.queue_depth_peak for e in engines)
+        compiles = lambda: sum(  # noqa: E731
+            e.metrics.compiles for e in engines)
+        close = fleet.close
+
     try:
-        res = loadgen.run_load(engine, frames, n_requests=n_requests,
+        res = loadgen.run_load(server, frames, n_requests=n_requests,
                                concurrency=concurrency, references=refs)
     finally:
-        engine.close()
+        close()
+    if host_stage_ms is None:
+        host_stage_ms = {rid: e.stages.summary()
+                         for rid, e in fleet.engines.items()}
 
     speedup = (res["throughput_rps"] / seq["throughput_rps"]
                if seq["throughput_rps"] else None)
@@ -613,6 +666,10 @@ def serving_main():
         "concurrency": concurrency,
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
+        "replicas": replicas,
+        "topology": ("single-replica" if replicas <= 1
+                     else f"fleet-{replicas}"),
+        "warmup_per_replica": warmup_per_replica,
         "serving_pairs_per_sec": round(res["throughput_rps"], 3),
         "sequential_batch1_pairs_per_sec": round(
             seq["throughput_rps"], 3),
@@ -621,15 +678,26 @@ def serving_main():
         "latency_p99_ms": round(res["latency_ms"]["p99"], 2),
         "batch_histogram": {str(k): v for k, v in
                             sorted(res["batch_histogram"].items())},
-        "mean_batch_size": round(engine.metrics.mean_batch_size(), 2),
-        "padded_slots": engine.metrics.padded_slots,
-        "queue_depth_peak": engine.metrics.queue_depth_peak,
-        "post_warmup_compiles": engine.metrics.compiles,
+        "mean_batch_size": round(mean_batch(), 2),
+        "padded_slots": padded_slots(),
+        "queue_depth_peak": queue_peak(),
+        "post_warmup_compiles": compiles(),
         "responses_bit_exact": res["ok"],
         "dropped": len(res["dropped"]),
         "mismatched": len(res["mismatched"]),
-        "host_stage_ms": engine.stages.summary(),
+        "host_stage_ms": host_stage_ms,
     }
+    if replicas > 1:
+        snap = metrics_owner.snapshot()
+        payload["fleet"] = {
+            "routed": int(snap["fleet_routed"]),
+            "failovers": int(snap["fleet_failovers"]),
+            "retries": int(snap["fleet_retries"]),
+            "shed": int(snap["fleet_shed"]),
+            "per_replica_routed": {
+                rid: int(snap[f"fleet_{rid}_routed"])
+                for rid in fleet.replica_ids},
+        }
     if platform != "tpu":
         # Honesty clause (bench.py discipline: context travels with the
         # artifact, values are never faked): the batch-1 gap is a device
@@ -661,7 +729,12 @@ def _serving_failure(msg: str) -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         try:
-            serving_main()
+            ap = argparse.ArgumentParser(prog="bench.py serving")
+            ap.add_argument("--replicas", type=int, default=1,
+                            help="serve through an N-replica fleet "
+                                 "(default: 1, the single-engine "
+                                 "trajectory point)")
+            serving_main(replicas=ap.parse_args(sys.argv[2:]).replicas)
         except SystemExit:
             raise
         except BaseException as e:  # noqa: BLE001 — artifact must parse

@@ -25,8 +25,15 @@ forward itself:
   histogram, queue depth, throughput, XLA compile-count probe, plus
   robustness gauges (health state, swaps/rollbacks/breaker trips).
 * :mod:`~raft_tpu.serving.loadgen` — CPU-runnable concurrent load
-  generator with bit-exact response checking (drives ``bench.py
-  serving`` and ``scripts/serve_drill.py``).
+  generator with bit-exact response checking and per-replica
+  attribution (drives ``bench.py serving`` and
+  ``scripts/serve_drill.py``).
+* :mod:`~raft_tpu.serving.fleet` — N engines behind one
+  ``submit()/health()`` surface: rendezvous-hashed bucket routing (each
+  replica warms only its buckets), health-gated balancing with
+  response-level failover, fleet-wide rolling hot reload
+  (canary-one-then-wave, whole-fleet rollback on drift), and
+  fleet-aggregated metrics.
 """
 
 from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
@@ -36,32 +43,44 @@ from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
 from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
                                      enable_persistent_compile_cache,
                                      make_engine)
+from raft_tpu.serving.fleet import (BucketRouter, FleetMetrics,
+                                    FleetReloadConfig, FleetReloader,
+                                    ServingFleet, make_fleet)
 from raft_tpu.serving.health import (CircuitBreaker, EngineUnhealthy,
-                                     HEALTH_CODES)
+                                     HEALTH_CODES, ROUTABLE, is_routable)
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
 from raft_tpu.serving.reload import (CanaryResult, HotReloader,
-                                     ReloadConfig)
+                                     ReloadConfig, load_step_variables)
 
 __all__ = [
     "BacklogFull",
+    "BucketRouter",
     "CanaryResult",
     "CircuitBreaker",
     "CompileWatch",
     "EngineUnhealthy",
+    "FleetMetrics",
+    "FleetReloadConfig",
+    "FleetReloader",
     "HEALTH_CODES",
     "HotReloader",
     "PRIORITIES",
     "PRIORITY_HIGH",
     "PRIORITY_LOW",
     "QueuedRequest",
+    "ROUTABLE",
     "ReloadConfig",
     "RequestTimedOut",
     "ServingConfig",
     "ServingEngine",
+    "ServingFleet",
     "ServingMetrics",
     "ShapeBucketBatcher",
     "enable_persistent_compile_cache",
+    "is_routable",
+    "load_step_variables",
     "make_engine",
+    "make_fleet",
     "xla_compile_count",
 ]

@@ -11,13 +11,18 @@ mechanisms behind one ``submit() -> Future`` API:
   repeating the last request (the batched-eval trick: one executable
   per bucket, never per partial size), and two priority classes per
   bucket let interactive traffic batch ahead of opt-in background work.
-* **Pipelined dispatch** — a dispatcher thread stacks and *dispatches*
-  batch N+1 while the device still computes batch N (`jax.Array`
-  dispatch is non-blocking; only the completion thread syncs, via
-  ``np.asarray``). A bounded in-flight queue (``pipeline_depth``)
-  provides backpressure so a slow device can't queue unbounded work.
-  With ``donate`` (default on TPU) the input image buffers are donated
-  to the executable, so steady-state serving holds one batch of inputs,
+* **Pipelined multi-bucket dispatch** — a router thread hands each
+  closed batch to its bucket's :class:`_BucketStream`, whose dispatch
+  thread stacks and *dispatches* batch N+1 while the device still
+  computes batch N (`jax.Array` dispatch is non-blocking; only the
+  stream's completion thread syncs, via ``np.asarray``). Streams are
+  independent per bucket, so a big-bucket batch in flight never
+  head-of-line-blocks small-bucket traffic — both buckets' batches are
+  dispatched and synced concurrently. Each stream's bounded in-flight
+  queue (``pipeline_depth``) provides per-bucket backpressure so a slow
+  device can't queue unbounded work. With ``donate`` (default on TPU)
+  the input image buffers are donated to the executable, so
+  steady-state serving holds one batch of inputs per active bucket,
   not one per pipeline slot.
 * **Warmup + persistent compile cache** — ``warmup()`` pre-compiles the
   executable for every configured bucket (counted by the
@@ -134,8 +139,9 @@ class ServingConfig:
         arbitrarily stale result. Counted in ``metrics.timeouts``.
         ``None``/``0`` disables (requests wait forever).
       pipeline_depth: dispatched-but-unsynced batches allowed in flight
-        (2 = classic double buffering: host stacks N+1 while device
-        runs N).
+        *per bucket stream* (2 = classic double buffering: host stacks
+        N+1 while device runs N). Buckets pipeline independently — see
+        :class:`_BucketStream`.
       donate: donate input image buffers to the executable. ``None``
         resolves to True on TPU, False elsewhere (CPU/older backends
         warn and ignore donation).
@@ -146,6 +152,11 @@ class ServingConfig:
         :class:`~raft_tpu.serving.health.EngineUnhealthy`).
       breaker_cooldown_s: seconds OPEN before the breaker half-opens
         and lets one probe batch test the device again.
+      replica_id: name of this engine within a serving fleet
+        (:mod:`raft_tpu.serving.fleet`). When set, every response
+        future is stamped with ``future.replica_id`` so load
+        generators and fleet drills can attribute each response (and
+        each failure) to the engine that produced it.
     """
 
     max_batch: int = 8
@@ -160,6 +171,103 @@ class ServingConfig:
     persistent_cache: object = None
     breaker_threshold: int = 5
     breaker_cooldown_s: float = 30.0
+    replica_id: Optional[str] = None
+
+
+class _BucketStream:
+    """One bucket's independent dispatch/completion pipeline.
+
+    The engine's router thread hands closed batches to the stream's
+    ``work`` queue; the stream's dispatch thread stacks + dispatches
+    them (non-blocking) into its own bounded ``inflight`` queue, and
+    its completion thread syncs. Because every bucket owns its own
+    pair of threads and its own ``pipeline_depth`` backpressure bound,
+    a large-bucket batch that takes long on the device never
+    head-of-line-blocks another bucket's traffic — multi-bucket
+    concurrent dispatch, the single-stream-limit lift the ROADMAP
+    carried. Bit-exactness is unaffected: each request still runs
+    through its bucket's one executable (pinned by
+    tests/test_serving.py::TestConcurrentDispatch).
+
+    Streams are created lazily by the router (one per padded shape
+    that actually sees traffic) and torn down by a ``None`` sentinel
+    on ``work`` when the engine closes.
+    """
+
+    def __init__(self, engine: "ServingEngine",
+                 bucket: Tuple[int, int]):
+        self.engine = engine
+        self.bucket = bucket
+        self.work: queue.Queue = queue.Queue()
+        self.inflight: queue.Queue = queue.Queue(
+            maxsize=max(engine.config.pipeline_depth, 1))
+        name = f"serving-{bucket[0]}x{bucket[1]}"
+        self.dispatcher = threading.Thread(
+            target=self._dispatch_loop, name=f"{name}-dispatch",
+            daemon=True)
+        self.completer = threading.Thread(
+            target=self._completion_loop, name=f"{name}-complete",
+            daemon=True)
+        self.dispatcher.start()
+        self.completer.start()
+
+    def put(self, batch) -> None:
+        self.work.put(batch)
+
+    def close(self) -> None:
+        """Ask the stream to drain its queued work and exit."""
+        self.work.put(None)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.dispatcher.join(timeout)
+        self.completer.join(timeout)
+
+    def _dispatch_loop(self) -> None:
+        eng = self.engine
+        try:
+            while True:
+                batch = self.work.get()
+                if batch is None:
+                    break
+                eng._dispatch_one(batch, self.inflight)
+        except BaseException as e:   # fatal: fail fast, not silently
+            eng._set_fatal(e)
+            while True:
+                try:
+                    left = self.work.get_nowait()
+                except queue.Empty:
+                    break
+                if left:
+                    for r in left:
+                        r.future.set_exception(e)
+                    eng.metrics.record_error(len(left))
+        finally:
+            self.inflight.put(None)
+
+    def _completion_loop(self) -> None:
+        eng = self.engine
+        while True:
+            item = self.inflight.get()
+            if item is None:
+                break
+            batch, out = item
+            try:
+                with eng.stages.stage("sync"):
+                    flow_up = np.asarray(out[1])   # blocks until done
+            except Exception as e:
+                with eng._state_lock:
+                    eng._inflight_batches -= 1
+                eng.breaker.record_failure()
+                eng._isolate_failed_batch(batch, e)
+                continue
+            with eng._state_lock:
+                eng._inflight_batches -= 1
+            eng.breaker.record_success()
+            now = time.monotonic()
+            with eng.stages.stage("unpad"):
+                for j, r in enumerate(batch):
+                    r.future.set_result(r.padder.unpad(flow_up[j]))
+                    eng.metrics.record_done(now - r.t_submit)
 
 
 class ServingEngine:
@@ -206,11 +314,13 @@ class ServingEngine:
             max_batch=self.config.max_batch,
             max_wait_s=self.config.max_wait_ms / 1e3,
             max_pending=self.config.max_pending)
-        self._inflight: queue.Queue = queue.Queue(
-            maxsize=max(self.config.pipeline_depth, 1))
         self._inflight_batches = 0
-        self._dispatcher: Optional[threading.Thread] = None
-        self._completer: Optional[threading.Thread] = None
+        # bucket -> _BucketStream, created lazily by the router thread
+        # (the only writer); _streams_lock guards reads from other
+        # threads (health, close).
+        self._streams: Dict[Tuple[int, int], _BucketStream] = {}
+        self._streams_lock = threading.Lock()
+        self._router: Optional[threading.Thread] = None
         self._started = False
         self._warming = False
         self._closed = False
@@ -240,28 +350,27 @@ class ServingEngine:
             raise RuntimeError("engine already started")
         if warmup and self.config.buckets:
             self.warmup()
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name="serving-dispatch",
-            daemon=True)
-        self._completer = threading.Thread(
-            target=self._completion_loop, name="serving-complete",
-            daemon=True)
+        self._router = threading.Thread(
+            target=self._route_loop, name="serving-route", daemon=True)
         self._started = True
-        self._dispatcher.start()
-        self._completer.start()
+        self._router.start()
         return self
 
-    def warmup(self) -> Dict[Tuple[int, int], Dict[str, float]]:
+    def warmup(self, buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+               ) -> Dict[Tuple[int, int], Dict[str, float]]:
         """Pre-compile the (max_batch, padded H, padded W) executable for
         every configured bucket through the exact serve-path code
         (``dispatch_batch`` → ``FlowPredictor._fn`` cache). After this,
         no request whose padded shape lands in a configured bucket
         triggers a fresh XLA compile. Returns per-bucket
-        ``{"compiles": n, "seconds": s}`` stats."""
+        ``{"compiles": n, "seconds": s}`` stats. ``buckets`` overrides
+        the configured set (the fleet warms spare buckets through it —
+        cache hits when the executable cache is shared)."""
         stats: Dict[Tuple[int, int], Dict[str, float]] = {}
         self._warming = True
         try:
-            for raw_hw in self.config.buckets:
+            for raw_hw in (self.config.buckets
+                           if buckets is None else buckets):
                 padder = InputPadder((*raw_hw, 3),
                                      mode=self.config.pad_mode,
                                      factor=self.config.factor)
@@ -290,8 +399,15 @@ class ServingEngine:
         self._closed = True
         self.batcher.close()
         if self._started:
-            self._dispatcher.join(timeout)
-            self._completer.join(timeout)
+            # The router drains the batcher into the streams and then
+            # sends each stream its shutdown sentinel (in its finally
+            # block), so joining router-then-streams resolves every
+            # queued and in-flight request before close() returns.
+            self._router.join(timeout)
+            with self._streams_lock:
+                streams = list(self._streams.values())
+            for s in streams:
+                s.join(timeout)
 
     def __enter__(self) -> "ServingEngine":
         if not self._started:
@@ -359,19 +475,30 @@ class ServingEngine:
     def swap_predictor(self, new_predictor) -> None:
         """Atomically swap the serving model between batches.
 
-        The dispatch path reads ``self.predictor`` under the swap lock,
-        so the swap waits for an in-progress dispatch call and the next
-        batch runs the new model; batches already in flight captured
-        the old weights at dispatch and complete normally — no request
-        is dropped or torn across models. This is the commit point of
+        Every bucket stream reads the ``self.predictor`` *reference*
+        under the swap lock before dispatching, so each batch runs
+        entirely on one model — batches dispatched before the swap
+        captured the old weights and complete normally; the next batch
+        per stream runs the new model. No request is dropped or torn
+        across models. This is the commit point of
         :class:`~raft_tpu.serving.reload.HotReloader`; counted in
         ``metrics.swaps`` and clears any ``canary-rollback``
         degradation from a previously pinned bad checkpoint."""
-        new_predictor.donate_images = self._donate
-        with self._swap_lock:
-            self.predictor = new_predictor
+        self._install_predictor(new_predictor)
         self.metrics.record_swap()
         self.clear_degraded("canary-rollback")
+
+    def _install_predictor(self, new_predictor) -> None:
+        """Install a predictor without counting a swap or touching the
+        degradation flags — the fleet's rollback-restore and chaos-kill
+        paths, where a ``swaps`` tick would corrupt the 'exactly one
+        canary swap' accounting the drills assert on."""
+        try:
+            new_predictor.donate_images = self._donate
+        except AttributeError:
+            pass                    # chaos stubs need not carry the flag
+        with self._swap_lock:
+            self.predictor = new_predictor
 
     def record_rollback(self, reason: str) -> None:
         """A canary-failed reload was rolled back: count it and mark
@@ -428,6 +555,11 @@ class ServingEngine:
                             priority=priority,
                             poisoned=active_injector()
                             .poisons_request(seq))
+        if self.config.replica_id is not None:
+            # Response attribution inside a fleet: loadgen and the
+            # fleet drills read this off the future to name the engine
+            # that produced (or failed) each response.
+            req.future.replica_id = self.config.replica_id
         try:
             evicted = self.batcher.enqueue(req)
         except BacklogFull:
@@ -458,7 +590,27 @@ class ServingEngine:
 
     # -- worker threads -------------------------------------------------
 
-    def _dispatch_loop(self) -> None:
+    def _set_fatal(self, e: BaseException) -> None:
+        """An unexpected (non-Exception) error escaped a worker thread:
+        record it so submit fails fast, and stop accepting requests."""
+        self._fatal = e
+        self.batcher.close()
+
+    def _stream_for(self, bucket: Tuple[int, int]) -> _BucketStream:
+        # Router-thread only: creation is single-threaded, the lock
+        # orders the dict write against concurrent readers.
+        stream = self._streams.get(bucket)
+        if stream is None:
+            stream = _BucketStream(self, bucket)
+            with self._streams_lock:
+                self._streams[bucket] = stream
+        return stream
+
+    def _route_loop(self) -> None:
+        """Pull closed batches off the batcher and hand each to its
+        bucket's stream. Routing never touches the device, so one
+        bucket's backpressure (a full ``inflight`` queue) stalls only
+        that bucket's dispatch thread, never this loop."""
         try:
             while True:
                 batch = self.batcher.next_batch(timeout=0.1)
@@ -466,10 +618,9 @@ class ServingEngine:
                     break
                 if not batch:
                     continue
-                self._dispatch_one(batch)
+                self._stream_for(batch[0].bucket).put(batch)
         except BaseException as e:  # fatal: fail fast, not silently
-            self._fatal = e
-            self.batcher.close()
+            self._set_fatal(e)
             while True:
                 left = self.batcher.next_batch(timeout=0)
                 if not left:
@@ -478,7 +629,10 @@ class ServingEngine:
                     r.future.set_exception(e)
                 self.metrics.record_error(len(left))
         finally:
-            self._inflight.put(None)
+            with self._streams_lock:
+                streams = list(self._streams.values())
+            for stream in streams:
+                stream.close()
 
     def _stack(self, batch: List[QueuedRequest]):
         n = len(batch)
@@ -497,17 +651,22 @@ class ServingEngine:
     def _dispatch_arrays(self, batch: List[QueuedRequest], i1, i2):
         """The guarded device entry: fault-injection hooks (a poisoned
         request in the batch, or an injected transient dispatch error)
-        fire before the device is touched; the predictor is read under
-        the swap lock so hot reloads land between batches."""
+        fire before the device is touched. The predictor *reference* is
+        read under the swap lock (so a hot reload lands between
+        batches, never tearing one), but the dispatch itself runs
+        outside it — bucket streams must be able to dispatch
+        concurrently without serializing on the lock."""
         inj = active_injector()
         if any(r.poisoned for r in batch):
             raise RuntimeError(
                 "injected poisoned input in dispatched batch")
         inj.maybe_fail_serving_dispatch()
         with self._swap_lock:
-            return self.predictor.dispatch_batch(i1, i2)
+            predictor = self.predictor
+        return predictor.dispatch_batch(i1, i2)
 
-    def _dispatch_one(self, batch: List[QueuedRequest]) -> None:
+    def _dispatch_one(self, batch: List[QueuedRequest],
+                      inflight: queue.Queue) -> None:
         # Expire requests whose time-in-queue budget ran out while they
         # waited for a batch slot: complete them with a clear error and
         # don't spend device compute on them.
@@ -549,11 +708,12 @@ class ServingEngine:
             return
         self.metrics.record_batch(n, self.config.max_batch,
                                   compiles=xla_compile_count() - c0)
-        # Bounded queue: blocks when pipeline_depth batches are already
-        # in flight — backpressure instead of unbounded device queueing.
+        # Bounded per-bucket queue: blocks when pipeline_depth batches
+        # of THIS bucket are already in flight — backpressure instead
+        # of unbounded device queueing, without stalling other buckets.
         with self._state_lock:
             self._inflight_batches += 1
-        self._inflight.put((batch, out))
+        inflight.put((batch, out))
 
     def _isolate_failed_batch(self, batch: List[QueuedRequest],
                               cause: BaseException) -> None:
@@ -584,30 +744,6 @@ class ServingEngine:
             self.metrics.record_done(time.monotonic() - r.t_submit)
             self.metrics.record_isolated_retry()
             self.breaker.record_success()
-
-    def _completion_loop(self) -> None:
-        while True:
-            item = self._inflight.get()
-            if item is None:
-                break
-            batch, out = item
-            try:
-                with self.stages.stage("sync"):
-                    flow_up = np.asarray(out[1])   # blocks until done
-            except Exception as e:
-                with self._state_lock:
-                    self._inflight_batches -= 1
-                self.breaker.record_failure()
-                self._isolate_failed_batch(batch, e)
-                continue
-            with self._state_lock:
-                self._inflight_batches -= 1
-            self.breaker.record_success()
-            now = time.monotonic()
-            with self.stages.stage("unpad"):
-                for j, r in enumerate(batch):
-                    r.future.set_result(r.padder.unpad(flow_up[j]))
-                    self.metrics.record_done(now - r.t_submit)
 
 
 def make_engine(model_path: str, serving: Optional[ServingConfig] = None,

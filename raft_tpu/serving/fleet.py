@@ -1,0 +1,840 @@
+"""Serving fleet: a bucket-aware router over N engines, health-gated
+balancing, and fleet-wide rolling hot reload.
+
+One :class:`~raft_tpu.serving.engine.ServingEngine` is production-grade
+below the replica boundary (dynamic batching, warmup, circuit breaker,
+canary-validated hot reload); the ROADMAP north star — heavy traffic
+from millions of users — needs many engines behind one front door. RAFT
+makes that pure systems work: its fixed iterative inference means any
+two replicas loaded with the same checkpoint are **bit-interchangeable**
+(same executable, same weights, same flow), so a fleet can route, fail
+over and roll reloads without ever changing a response's value. The
+:class:`ServingFleet` exposes the same ``submit()/health()`` surface as
+one engine and adds:
+
+* **Bucket-aware consistent routing** — :class:`BucketRouter` assigns
+  each padded shape bucket to a replica by rendezvous (highest-random-
+  weight) hashing over ``blake2b`` digests: deterministic across
+  process restarts (unlike Python's salted ``hash``), and minimal-churn
+  by construction — removing a replica moves only *its* buckets (every
+  other bucket keeps its top-scoring replica), adding one steals only
+  the buckets it now wins. Each replica therefore **warms only its
+  assigned buckets**; fleet-wide, every bucket executable compiles
+  exactly once.
+* **Health-gated balancing with response-level failover** — the router
+  yields owners in preference order and the fleet skips replicas whose
+  :func:`~raft_tpu.serving.health.is_routable` check fails (breaker
+  OPEN, closed, still warming). Acceptance is not the end of the
+  contract: the fleet wraps every request in its own future and, when
+  a replica fails a response *after* accepting it (a mid-flight death),
+  resubmits to the next healthy owner — each replica is tried at most
+  once, so a request degrades to an error only when every routable
+  replica failed it. Killing a replica under load costs zero dropped
+  responses (proven by ``scripts/serve_drill.py --drill fleet``).
+* **Shared compile caches** — in-process replicas are built with
+  ``FlowPredictor.clone_with_variables``, sharing one compiled-
+  executable cache: failover traffic landing on a non-owner replica
+  reuses the owner's executable with **zero fresh compiles**, and
+  ``warm_spares`` lets standby replicas pre-touch non-owned buckets at
+  cache-hit cost. Across processes the engines' persistent XLA cache
+  wiring (``persistent_cache``) plays the same role.
+* **Rolling hot reload** — :class:`FleetReloader` canaries a new
+  committed checkpoint on **exactly one** replica (the full
+  :class:`~raft_tpu.serving.reload.HotReloader` golden-pair gauntlet:
+  finite flow, EPE drift band, zero compiles), then waves the rest;
+  any wave failure (non-finite flow, a fresh compile, a staging error)
+  rolls the **whole fleet** back to the prior weights and pins the
+  step. Canary-rejected steps are pinned fleet-wide, never retried.
+* **Fleet-aggregated metrics** — :class:`FleetMetrics` pools the raw
+  latency windows across replicas (fleet p50/p95/p99 over samples, not
+  averaged percentiles), counts routed / failed-over / retried / shed
+  submits, and exposes one health gauge per replica. It duck-types the
+  slice of :class:`~raft_tpu.serving.metrics.ServingMetrics` the load
+  generator reads, so ``loadgen.run_load(fleet, ...)`` drives a fleet
+  unchanged.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import hashlib
+import logging
+import threading
+from collections import Counter, OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.serving import health as health_mod
+from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
+from raft_tpu.serving.engine import ServingConfig, ServingEngine
+from raft_tpu.serving.health import EngineUnhealthy, is_routable
+from raft_tpu.serving.metrics import CompileWatch, _percentile
+from raft_tpu.serving.reload import (HotReloader, ReloadConfig,
+                                     load_step_variables)
+from raft_tpu.utils.padder import InputPadder
+
+logger = logging.getLogger(__name__)
+
+Bucket = Tuple[int, int]
+
+
+# -- consistent bucket routing ------------------------------------------
+
+class BucketRouter:
+    """Rendezvous (highest-random-weight) assignment of shape buckets
+    to replica ids.
+
+    Every ``(bucket, replica)`` pair gets a stable 64-bit score from a
+    ``blake2b`` digest; a bucket's owner-preference order is its
+    replicas sorted by score. Properties the fleet leans on:
+
+    * **Deterministic across restarts** — the digest depends only on
+      the bucket and the replica id string (Python's builtin ``hash``
+      is salted per process and would reshuffle every restart).
+    * **Minimal churn** — removing a replica changes the order of no
+      other pair, so only the departed replica's buckets move (each to
+      its previous runner-up); adding a replica steals exactly the
+      buckets it now top-scores. No global reshuffle, ever.
+    * **Failover order for free** — ``owners()`` returns the *full*
+      preference list, so "next healthy owner" is just the next entry
+      whose replica passes the health gate.
+    """
+
+    def __init__(self, replica_ids: Sequence[str]):
+        # De-dup, preserve caller order (irrelevant to scoring, nice
+        # for reporting).
+        self._ids: List[str] = list(dict.fromkeys(replica_ids))
+
+    @staticmethod
+    def _score(bucket: Bucket, replica_id: str) -> int:
+        key = f"{bucket[0]}x{bucket[1]}|{replica_id}".encode()
+        return int.from_bytes(
+            hashlib.blake2b(key, digest_size=8).digest(), "big")
+
+    @property
+    def replica_ids(self) -> List[str]:
+        return list(self._ids)
+
+    def add_replica(self, replica_id: str) -> None:
+        if replica_id not in self._ids:
+            self._ids.append(replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        if replica_id in self._ids:
+            self._ids.remove(replica_id)
+
+    def owners(self, bucket: Bucket) -> List[str]:
+        """All replicas in preference order for ``bucket`` (index 0 is
+        the owner; the rest is the failover chain)."""
+        return sorted(
+            self._ids,
+            key=lambda rid: (self._score(bucket, rid), rid),
+            reverse=True)
+
+    def owner(self, bucket: Bucket) -> str:
+        if not self._ids:
+            raise RuntimeError("router has no replicas")
+        return self.owners(bucket)[0]
+
+    def assignment(self, buckets: Sequence[Bucket]) -> Dict[str, List[Bucket]]:
+        """``{replica_id: [owned buckets]}`` over ``buckets`` — every
+        replica appears, possibly with an empty list."""
+        out: Dict[str, List[Bucket]] = {rid: [] for rid in self._ids}
+        for b in buckets:
+            out[self.owner(b)].append(b)
+        return out
+
+
+# -- fleet metrics ------------------------------------------------------
+
+class FleetMetrics:
+    """Fleet-level counters + aggregation over the replicas' own
+    :class:`~raft_tpu.serving.metrics.ServingMetrics`.
+
+    Duck-types the reader surface ``loadgen.run_load`` touches
+    (``latency_ms`` / ``batch_histogram`` / ``snapshot``), pooling the
+    raw per-replica latency windows so fleet percentiles are computed
+    over samples rather than averaging percentiles. Routing counters:
+
+    * ``routed`` — accepted submits, per accepting replica.
+    * ``failovers`` — accepted submits that landed off the bucket's
+      primary owner (it was unhealthy or refused), per accepting
+      replica.
+    * ``retries`` — response-level resubmits: a replica failed the
+      request *after* accepting it and the fleet moved it on.
+    * ``shed`` — submits no routable replica accepted (the client sees
+      the last error).
+    """
+
+    def __init__(self, engines_provider):
+        self._engines = engines_provider   # () -> OrderedDict[rid, engine]
+        self._lock = threading.Lock()
+        self.routed: Counter = Counter()
+        self.failovers: Counter = Counter()
+        self.retries: Counter = Counter()
+        self.shed = 0
+
+    # -- recording (fleet-internal) ------------------------------------
+
+    def record_routed(self, replica_id: str, failover: bool) -> None:
+        with self._lock:
+            self.routed[replica_id] += 1
+            if failover:
+                self.failovers[replica_id] += 1
+
+    def record_retry(self, replica_id: str) -> None:
+        """``replica_id`` is the replica that FAILED the response (the
+        resubmission lands as a fresh ``record_routed`` failover)."""
+        with self._lock:
+            self.retries[replica_id] += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    # -- reading -------------------------------------------------------
+
+    def _pooled_latencies(self) -> List[float]:
+        vals: List[float] = []
+        for eng in self._engines().values():
+            vals.extend(eng.metrics.latencies_s())
+        return vals
+
+    def latency_ms(self) -> Dict[str, float]:
+        vals = sorted(self._pooled_latencies())
+        return {"p50": _percentile(vals, 50) * 1e3,
+                "p95": _percentile(vals, 95) * 1e3,
+                "p99": _percentile(vals, 99) * 1e3,
+                "mean": (sum(vals) / len(vals) * 1e3) if vals else 0.0}
+
+    def batch_histogram(self) -> Dict[int, int]:
+        hist: Counter = Counter()
+        for eng in self._engines().values():
+            hist.update(eng.metrics.batch_hist)
+        return dict(hist)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float dict: fleet totals + pooled percentiles +
+        ``fleet_<rid>_*`` per-replica series (latency percentiles,
+        health-state code, routed/failover/retry counts) — one stream
+        an operator can plot per replica."""
+        engines = self._engines()
+        lat = self.latency_ms()
+        with self._lock:
+            out: Dict[str, float] = {
+                "fleet_replicas": float(len(engines)),
+                "fleet_routed": float(sum(self.routed.values())),
+                "fleet_failovers": float(sum(self.failovers.values())),
+                "fleet_retries": float(sum(self.retries.values())),
+                "fleet_shed": float(self.shed),
+            }
+            routed = dict(self.routed)
+            failovers = dict(self.failovers)
+            retries = dict(self.retries)
+        out["fleet_latency_p50_ms"] = lat["p50"]
+        out["fleet_latency_p95_ms"] = lat["p95"]
+        out["fleet_latency_p99_ms"] = lat["p99"]
+        out["fleet_latency_mean_ms"] = lat["mean"]
+        responses = errors = 0
+        for rid, eng in engines.items():
+            m = eng.metrics
+            responses += m.responses
+            errors += m.errors
+            rlat = m.latency_ms()
+            out[f"fleet_{rid}_latency_p50_ms"] = rlat["p50"]
+            out[f"fleet_{rid}_latency_p95_ms"] = rlat["p95"]
+            out[f"fleet_{rid}_latency_p99_ms"] = rlat["p99"]
+            out[f"fleet_{rid}_health"] = float(
+                health_mod.HEALTH_CODES[eng.health_state()])
+            out[f"fleet_{rid}_routed"] = float(routed.get(rid, 0))
+            out[f"fleet_{rid}_failovers"] = float(failovers.get(rid, 0))
+            out[f"fleet_{rid}_retries"] = float(retries.get(rid, 0))
+            out[f"fleet_{rid}_responses"] = float(m.responses)
+            out[f"fleet_{rid}_errors"] = float(m.errors)
+        out["fleet_responses"] = float(responses)
+        out["fleet_errors"] = float(errors)
+        return out
+
+    def report(self) -> str:
+        lat = self.latency_ms()
+        with self._lock:
+            per = ", ".join(
+                f"{rid}:{n}" for rid, n in sorted(self.routed.items()))
+            totals = (sum(self.routed.values()),
+                      sum(self.failovers.values()),
+                      sum(self.retries.values()), self.shed)
+        return (f"routed {totals[0]} {{{per}}} | failovers {totals[1]}, "
+                f"retries {totals[2]}, shed {totals[3]} | fleet latency "
+                f"ms p50 {lat['p50']:.1f} p95 {lat['p95']:.1f} p99 "
+                f"{lat['p99']:.1f}")
+
+
+# -- chaos hook ---------------------------------------------------------
+
+class _DeadPredictor:
+    """Installed by :meth:`ServingFleet.kill_replica`: every dispatch
+    raises, exactly like a replica whose device fell over mid-flight.
+    The engine's own machinery does the rest — isolation singles fail,
+    the breaker trips OPEN, health goes unroutable."""
+
+    def __init__(self, replica_id: str):
+        self.replica_id = replica_id
+
+    def _dead(self, *args, **kwargs):
+        raise RuntimeError(
+            f"replica {self.replica_id} killed (fleet chaos hook)")
+
+    dispatch_batch = _dead
+    predict_batch = _dead
+    __call__ = _dead
+
+
+# -- the fleet ----------------------------------------------------------
+
+class ServingFleet:
+    """N :class:`~raft_tpu.serving.engine.ServingEngine` replicas behind
+    one ``submit()/health()`` surface.
+
+    Construct with engines whose configs carry distinct ``replica_id``s
+    (use :func:`make_fleet` for the standard sharing-clone setup), then
+    ``start()`` — each replica warms **only its assigned buckets** —
+    and submit as if it were one engine::
+
+        fleet = make_fleet(predictor, n_replicas=3, base=ServingConfig(
+            max_batch=8, buckets=((436, 1024), (180, 320))))
+        fleet.start()
+        flow = fleet.submit(im1, im2).result()
+        fleet.submit(im1, im2).replica_id   # set once resolved
+        fleet.health()["state"]
+        fleet.close()
+
+    The returned future is the *fleet's*, not a replica's: a replica
+    failing the response after accepting it triggers a transparent
+    resubmit to the next healthy owner (each replica tried at most
+    once; ``RequestTimedOut`` is never retried — the client's queue
+    budget is already spent). ``future.replica_id`` names the replica
+    that produced the final result (or the last failure), for
+    per-replica attribution in :mod:`~raft_tpu.serving.loadgen`.
+    """
+
+    def __init__(self, engines: Sequence[ServingEngine]):
+        if not engines:
+            raise ValueError("a fleet needs at least one engine")
+        self._engines: "OrderedDict[str, ServingEngine]" = OrderedDict()
+        for i, eng in enumerate(engines):
+            rid = eng.config.replica_id
+            if rid is None:
+                # Engines must stamp responses for attribution; give
+                # unnamed ones a positional name.
+                rid = f"r{i}"
+                eng.config = dataclasses.replace(eng.config,
+                                                 replica_id=rid)
+            if rid in self._engines:
+                raise ValueError(f"duplicate replica_id {rid!r}")
+            self._engines[rid] = eng
+        first = engines[0].config
+        for eng in engines:
+            if (eng.config.pad_mode, eng.config.factor) != \
+                    (first.pad_mode, first.factor):
+                raise ValueError(
+                    "fleet replicas must share pad_mode/factor (bucket "
+                    "keys would diverge across replicas)")
+        self._pad_mode = first.pad_mode
+        self._factor = first.factor
+        self.router = BucketRouter(list(self._engines))
+        self.metrics = FleetMetrics(lambda: self._engines)
+        self.warmup_stats: Dict[str, Dict[str, float]] = {}
+        self._killed: Dict[str, object] = {}   # rid -> live predictor
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def engines(self) -> "OrderedDict[str, ServingEngine]":
+        return self._engines
+
+    @property
+    def replica_ids(self) -> List[str]:
+        return list(self._engines)
+
+    def start(self, warmup: bool = True,
+              warm_spares: bool = False) -> "ServingFleet":
+        """Warm every replica on its assigned buckets and start them.
+
+        ``warm_spares`` additionally touches every *non*-owned bucket
+        on every replica: with the shared executable cache those warms
+        are cache hits (zero fresh compiles — recorded in
+        ``warmup_stats[rid]["spare_compiles"]``), and afterwards even a
+        failover request pays no first-contact compile anywhere."""
+        all_buckets: List[Bucket] = []
+        for eng in self._engines.values():
+            for b in eng.config.buckets:
+                if b not in all_buckets:
+                    all_buckets.append(b)
+        # Owned buckets first across ALL replicas, spares second: the
+        # owner pays each bucket's compile, spare warms then hit the
+        # shared cache (the accounting the drill asserts on).
+        for rid, eng in self._engines.items():
+            stats: Dict[str, float] = {"seconds": 0.0, "compiles": 0.0,
+                                       "buckets": 0.0}
+            if warmup and eng.config.buckets:
+                for per_bucket in eng.warmup().values():
+                    stats["seconds"] += per_bucket["seconds"]
+                    stats["compiles"] += per_bucket["compiles"]
+                    stats["buckets"] += 1
+            self.warmup_stats[rid] = stats
+        if warm_spares:
+            for rid, eng in self._engines.items():
+                stats = self.warmup_stats[rid]
+                stats["spare_compiles"] = 0.0
+                spares = tuple(b for b in all_buckets
+                               if b not in eng.config.buckets)
+                if spares:
+                    for per_bucket in eng.warmup(spares).values():
+                        stats["seconds"] += per_bucket["seconds"]
+                        stats["spare_compiles"] += per_bucket["compiles"]
+        for eng in self._engines.values():
+            eng.start(warmup=False)
+        return self
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        self._closed = True
+        for eng in self._engines.values():
+            eng.close(timeout)
+
+    def __enter__(self) -> "ServingFleet":
+        if not self.warmup_stats:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- routing -------------------------------------------------------
+
+    def bucket_for(self, image_shape) -> Bucket:
+        """The padded-shape bucket key a request of ``image_shape``
+        lands in — the same key the engines' batchers use."""
+        return InputPadder(image_shape, mode=self._pad_mode,
+                           factor=self._factor).padded_shape
+
+    def assignments(self) -> Dict[str, List[Bucket]]:
+        """Static HRW assignment of every configured (padded) bucket —
+        which replica warms what. Ignores health; see
+        :meth:`effective_owner` for the live answer."""
+        buckets: List[Bucket] = []
+        for eng in self._engines.values():
+            for raw in eng.config.buckets:
+                b = self.bucket_for((*raw, 3))
+                if b not in buckets:
+                    buckets.append(b)
+        return self.router.assignment(buckets)
+
+    def effective_owner(self, bucket: Bucket) -> Optional[str]:
+        """The replica currently serving ``bucket``: the first owner in
+        HRW preference order whose health gate passes. ``None`` when no
+        replica is routable (the fleet would shed)."""
+        for rid in self.router.owners(bucket):
+            if is_routable(self._engines[rid].health_state()):
+                return rid
+        return None
+
+    # -- health --------------------------------------------------------
+
+    def health(self) -> Dict[str, object]:
+        """Fleet probe payload: per-replica ``health()`` dicts plus the
+        fleet rollup — ``ready`` while at least one replica is
+        routable, ``state`` = ``ready`` (all replicas READY) /
+        ``degraded`` (serving, but at least one replica isn't READY) /
+        ``open`` (no routable replica) / ``closed``."""
+        replicas = {rid: eng.health()
+                    for rid, eng in self._engines.items()}
+        states = [r["state"] for r in replicas.values()]
+        routable = sum(1 for s in states if is_routable(s))
+        if all(s == health_mod.CLOSED for s in states):
+            state = health_mod.CLOSED
+        elif routable == 0:
+            state = health_mod.OPEN
+        elif all(s == health_mod.READY for s in states):
+            state = health_mod.READY
+        else:
+            state = health_mod.DEGRADED
+        return {"state": state, "ready": routable > 0,
+                "routable_replicas": routable,
+                "replicas": replicas}
+
+    # -- client API ----------------------------------------------------
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               priority: str = PRIORITY_HIGH):
+        """Route one request to its bucket's healthiest owner; returns
+        a future resolving to the unpadded ``(H, W, 2)`` flow,
+        bit-identical to any single replica's answer (replicas are
+        bit-interchangeable). Transparent failover on both refusal and
+        post-acceptance failure; ``future.replica_id`` is stamped when
+        the future resolves. Thread-safe."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        outer: concurrent.futures.Future = concurrent.futures.Future()
+        outer.replica_id = None
+        bucket = self.bucket_for(image1.shape)
+        self._dispatch(outer, image1, image2, priority, bucket,
+                       tried=set(), hops=0, last_exc=None)
+        return outer
+
+    def predict(self, image1: np.ndarray, image2: np.ndarray,
+                timeout: Optional[float] = 120.0) -> np.ndarray:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(image1, image2).result(timeout)
+
+    def _dispatch(self, outer, image1, image2, priority, bucket: Bucket,
+                  tried: set, hops: int, last_exc) -> None:
+        """Walk the bucket's owner-preference chain and hand the
+        request to the first routable replica not yet tried. Called
+        once at submit and re-entered (from a replica's completion
+        thread) after each post-acceptance failure; ``tried`` grows by
+        one replica per re-entry, so the walk terminates."""
+        owners = self.router.owners(bucket)
+        primary = owners[0] if owners else None
+        for rid in owners:
+            if rid in tried:
+                continue
+            engine = self._engines[rid]
+            if not is_routable(engine.health_state()):
+                continue
+            try:
+                inner = engine.submit(image1, image2, priority=priority)
+            except Exception as e:
+                # Refused at the door (breaker fast-fail, backlog full,
+                # closed): try the next owner.
+                tried.add(rid)
+                last_exc = e
+                continue
+            self.metrics.record_routed(
+                rid, failover=(rid != primary or hops > 0))
+            inner.add_done_callback(
+                lambda f, rid=rid: self._on_reply(
+                    outer, f, rid, image1, image2, priority, bucket,
+                    tried, hops))
+            return
+        self.metrics.record_shed()
+        outer.set_exception(last_exc or EngineUnhealthy(
+            f"no routable replica for bucket {bucket} "
+            f"(replicas: {', '.join(self._engines)})"))
+
+    def _on_reply(self, outer, inner, rid: str, image1, image2,
+                  priority, bucket: Bucket, tried: set, hops: int) -> None:
+        exc = inner.exception()
+        if exc is None:
+            outer.replica_id = getattr(inner, "replica_id", rid)
+            outer.set_result(inner.result())
+            return
+        if isinstance(exc, RequestTimedOut) or self._closed:
+            # The queue budget is the client's; retrying elsewhere
+            # would just serve a staler answer later. Closed fleet:
+            # nothing left to retry on.
+            outer.replica_id = rid
+            outer.set_exception(exc)
+            return
+        tried.add(rid)
+        self.metrics.record_retry(rid)
+        try:
+            self._dispatch(outer, image1, image2, priority, bucket,
+                           tried, hops + 1, last_exc=exc)
+        except Exception as e:   # never lose a future to a retry bug
+            if not outer.done():
+                outer.replica_id = rid
+                outer.set_exception(e)
+
+    # -- chaos ---------------------------------------------------------
+
+    def kill_replica(self, replica_id: str) -> None:
+        """Chaos hook: make ``replica_id`` fail every dispatch from now
+        on, as if its device died mid-flight. Requests it already
+        accepted fail at dispatch/sync and fail over; its breaker trips
+        OPEN; the router's health gate then re-balances its buckets to
+        their next owners. Quiet install — no swap metric tick."""
+        engine = self._engines[replica_id]
+        if replica_id not in self._killed:
+            self._killed[replica_id] = engine.predictor
+        engine._install_predictor(_DeadPredictor(replica_id))
+
+    def revive_replica(self, replica_id: str) -> None:
+        """Undo :meth:`kill_replica`: reinstall the live predictor and
+        let the breaker close on its next successful probe."""
+        engine = self._engines[replica_id]
+        predictor = self._killed.pop(replica_id, None)
+        if predictor is None:
+            return
+        engine._install_predictor(predictor)
+
+
+def make_fleet(predictor, n_replicas: int,
+               base: Optional[ServingConfig] = None) -> ServingFleet:
+    """Standard in-process fleet construction: ``n_replicas`` engines
+    named ``r0..rN-1``, each owning (and later warming) only the
+    buckets the :class:`BucketRouter` assigns it, every replica's
+    predictor a ``clone_with_variables`` of ``predictor`` so all share
+    one compiled-executable cache (fleet-wide each bucket compiles
+    once; failover traffic and rolling-reload standbys are cache
+    hits). ``base`` supplies the shared knobs; its ``buckets`` is the
+    fleet-wide set, split here."""
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    base = base or ServingConfig()
+    ids = [f"r{i}" for i in range(n_replicas)]
+    router = BucketRouter(ids)
+    padded = {
+        raw: InputPadder((*raw, 3), mode=base.pad_mode,
+                         factor=base.factor).padded_shape
+        for raw in base.buckets}
+    engines = []
+    for i, rid in enumerate(ids):
+        mine = tuple(raw for raw in base.buckets
+                     if router.owner(padded[raw]) == rid)
+        cfg = dataclasses.replace(base, buckets=mine, replica_id=rid)
+        pred = (predictor if i == 0
+                else predictor.clone_with_variables(predictor.variables))
+        engines.append(ServingEngine(pred, cfg))
+    return ServingFleet(engines)
+
+
+# -- rolling reload -----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FleetReloadConfig:
+    """Knobs for one :class:`FleetReloader`.
+
+    ``poll_interval_s`` / ``canary_max_epe`` / ``max_canary_compiles``
+    mirror :class:`~raft_tpu.serving.reload.ReloadConfig` (they
+    configure the canary replica's full golden-pair gauntlet).
+    ``max_wave_compiles`` caps fresh XLA compiles per *waved* replica
+    (default 0: standbys must serve through the shared executables; a
+    compile on the wave means every replica would pay it again and
+    triggers a fleet rollback)."""
+
+    poll_interval_s: float = 5.0
+    canary_max_epe: Optional[float] = 5.0
+    max_canary_compiles: int = 0
+    max_wave_compiles: int = 0
+
+
+class FleetReloader:
+    """Fleet-wide rolling hot reload: canary one replica, wave the
+    rest, roll the whole fleet back on any drift.
+
+    Per :meth:`poll_once`:
+
+    1. **Canary** — the first *routable* replica runs the full
+       single-engine :class:`~raft_tpu.serving.reload.HotReloader`
+       cycle on the newest committed, un-pinned step: stage a standby
+       through the shared executable cache, golden-pair canary (finite
+       flow, EPE drift band, zero compiles), swap or pin+rollback.
+       Exactly one replica ever serves an unvalidated checkpoint, and
+       only to its canary pairs — traffic sees it only after the pass.
+    2. **Wave** — every other routable replica stages its own standby
+       from the same step and passes :meth:`_wave_check` (finite flow
+       through the serving-shaped batch; a cheaper re-validation — the
+       canary already did the full gauntlet on identical weights) plus
+       the ``max_wave_compiles`` gate, then swaps atomically. Replicas
+       that are unroutable (killed, breaker OPEN) are skipped and
+       reported; they re-sync on a later poll once healthy.
+    3. **Rollback** — if any wave step fails, every already-swapped
+       replica (canary included) gets its prior predictor reinstalled
+       (quietly — no extra swap tick), the step is pinned fleet-wide,
+       and each restored replica records a rollback (degraded, for the
+       operator). The fleet is never left serving mixed weights.
+
+    Pinning and ``current_step`` live here (fleet-level) and are shared
+    into the per-poll canary reloader, so one bad export is rejected
+    once, not once per replica.
+    """
+
+    def __init__(self, fleet: ServingFleet, ckpt_dir: str,
+                 canary_frames, config: Optional[FleetReloadConfig] = None,
+                 checkpointer=None):
+        if not canary_frames:
+            raise ValueError("canary_frames must hold at least one "
+                             "(image1, image2) fixture pair")
+        self.fleet = fleet
+        self.ckpt_dir = ckpt_dir
+        self.canary_frames = list(canary_frames)
+        self.config = config or FleetReloadConfig()
+        self._owns_ckptr = checkpointer is None
+        if checkpointer is None:
+            from raft_tpu.checkpoint import RunCheckpointer
+            checkpointer = RunCheckpointer(ckpt_dir, gc_orphans=False)
+        self._ckptr = checkpointer
+        self.current_step: Optional[int] = None
+        self.pinned_steps: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- the rolling cycle ---------------------------------------------
+
+    def _canary_reloader(self, engine) -> HotReloader:
+        """A single-engine reloader for this poll's canary replica,
+        sharing the fleet's checkpointer, pinned-step set (same object:
+        a canary rejection pins fleet-wide) and current step."""
+        hr = HotReloader(
+            engine, self.ckpt_dir, self.canary_frames,
+            config=ReloadConfig(
+                poll_interval_s=self.config.poll_interval_s,
+                canary_max_epe=self.config.canary_max_epe,
+                max_canary_compiles=self.config.max_canary_compiles),
+            checkpointer=self._ckptr)
+        hr.pinned_steps = self.pinned_steps
+        hr.current_step = self.current_step
+        return hr
+
+    def _wave_check(self, engine, standby) -> Tuple[bool, str]:
+        """Re-validate a waved standby before its swap: finite flow on
+        the first golden pair through the serving-shaped batch. The
+        canary already ran the full gauntlet on bit-identical weights;
+        this catches a torn/corrupt *read* on this replica's own
+        staging path. Monkeypatchable drift seam for tests."""
+        cfg = engine.config
+        image1, image2 = self.canary_frames[0]
+        padder = InputPadder(image1.shape, mode=cfg.pad_mode,
+                             factor=cfg.factor)
+        p1, p2 = padder.pad(image1, image2)
+        b1 = np.repeat(p1[None], cfg.max_batch, 0)
+        b2 = np.repeat(p2[None], cfg.max_batch, 0)
+        _, up = standby.predict_batch(b1, b2)
+        if not np.isfinite(up[0]).all():
+            return False, "non-finite flow from waved standby"
+        return True, "ok"
+
+    def poll_once(self) -> Dict[str, object]:
+        """One rolling-reload cycle. Returns an action record::
+
+            {"action": "none"}
+            {"action": "swapped", "step": s, "epe": e,
+             "canary_replica": rid, "waved": [...], "skipped": [...],
+             "wave_compiles": n}
+            {"action": "rolled_back", "step": s, "reason": r, ...}
+        """
+        engines = self.fleet.engines
+        canary_rid = next(
+            (rid for rid, eng in engines.items()
+             if is_routable(eng.health_state())), None)
+        if canary_rid is None:
+            return {"action": "none", "reason": "no routable replica"}
+        # Prior predictors, captured before anything swaps: the fleet
+        # rollback target.
+        prior = {rid: eng.predictor for rid, eng in engines.items()}
+        hr = self._canary_reloader(engines[canary_rid])
+        act = dict(hr.poll_once())
+        if act["action"] != "swapped":
+            if act["action"] == "rolled_back":
+                act["canary_replica"] = canary_rid
+            return act
+        step = int(act["step"])
+        waved: List[str] = []
+        skipped: List[str] = []
+        with CompileWatch() as watch:
+            for rid, eng in engines.items():
+                if rid == canary_rid:
+                    continue
+                if not is_routable(eng.health_state()):
+                    skipped.append(rid)
+                    continue
+                c0 = watch.so_far
+                try:
+                    variables = load_step_variables(
+                        self.ckpt_dir, step, eng.predictor.variables)
+                    standby = eng.predictor.clone_with_variables(
+                        variables)
+                    ok, reason = self._wave_check(eng, standby)
+                except Exception as e:
+                    ok, reason = False, (f"wave stage raised "
+                                         f"{type(e).__name__}: {e}")
+                compiles = watch.so_far - c0
+                if ok and compiles > self.config.max_wave_compiles:
+                    ok = False
+                    reason = (f"wave triggered {compiles} fresh "
+                              f"compile(s) on {rid} (max "
+                              f"{self.config.max_wave_compiles}) — "
+                              "standby does not share the warmed "
+                              "executables")
+                if not ok:
+                    restored = self._rollback_fleet(
+                        prior, [canary_rid, *waved], step, reason)
+                    logger.warning(
+                        "rolling reload of step %d rolled back on "
+                        "replica %s: %s (restored %s)", step, rid,
+                        reason, ", ".join(restored))
+                    return {"action": "rolled_back", "step": step,
+                            "reason": reason, "failed_replica": rid,
+                            "canary_replica": canary_rid,
+                            "restored": restored}
+                eng.swap_predictor(standby)
+                waved.append(rid)
+        self.current_step = step
+        logger.info(
+            "rolling reload: fleet now serving step %d (canary %s, "
+            "waved %s, skipped %s, %d wave compiles)", step, canary_rid,
+            waved, skipped, watch.compiles)
+        act.update({"canary_replica": canary_rid, "waved": waved,
+                    "skipped": skipped, "wave_compiles": watch.compiles})
+        return act
+
+    def _rollback_fleet(self, prior, swapped_rids: List[str], step: int,
+                        reason: str) -> List[str]:
+        """Restore every already-swapped replica's prior predictor
+        (quiet install — the canary's swap already ticked ``swaps``;
+        the restore must not tick another), pin the step fleet-wide,
+        and record a rollback on each restored replica.
+        ``current_step`` stays at the pre-poll value (it is only
+        advanced after a fully successful wave)."""
+        self.pinned_steps.add(step)
+        restored = []
+        for rid in swapped_rids:
+            eng = self.fleet.engines[rid]
+            eng._install_predictor(prior[rid])
+            eng.record_rollback(reason)
+            restored.append(rid)
+        return restored
+
+    # -- background watcher --------------------------------------------
+
+    def start(self) -> "FleetReloader":
+        """Run :meth:`poll_once` every ``poll_interval_s`` in a daemon
+        thread until :meth:`stop` (same contract as the single-engine
+        watcher: a poll that raises is logged and retried)."""
+        if self._thread is not None:
+            raise RuntimeError("fleet reloader already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.config.poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception as e:   # pragma: no cover - defensive
+                    logger.warning(
+                        "fleet reload poll failed (%s: %s); retrying "
+                        "next interval", type(e).__name__, e)
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-hot-reload", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if self._owns_ckptr:
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "FleetReloader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -61,6 +61,17 @@ HEALTH_CODES: Dict[str, int] = {
     CLOSED: 5,
 }
 
+# The states a load balancer may send traffic to. DEGRADED is
+# deliberately routable (serving safely, paging a human); everything
+# else is either not up yet, failing, or gone. The single source of
+# truth the fleet router keys on.
+ROUTABLE = frozenset({READY, DEGRADED})
+
+
+def is_routable(state: str) -> bool:
+    """Whether a replica in ``state`` should receive traffic."""
+    return state in ROUTABLE
+
 
 class EngineUnhealthy(RuntimeError):
     """Fail-fast rejection while the dispatch circuit breaker is open.

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu.serving.metrics import _percentile
 from raft_tpu.utils.padder import InputPadder
 
 
@@ -129,8 +130,18 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     blend, and never garbage. Returns a dict with ``ok``, ``completed``,
     ``dropped`` (exceptions, by request index), ``mismatched`` (request
     indices whose flow matched neither reference), ``matched_primary``/
-    ``matched_alt`` counts, ``seconds``, ``throughput_rps``, and the
-    engine's metrics snapshot/histogram.
+    ``matched_alt`` counts, ``seconds``, ``throughput_rps``, the
+    engine's metrics snapshot/histogram, and ``per_replica``.
+
+    ``per_replica`` attributes every outcome to the replica that
+    produced it, keyed by the ``replica_id`` the engine (or fleet)
+    stamps on resolved futures — ``"unattributed"`` for engines that
+    don't stamp. Per replica: ``completed`` / ``dropped`` counts,
+    ``mismatched`` request indices, and client-observed latency
+    percentiles (submit → result wall time, which for a fleet includes
+    failover resubmits — the number the client actually experiences).
+    A fleet drill reads it to NAME the replica that dropped or
+    corrupted a response instead of reporting an anonymous failure.
     """
     lock = threading.Lock()
     next_req = [0]
@@ -139,10 +150,18 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     completed = [0]
     matched_primary = [0]
     matched_alt = [0]
+    per_replica: Dict[str, Dict[str, object]] = {}
 
     def _matches(flow, ref) -> bool:
         return (ref is not None and flow.shape == ref.shape
                 and np.array_equal(flow, ref))
+
+    def _replica_stats(fut) -> Dict[str, object]:
+        """Caller holds ``lock``."""
+        rid = getattr(fut, "replica_id", None) or "unattributed"
+        return per_replica.setdefault(rid, {
+            "completed": 0, "dropped": 0, "mismatched": [],
+            "latencies_s": []})
 
     def client():
         while True:
@@ -152,14 +171,22 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
                     return
                 next_req[0] += 1
             im1, im2 = frames[i % len(frames)]
+            fut = None
+            t_req = time.perf_counter()
             try:
-                flow = engine.submit(im1, im2).result(timeout)
+                fut = engine.submit(im1, im2)
+                flow = fut.result(timeout)
             except Exception:
                 with lock:
                     dropped.append(i)
+                    _replica_stats(fut)["dropped"] += 1
                 continue
+            latency = time.perf_counter() - t_req
             with lock:
                 completed[0] += 1
+                stats = _replica_stats(fut)
+                stats["completed"] += 1
+                stats["latencies_s"].append(latency)
             if references is not None:
                 ref = references[i % len(frames)]
                 alt = (alt_references[i % len(frames)]
@@ -173,6 +200,7 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
                 else:
                     with lock:
                         mismatched.append(i)
+                        _replica_stats(fut)["mismatched"].append(i)
 
     threads = [threading.Thread(target=client, name=f"loadgen-{t}")
                for t in range(concurrency)]
@@ -182,6 +210,20 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     for th in threads:
         th.join()
     dt = time.perf_counter() - t0
+    replica_out = {}
+    for rid, stats in sorted(per_replica.items()):
+        lats = sorted(stats["latencies_s"])
+        replica_out[rid] = {
+            "completed": stats["completed"],
+            "dropped": stats["dropped"],
+            "mismatched": sorted(stats["mismatched"]),
+            "latency_ms": {
+                "p50": _percentile(lats, 50) * 1e3,
+                "p95": _percentile(lats, 95) * 1e3,
+                "p99": _percentile(lats, 99) * 1e3,
+                "mean": (sum(lats) / len(lats) * 1e3) if lats else 0.0,
+            },
+        }
     return {
         "ok": not dropped and not mismatched
               and completed[0] == n_requests,
@@ -197,4 +239,5 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
         "latency_ms": engine.metrics.latency_ms(),
         "batch_histogram": engine.metrics.batch_histogram(),
         "metrics": engine.metrics.snapshot(),
+        "per_replica": replica_out,
     }

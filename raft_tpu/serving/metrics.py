@@ -236,6 +236,14 @@ class ServingMetrics:
 
     # -- reading --------------------------------------------------------
 
+    def latencies_s(self) -> list:
+        """Copy of the rolling latency window, in seconds. The fleet
+        aggregator pools these across replicas so fleet percentiles are
+        computed over the raw samples, not averaged per-replica
+        percentiles (which would be statistically meaningless)."""
+        with self._lock:
+            return list(self._lat)
+
     def latency_ms(self) -> Dict[str, float]:
         with self._lock:
             vals = sorted(self._lat)

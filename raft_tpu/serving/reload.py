@@ -87,6 +87,35 @@ class CanaryResult:
     compiles: int
 
 
+def load_step_variables(ckpt_dir: str, step: int, current_variables):
+    """Load ``step``'s params from ``ckpt_dir`` into a variables pytree
+    shaped like ``current_variables`` (same top-level collections), with
+    every leaf normalized to host numpy.
+
+    Orbax hands back device-COMMITTED arrays; jit specializes on
+    committed-ness, so feeding them straight into the shared executables
+    would retrace (one fresh compile — exactly what the canary's
+    zero-compile check catches). Host numpy leaves are placement-neutral
+    and hit the warmed executables. Shared by the single-engine
+    :class:`HotReloader` and the fleet's wave stage
+    (:class:`~raft_tpu.serving.fleet.FleetReloader`), which must build
+    one standby per replica from the same checkpoint read."""
+    import jax
+
+    from raft_tpu.checkpoint import load_params
+
+    params, batch_stats = load_params(ckpt_dir, step=step)
+    params = jax.tree_util.tree_map(np.asarray, params)
+    batch_stats = jax.tree_util.tree_map(np.asarray, batch_stats)
+    variables = {"params": params}
+    if "batch_stats" in current_variables:
+        variables["batch_stats"] = batch_stats
+    for key in current_variables:
+        if key not in variables:
+            variables[key] = current_variables[key]
+    return variables
+
+
 class HotReloader:
     """Watches a checkpoint directory and hot-swaps the serving model.
 
@@ -191,25 +220,8 @@ class HotReloader:
         mirrors the serving model's top-level collections (include
         ``batch_stats`` only if the current model carries it) so the
         shared cache never retraces."""
-        import jax
-
-        from raft_tpu.checkpoint import load_params
-
-        params, batch_stats = load_params(self.ckpt_dir, step=step)
-        # Orbax hands back device-COMMITTED arrays; jit specializes on
-        # committed-ness, so feeding them straight into the shared
-        # executables would retrace (one fresh compile — exactly what
-        # the canary's zero-compile check catches). Host numpy leaves
-        # are placement-neutral and hit the warmed executables.
-        params = jax.tree_util.tree_map(np.asarray, params)
-        batch_stats = jax.tree_util.tree_map(np.asarray, batch_stats)
         current = self.engine.predictor.variables
-        variables = {"params": params}
-        if "batch_stats" in current:
-            variables["batch_stats"] = batch_stats
-        for key in current:
-            if key not in variables:
-                variables[key] = current[key]
+        variables = load_step_variables(self.ckpt_dir, step, current)
         return self.engine.predictor.clone_with_variables(variables)
 
     def poll_once(self) -> Dict[str, object]:

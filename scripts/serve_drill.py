@@ -401,10 +401,175 @@ def drill_reload_under_load(root):
     assert engine.health()["state"] == "closed"
 
 
+def drill_fleet(root):
+    """3-replica fleet: kill a replica under 50 concurrent clients
+    (zero dropped/bit-incorrect, breaker isolates it, router
+    re-balances its buckets), then a rolling reload — exactly one
+    canary, zero fresh compiles on the waved replicas — and a NaN
+    checkpoint that rolls the whole fleet back."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.checkpoint import RunCheckpointer
+    from raft_tpu.serving import (CircuitBreaker, CompileWatch,
+                                  FleetReloadConfig, FleetReloader,
+                                  ServingConfig, loadgen, make_fleet)
+
+    predictor = _make_predictor()
+    frames = loadgen.make_frames(SHAPES, per_shape=2, seed=41)
+    refs_old, ref_kind = _references(predictor, frames, max_batch=4)
+
+    n_replicas, concurrency = 3, 50
+    fleet = make_fleet(predictor, n_replicas, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=BUCKETS,
+        breaker_threshold=2, breaker_cooldown_s=120.0))
+    # Long cooldown: the killed replica must stay OPEN (unroutable) for
+    # the rest of the drill instead of half-open probing its dead device.
+    fleet.start(warm_spares=True)
+    owned = sum(s["compiles"] for s in fleet.warmup_stats.values())
+    spare = sum(s.get("spare_compiles", 0.0)
+                for s in fleet.warmup_stats.values())
+    assignments = fleet.assignments()
+    print(f"  assignment: {assignments}; warmup compiles owned={owned:g} "
+          f"spare={spare:g} (spares warm from the shared cache)")
+    assert owned > 0, "owners compiled nothing"
+    assert spare == 0, \
+        f"spare warmups compiled {spare:g} times (shared cache broken)"
+    victim = next(rid for rid, bs in assignments.items() if bs)
+    victim_buckets = assignments[victim]
+
+    # -- Phase 1: kill the victim under 50-client load ------------------
+    n_requests = 150
+    out1 = {}
+
+    def load1():
+        out1.update(loadgen.run_load(
+            fleet, frames, n_requests=n_requests,
+            concurrency=concurrency, references=refs_old, timeout=120.0))
+
+    def fleet_responses():
+        return sum(e.metrics.responses for e in fleet.engines.values())
+
+    loader = threading.Thread(target=load1, name="fleet-load-1")
+    loader.start()
+    _await_metric(fleet_responses, 30, 120, "responses before kill")
+    fleet.kill_replica(victim)
+    loader.join(300)
+    assert not loader.is_alive(), "load generator wedged"
+
+    per = {rid: (s["completed"], s["dropped"])
+           for rid, s in out1["per_replica"].items()}
+    print(f"  kill {victim} under load: {out1['completed']}/{n_requests} "
+          f"responses at concurrency {concurrency}, per-replica "
+          f"(completed, dropped) = {per}; reference = {ref_kind}")
+    print("  fleet:", fleet.metrics.report())
+    assert out1["completed"] == n_requests, \
+        f"completed {out1['completed']}/{n_requests}"
+    assert not out1["dropped"], f"dropped: {out1['dropped']}"
+    assert not out1["mismatched"], \
+        f"bit-incorrect responses: {out1['mismatched']}"
+    # Breaker isolation on the dead replica, traffic re-routed.
+    v_eng = fleet.engines[victim]
+    assert v_eng.breaker.state == CircuitBreaker.OPEN, \
+        f"victim breaker {v_eng.breaker.state}, want open"
+    assert v_eng.health()["state"] == "open"
+    snap = fleet.metrics.snapshot()
+    assert snap["fleet_failovers"] > 0, "no failover was ever recorded"
+    assert snap["fleet_shed"] == 0, f"shed {snap['fleet_shed']} requests"
+    # Router re-balance: every victim bucket has a new live owner.
+    for b in victim_buckets:
+        new_owner = fleet.effective_owner(b)
+        assert new_owner is not None and new_owner != victim, \
+            f"bucket {b} not re-balanced (owner {new_owner})"
+    print(f"  victim {victim} OPEN; its buckets re-balanced to "
+          f"{[fleet.effective_owner(b) for b in victim_buckets]}")
+    health = fleet.health()
+    assert health["ready"] and health["state"] == "degraded", health
+
+    # -- Phase 2: rolling reload on the degraded fleet ------------------
+    vars_cur = predictor.variables
+    params_good = jax.tree_util.tree_map(
+        lambda x: x * (1 + 1e-3), vars_cur["params"])
+    params_bad = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), vars_cur["params"])
+    refs_new, _ = _references(
+        predictor.clone_with_variables(
+            dict(vars_cur, params=params_good)), frames, max_batch=4)
+
+    class _FleetState:
+        def __init__(self, step, params):
+            self.step = jnp.asarray(step, jnp.int32)
+            self.params = params
+            self.batch_stats = vars_cur.get("batch_stats", {})
+            self.opt_state = {"m": jnp.zeros(4, jnp.float32)}
+
+    # Warm orbax's one-time internal jit against a scratch dir so the
+    # zero-compile watch below measures only the serving path.
+    scratch = RunCheckpointer(os.path.join(root, "scratch"))
+    scratch.save(_FleetState(1, params_good))
+    scratch.close()
+    ckpt_dir = os.path.join(root, "ckpts")
+    trainer = RunCheckpointer(ckpt_dir)
+    reloader = FleetReloader(
+        fleet, ckpt_dir, canary_frames=[frames[0]],
+        config=FleetReloadConfig(canary_max_epe=50.0))
+    try:
+        trainer.save(_FleetState(1, params_good))
+        with CompileWatch() as watch:
+            act = reloader.poll_once()
+        assert act["action"] == "swapped", f"reload did not swap: {act}"
+        assert isinstance(act["canary_replica"], str), act
+        # Exactly one canary; the dead replica is skipped, everyone
+        # else waves; zero fresh compiles anywhere on the wave.
+        assert act["skipped"] == [victim], act
+        assert len(act["waved"]) == n_replicas - 2, act
+        assert act["wave_compiles"] == 0, act
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) during rolling reload"
+        print(f"  rolling reload: canary {act['canary_replica']} "
+              f"(EPE {act['epe']:.3f} px), waved {act['waved']}, "
+              f"skipped {act['skipped']}, 0 fresh compiles")
+        # Post-reload traffic must bit-match the NEW model fleet-wide.
+        out2 = loadgen.run_load(fleet, frames, n_requests=60,
+                                concurrency=16, references=refs_new,
+                                timeout=120.0)
+        assert out2["completed"] == 60 and not out2["dropped"], out2
+        assert not out2["mismatched"], \
+            f"post-reload mismatches: {out2['mismatched']}"
+        served_by = sorted(out2["per_replica"])
+        assert victim not in served_by, \
+            f"dead replica {victim} served post-reload traffic"
+        print(f"  post-reload: 60/60 bit-exact on the new model, "
+              f"served by {served_by}")
+
+        # NaN checkpoint: canary catches it, whole fleet keeps the good
+        # weights, step is pinned fleet-wide.
+        trainer.save(_FleetState(2, params_bad))
+        act = reloader.poll_once()
+        assert act["action"] == "rolled_back", act
+        assert "non-finite" in act["reason"], act
+        assert reloader.poll_once()["action"] == "none", \
+            "pinned step was retried"
+        assert reloader.current_step == 1
+        flow = fleet.submit(*frames[0]).result(60)
+        assert np.array_equal(flow, refs_new[0]), \
+            "post-rollback response not bit-exact vs the good model"
+        print(f"  NaN checkpoint rolled back by canary "
+              f"{act['canary_replica']}, step 2 pinned; fleet still "
+              f"serves the good weights bit-exact")
+    finally:
+        reloader.stop()
+        trainer.close()
+        fleet.close()
+    assert fleet.health()["state"] == "closed"
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
     drill_reload_under_load,
+    drill_fleet,
 ]
 
 

@@ -1,0 +1,440 @@
+"""Serving-fleet suite: rendezvous router determinism and minimal
+churn, the in-process 2-replica fleet (bit-exact routing, per-replica
+attribution, warmup ownership), health-gated failover under a killed
+replica, and fleet-wide rolling hot reload (wave, rollback-on-drift,
+canary rollback, unroutable-skip) — plus the multi-replica chaos drill
+as a `slow` subprocess test.
+
+Same determinism regime as tests/test_serving.py: random-weights
+RAFT-small at iters=2, references through the SAME (max_batch=4)
+executable the engines dispatch (this suite runs under 8 virtual CPU
+devices, where batch-1 ``__call__`` is a different executable with
+different float accumulation order). All fleets here are built from one
+module predictor, so replicas share a single compiled-executable cache
+and each bucket compiles once for the whole module.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving.fleet import BucketRouter
+
+# Two raw shapes padding to DIFFERENT /8 buckets — (40, 64) and
+# (56, 80) — so routing actually has something to split.
+FLEET_SHAPES = [(36, 60), (52, 76)]
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+@pytest.fixture(scope="module")
+def frames_and_refs(predictor):
+    from raft_tpu.serving import loadgen
+    frames = loadgen.make_frames(FLEET_SHAPES, per_shape=2, seed=11)
+    return frames, loadgen.batched_reference_flows(predictor, frames,
+                                                   max_batch=4)
+
+
+def _fleet(predictor, n=2, **kw):
+    from raft_tpu.serving import ServingConfig
+    from raft_tpu.serving.fleet import make_fleet
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 3.0)
+    kw.setdefault("buckets", tuple(FLEET_SHAPES))
+    kw.setdefault("breaker_threshold", 2)
+    # Long cooldown: a tripped breaker stays OPEN for the whole test,
+    # so "unroutable" assertions can't race a half-open probe.
+    kw.setdefault("breaker_cooldown_s", 120.0)
+    return make_fleet(predictor, n, ServingConfig(**kw))
+
+
+# -- router: determinism + minimal churn (no jax needed) ----------------
+
+
+class TestBucketRouter:
+    IDS = ["r0", "r1", "r2"]
+    BUCKETS = [(40, 64), (56, 80), (80, 128), (120, 160), (184, 320),
+               (224, 320), (440, 1024), (64, 96)]
+    # Wide synthetic set for the churn tests: enough buckets that a
+    # join/leave statistically must move some and keep most.
+    MANY = [(8 * i, 8 * j) for i in range(1, 9) for j in range(1, 6)]
+
+    def test_owner_assignment_pinned(self):
+        """Golden assignment, computed once and pinned: blake2b scoring
+        depends only on (bucket, replica_id) strings, so ANY process —
+        today's, a restarted server's, a different host's — must
+        reproduce exactly this map. (Python's builtin ``hash`` is
+        salted per process and would fail this test on every rerun.)"""
+        r = BucketRouter(self.IDS)
+        assert {b: r.owner(b) for b in self.BUCKETS} == {
+            (40, 64): "r2", (56, 80): "r2", (80, 128): "r1",
+            (120, 160): "r1", (184, 320): "r2", (224, 320): "r1",
+            (440, 1024): "r2", (64, 96): "r1"}
+
+    def test_fresh_instance_agrees(self):
+        a = BucketRouter(self.IDS)
+        b = BucketRouter(list(reversed(self.IDS)))   # order-insensitive
+        for bucket in self.MANY:
+            assert a.owners(bucket) == b.owners(bucket)
+
+    def test_owners_is_full_failover_chain(self):
+        r = BucketRouter(self.IDS)
+        for bucket in self.BUCKETS:
+            chain = r.owners(bucket)
+            assert sorted(chain) == sorted(self.IDS)
+            assert r.owner(bucket) == chain[0]
+
+    def test_remove_moves_only_departed_replicas_buckets(self):
+        ids = ["r0", "r1", "r2", "r3", "r4"]
+        r = BucketRouter(ids)
+        before = {b: r.owners(b) for b in self.MANY}
+        r.remove_replica("r2")
+        moved = 0
+        for b in self.MANY:
+            after = r.owner(b)
+            if before[b][0] == "r2":
+                # Departed owner's buckets land on their previous
+                # runner-up — the preference order of the survivors is
+                # untouched.
+                assert after == before[b][1]
+                moved += 1
+            else:
+                assert after == before[b][0]
+        assert moved > 0          # r2 owned something (fixed hashing)
+
+    def test_add_steals_only_buckets_it_wins(self):
+        r = BucketRouter(["r0", "r1", "r2", "r3"])
+        before = {b: r.owner(b) for b in self.MANY}
+        r.add_replica("r4")
+        stolen = kept = 0
+        for b in self.MANY:
+            after = r.owner(b)
+            if after == "r4":
+                stolen += 1
+            else:
+                assert after == before[b]   # nobody else's bucket moved
+                kept += 1
+        assert stolen > 0 and kept > 0
+
+    def test_assignment_partitions_buckets(self):
+        r = BucketRouter(self.IDS)
+        assignment = r.assignment(self.BUCKETS)
+        assert sorted(assignment) == sorted(self.IDS)
+        flat = [b for owned in assignment.values() for b in owned]
+        assert sorted(flat) == sorted(self.BUCKETS)
+
+    def test_duplicate_ids_deduped(self):
+        assert BucketRouter(["a", "b", "a"]).replica_ids == ["a", "b"]
+
+    def test_empty_router_owner_raises(self):
+        with pytest.raises(RuntimeError, match="no replicas"):
+            BucketRouter([]).owner((40, 64))
+
+
+# -- in-process fleet: routing, attribution, warmup ownership -----------
+
+
+class TestFleetSmoke:
+    def test_two_replica_fleet_bit_exact(self, predictor,
+                                         frames_and_refs):
+        from raft_tpu.serving import loadgen
+        frames, refs = frames_and_refs
+        fleet = _fleet(predictor, 2)
+        # Each replica's engine config carries exactly the raw shapes
+        # whose padded buckets the router assigned it.
+        assignment = fleet.router.assignment(
+            [fleet.bucket_for((*s, 3)) for s in FLEET_SHAPES])
+        for rid, eng in fleet.engines.items():
+            owned = {fleet.bucket_for((*s, 3))
+                     for s in eng.config.buckets}
+            assert owned == set(assignment[rid])
+        fleet.start()
+        try:
+            res = loadgen.run_load(fleet, frames, n_requests=16,
+                                   concurrency=4, references=refs,
+                                   timeout=120.0)
+        finally:
+            fleet.close()
+        assert res["ok"], res
+        # Every response attributed to a real replica, none anonymous.
+        assert set(res["per_replica"]) <= set(fleet.replica_ids)
+        assert "unattributed" not in res["per_replica"]
+        snap = fleet.metrics.snapshot()
+        assert snap["fleet_replicas"] == 2.0
+        assert snap["fleet_routed"] == 16.0
+        assert snap["fleet_shed"] == 0.0
+        assert snap["fleet_responses"] == 16.0
+        # Per-replica series exist for every replica.
+        for rid in fleet.replica_ids:
+            assert f"fleet_{rid}_health" in snap
+            assert f"fleet_{rid}_routed" in snap
+
+    def test_future_stamped_with_effective_owner(self, predictor,
+                                                 frames_and_refs):
+        frames, refs = frames_and_refs
+        with _fleet(predictor, 2) as fleet:
+            bucket = fleet.bucket_for(frames[0][0].shape)
+            fut = fleet.submit(*frames[0])
+            flow = fut.result(120)
+            assert np.array_equal(flow, refs[0])
+            assert fut.replica_id == fleet.effective_owner(bucket)
+        assert fleet.health()["state"] == "closed"
+
+    def test_fleet_health_rollup_ready(self, predictor):
+        with _fleet(predictor, 2) as fleet:
+            h = fleet.health()
+            assert h["state"] == "ready" and h["ready"]
+            assert h["routable_replicas"] == 2
+            assert sorted(h["replicas"]) == fleet.replica_ids
+
+    def test_warmup_compiles_each_bucket_exactly_once(self):
+        """Fleet-wide compile accounting on a COLD cache: owners pay
+        one compile per owned bucket, spare warms are pure cache hits
+        through the shared executable cache."""
+        from raft_tpu.evaluate import load_predictor
+        pred = load_predictor("random", small=True, iters=2)
+        fleet = _fleet(pred, 2)
+        fleet.start(warm_spares=True)
+        try:
+            owned_compiles = sum(
+                s["compiles"] for s in fleet.warmup_stats.values())
+            spare_compiles = sum(
+                s["spare_compiles"] for s in fleet.warmup_stats.values())
+            n_buckets = sum(
+                s["buckets"] for s in fleet.warmup_stats.values())
+            assert n_buckets == len(FLEET_SHAPES)
+            assert owned_compiles >= n_buckets   # cold cache compiled
+            assert spare_compiles == 0           # spares were cache hits
+        finally:
+            fleet.close()
+
+
+# -- health-gated failover ----------------------------------------------
+
+
+class TestFleetFailover:
+    def test_killed_replica_fails_over_bit_exact(self, predictor,
+                                                 frames_and_refs):
+        frames, refs = frames_and_refs
+        fleet = _fleet(predictor, 2)
+        fleet.start(warm_spares=True)   # survivor pre-warmed: failover
+        try:                            # costs no first-contact compile
+            bucket = fleet.bucket_for(frames[0][0].shape)
+            victim = fleet.effective_owner(bucket)
+            fleet.kill_replica(victim)
+            # Victim is still health-routable until its breaker trips,
+            # so the first requests exercise the POST-acceptance path:
+            # accepted, dispatch dies, fleet resubmits to the survivor.
+            for i, (im1, im2) in enumerate(frames):
+                fut = fleet.submit(im1, im2)
+                assert np.array_equal(fut.result(120), refs[i])
+                assert fut.replica_id != victim
+            snap = fleet.metrics.snapshot()
+            assert snap["fleet_retries"] >= 1.0    # post-accept failover
+            assert snap["fleet_failovers"] >= 1.0
+            assert snap["fleet_shed"] == 0.0
+            # The victim's own machinery isolated the failures: breaker
+            # OPEN, unroutable, buckets re-balanced to the survivor.
+            assert fleet.engines[victim].health_state() == "open"
+            assert fleet.effective_owner(bucket) != victim
+            h = fleet.health()
+            assert h["state"] == "degraded" and h["ready"]
+            assert h["routable_replicas"] == 1
+            # Revive reinstalls the live predictor (the breaker reopens
+            # routing on its own cooldown schedule).
+            fleet.revive_replica(victim)
+            assert fleet.engines[victim].predictor is not None
+            assert not hasattr(fleet.engines[victim].predictor, "_dead")
+        finally:
+            fleet.close()
+
+    def test_shed_when_no_replica_routable(self, predictor,
+                                           frames_and_refs):
+        from raft_tpu.serving import EngineUnhealthy
+        frames, _ = frames_and_refs
+        fleet = _fleet(predictor, 2)
+        fleet.start()
+        try:
+            for eng in fleet.engines.values():
+                for _ in range(eng.config.breaker_threshold):
+                    eng.breaker.record_failure()
+                assert eng.health_state() == "open"
+            fut = fleet.submit(*frames[0])
+            with pytest.raises(EngineUnhealthy, match="no routable"):
+                fut.result(30)
+            assert fleet.metrics.snapshot()["fleet_shed"] == 1.0
+            h = fleet.health()
+            assert h["state"] == "open" and not h["ready"]
+        finally:
+            fleet.close()
+
+
+# -- rolling hot reload -------------------------------------------------
+
+
+class TestFleetRollingReload:
+    def _setup(self, predictor, frames, tmp_path, **cfg_kw):
+        import jax
+
+        from raft_tpu.serving import FleetReloadConfig, FleetReloader
+        fleet = _fleet(predictor, 2)
+        fleet.start(warm_spares=True)
+        rel = FleetReloader(
+            fleet, str(tmp_path / "ckpts"), canary_frames=[frames[0]],
+            config=FleetReloadConfig(**{"canary_max_epe": None,
+                                        **cfg_kw}))
+        good = jax.tree_util.tree_map(lambda x: x * (1 + 1e-3),
+                                      predictor.variables["params"])
+        return fleet, rel, good
+
+    def _save(self, tmp_path, step, params):
+        from test_serving import _save_params_ckpt
+        _save_params_ckpt(str(tmp_path / "ckpts"), step, params)
+
+    def test_rolling_swap_waves_all_with_zero_compiles(
+            self, predictor, frames_and_refs, tmp_path):
+        from raft_tpu.serving import CompileWatch, loadgen
+        frames, _ = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        refs_new = loadgen.batched_reference_flows(
+            predictor.clone_with_variables(
+                dict(predictor.variables, params=good)),
+            frames, max_batch=4)
+        try:
+            assert rel.poll_once()["action"] == "none"   # empty dir
+            self._save(tmp_path, 3, good)
+            with CompileWatch() as w:
+                act = rel.poll_once()
+            assert act["action"] == "swapped" and act["step"] == 3
+            # Exactly one canary, everyone else waved, nobody skipped,
+            # and the whole roll reused the warmed executables.
+            assert act["canary_replica"] == "r0"
+            assert act["waved"] == ["r1"]
+            assert act["skipped"] == []
+            assert act["wave_compiles"] == 0
+            assert w.compiles == 0
+            assert rel.current_step == 3
+            for eng in fleet.engines.values():
+                assert eng.metrics.swaps == 1
+                assert eng.health()["state"] == "ready"
+            # Every replica now serves the new weights bit-exact (the
+            # submits route to the waved owner, not the canary).
+            for i, (im1, im2) in enumerate(frames):
+                assert np.array_equal(fleet.submit(im1, im2).result(120),
+                                      refs_new[i])
+            assert rel.poll_once()["action"] == "none"   # same step
+        finally:
+            rel.stop()
+            fleet.close()
+
+    def test_wave_drift_rolls_back_whole_fleet(self, predictor,
+                                               frames_and_refs,
+                                               tmp_path):
+        frames, refs = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        # Force the wave re-validation to fail on the waved replica.
+        rel._wave_check = lambda eng, standby: (False, "forced drift")
+        prior = {rid: eng.predictor for rid, eng in fleet.engines.items()}
+        try:
+            self._save(tmp_path, 4, good)
+            act = rel.poll_once()
+            assert act["action"] == "rolled_back" and act["step"] == 4
+            assert "forced drift" in act["reason"]
+            assert act["failed_replica"] == "r1"
+            assert act["canary_replica"] == "r0"
+            # Only already-swapped replicas are restored — the canary.
+            # r1 failed BEFORE swapping, so it never left the old
+            # weights and needs no restore.
+            assert act["restored"] == ["r0"]
+            for rid, eng in fleet.engines.items():
+                assert eng.predictor is prior[rid]   # identity restore
+            assert fleet.engines["r0"].metrics.rollbacks == 1
+            assert fleet.engines["r0"].health()["state"] == "degraded"
+            assert fleet.engines["r1"].metrics.rollbacks == 0
+            assert 4 in rel.pinned_steps
+            assert rel.current_step is None          # never advanced
+            assert rel.poll_once()["action"] == "none"   # pinned
+            # The fleet still serves the OLD model bit-exact.
+            assert np.array_equal(fleet.submit(*frames[0]).result(120),
+                                  refs[0])
+        finally:
+            rel.stop()
+            fleet.close()
+
+    def test_nan_canary_rolls_back_before_any_wave(self, predictor,
+                                                   frames_and_refs,
+                                                   tmp_path):
+        import jax
+        import jax.numpy as jnp
+        frames, refs = frames_and_refs
+        fleet, rel, _ = self._setup(predictor, frames, tmp_path)
+        bad = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan),
+            predictor.variables["params"])
+        try:
+            self._save(tmp_path, 5, bad)
+            act = rel.poll_once()
+            assert act["action"] == "rolled_back" and act["step"] == 5
+            assert "non-finite" in act["reason"]
+            assert act["canary_replica"] == "r0"
+            # The canary gauntlet caught it; the wave never started and
+            # the waved replica never saw the bad weights.
+            assert fleet.engines["r1"].metrics.swaps == 0
+            assert fleet.engines["r1"].metrics.rollbacks == 0
+            assert 5 in rel.pinned_steps
+            assert rel.poll_once()["action"] == "none"   # pinned
+            assert np.array_equal(fleet.submit(*frames[0]).result(120),
+                                  refs[0])
+        finally:
+            rel.stop()
+            fleet.close()
+
+    def test_unroutable_replica_skipped_then_reported(self, predictor,
+                                                      frames_and_refs,
+                                                      tmp_path):
+        frames, _ = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        try:
+            # Trip r1's breaker: OPEN, unroutable — the wave must skip
+            # it rather than swap weights onto a sick replica.
+            eng = fleet.engines["r1"]
+            for _ in range(eng.config.breaker_threshold):
+                eng.breaker.record_failure()
+            assert eng.health_state() == "open"
+            self._save(tmp_path, 6, good)
+            act = rel.poll_once()
+            assert act["action"] == "swapped"
+            assert act["canary_replica"] == "r0"
+            assert act["waved"] == []
+            assert act["skipped"] == ["r1"]
+            assert fleet.engines["r0"].metrics.swaps == 1
+            assert fleet.engines["r1"].metrics.swaps == 0
+        finally:
+            rel.stop()
+            fleet.close()
+
+
+# -- the multi-replica chaos drill, end to end --------------------------
+
+
+@pytest.mark.slow
+def test_fleet_drill_script():
+    """`scripts/serve_drill.py --drill fleet` in a fresh process: kill
+    a replica under 50-client load (0 dropped / 0 bit-incorrect),
+    breaker isolation + router re-balance, then a rolling reload with
+    exactly one canary and zero compiles on the waved replicas, and a
+    fleet rollback on a NaN checkpoint."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "serve_drill.py")
+    proc = subprocess.run([sys.executable, script, "--drill", "fleet"],
+                          capture_output=True, text=True, env=env,
+                          timeout=1800)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

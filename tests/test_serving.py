@@ -1048,3 +1048,135 @@ class TestLoadgenAltReferences:
             _FakeEngine(np.full((4, 4, 2), 7.0, np.float32)), frames, 4,
             concurrency=2, references=primary, alt_references=alt)
         assert not res["ok"] and len(res["mismatched"]) == 4
+
+    def test_per_replica_attribution(self):
+        """Outcomes are attributed to the replica_id stamped on the
+        resolved future; futures without one pool as unattributed."""
+        from concurrent.futures import Future
+
+        from raft_tpu.serving import loadgen
+
+        ref = [np.zeros((4, 4, 2), np.float32)]
+        frames = [(np.zeros((4, 4, 3), np.float32),) * 2]
+
+        class _StampingEngine:
+            def __init__(self):
+                self.metrics = ServingMetrics()
+                self._n = 0
+
+            def submit(self, im1, im2, priority="high"):
+                f = Future()
+                self._n += 1
+                if self._n % 2:
+                    f.replica_id = "rA"
+                    f.set_result(ref[0])
+                else:
+                    f.replica_id = "rB"
+                    f.set_exception(RuntimeError("boom"))
+                return f
+
+        res = loadgen.run_load(_StampingEngine(), frames, 4,
+                               concurrency=1, references=ref)
+        per = res["per_replica"]
+        assert per["rA"]["completed"] == 2 and per["rA"]["dropped"] == 0
+        assert per["rB"]["dropped"] == 2 and per["rB"]["completed"] == 0
+        assert "latency_ms" in per["rA"]
+        assert "unattributed" not in per
+
+
+class TestConcurrentDispatch:
+    """The engine's per-bucket dispatch streams: two buckets dispatch
+    concurrently (no head-of-line blocking across buckets) and the
+    concurrency is invisible to clients — every response stays
+    bit-exact and carries its replica attribution."""
+
+    TWO_BUCKET_SHAPES = [(36, 60), (52, 76)]   # (40, 64) and (56, 80)
+
+    def test_two_buckets_bit_exact_under_concurrency(self, predictor):
+        from raft_tpu.serving import loadgen
+        frames = loadgen.make_frames(self.TWO_BUCKET_SHAPES,
+                                     per_shape=2, seed=17)
+        refs = loadgen.batched_reference_flows(predictor, frames,
+                                               max_batch=4)
+        eng = _engine(predictor, max_batch=4, max_wait_ms=3.0)
+        eng.start()
+        try:
+            res = loadgen.run_load(eng, frames, n_requests=24,
+                                   concurrency=8, references=refs,
+                                   timeout=120.0)
+        finally:
+            eng.close()
+        assert res["ok"], res
+        # One independent dispatch stream materialized per bucket.
+        assert set(eng._streams) == {(40, 64), (56, 80)}
+
+    def test_slow_bucket_does_not_block_other_bucket(self, predictor):
+        """A bucket whose dispatch stalls must not delay another
+        bucket's traffic: streams are per-bucket thread pairs fed by
+        the router, so only the stalled bucket queues behind it."""
+        from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+        frames = loadgen.make_frames(self.TWO_BUCKET_SHAPES,
+                                     per_shape=1, seed=19)
+        # batch-1 references BEFORE the engine starts, so its dispatch
+        # of the same executables is a cache hit (no compile while the
+        # gate is held).
+        refs = loadgen.batched_reference_flows(predictor, frames,
+                                               max_batch=1)
+        gate = threading.Event()
+
+        class _GatedPredictor:
+            """Blocks dispatch for one padded bucket until released."""
+
+            def __init__(self, inner, gate_hw):
+                self._inner = inner
+                self._gate_hw = gate_hw
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def dispatch_batch(self, i1, i2):
+                if tuple(i1.shape[1:3]) == self._gate_hw:
+                    assert gate.wait(60), "gate never released"
+                return self._inner.dispatch_batch(i1, i2)
+
+        eng = ServingEngine(
+            _GatedPredictor(predictor, (56, 80)),
+            ServingConfig(max_batch=1, max_wait_ms=1.0))
+        eng.start(warmup=False)
+        try:
+            slow = eng.submit(*frames[1])      # (52, 76) -> gated bucket
+            fast = eng.submit(*frames[0])      # (36, 60) -> free bucket
+            # The free bucket completes while the gated one is still
+            # stuck in its own stream's dispatch.
+            assert np.array_equal(fast.result(120), refs[0])
+            assert not slow.done()
+            gate.set()
+            assert np.array_equal(slow.result(120), refs[1])
+        finally:
+            gate.set()
+            eng.close()
+
+    def test_replica_id_stamped_on_future(self, predictor,
+                                          frames_and_refs):
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=2.0,
+                      replica_id="rx")
+        eng.start()
+        try:
+            fut = eng.submit(*frames[0])
+            assert np.array_equal(fut.result(120), refs[0])
+            assert fut.replica_id == "rx"
+        finally:
+            eng.close()
+
+    def test_no_replica_id_without_config(self, predictor,
+                                          frames_and_refs):
+        frames, _ = frames_and_refs
+        eng = _engine(predictor, max_batch=4, max_wait_ms=2.0)
+        eng.start()
+        try:
+            fut = eng.submit(*frames[0])
+            fut.result(120)
+            assert getattr(fut, "replica_id", None) is None
+        finally:
+            eng.close()
