@@ -20,7 +20,10 @@ mechanisms behind one ``submit() -> Future`` API:
   head-of-line-blocks small-bucket traffic — both buckets' batches are
   dispatched and synced concurrently. Each stream's bounded in-flight
   queue (``pipeline_depth``) provides per-bucket backpressure so a slow
-  device can't queue unbounded work. With ``donate`` (default on TPU)
+  device can't queue unbounded work, and streams for shapes outside the
+  configured buckets are capped (``max_dynamic_streams``, LRU-retired)
+  so arbitrary-shape traffic can't grow threads without bound. With
+  ``donate`` (default on TPU)
   the input image buffers are donated to the executable, so
   steady-state serving holds one batch of inputs per active bucket,
   not one per pipeline slot.
@@ -124,7 +127,8 @@ class ServingConfig:
         (padded internally — pass what requests will carry, e.g.
         ``(436, 1024)`` for Sintel). Requests outside the configured
         buckets still serve, paying their compile on first contact
-        (counted in ``metrics.compiles``).
+        (counted in ``metrics.compiles``); their dispatch streams are
+        transient, capped by ``max_dynamic_streams``.
       pad_mode: InputPadder mode for every request ("sintel" centers
         vertical padding, "kitti" bottom-pads).
       factor: pad-to multiple (8 for stride-8 RAFT features).
@@ -142,6 +146,15 @@ class ServingConfig:
         *per bucket stream* (2 = classic double buffering: host stacks
         N+1 while device runs N). Buckets pipeline independently — see
         :class:`_BucketStream`.
+      max_dynamic_streams: cap on live dispatch streams for buckets
+        OUTSIDE the configured ``buckets`` set (each stream is a
+        thread pair + a pipeline queue; ``submit`` accepts arbitrary
+        shapes, so without a cap varied traffic would grow threads
+        without bound). Configured buckets keep permanent streams;
+        beyond the cap the least-recently-used dynamic stream is
+        drained (its queued and in-flight work still resolves) and
+        retired — the shape simply gets a fresh stream on its next
+        batch.
       donate: donate input image buffers to the executable. ``None``
         resolves to True on TPU, False elsewhere (CPU/older backends
         warn and ignore donation).
@@ -167,6 +180,7 @@ class ServingConfig:
     max_pending: int = 2048
     queue_timeout_ms: Optional[float] = None
     pipeline_depth: int = 2
+    max_dynamic_streams: int = 8
     donate: Optional[bool] = None
     persistent_cache: object = None
     breaker_threshold: int = 5
@@ -191,13 +205,18 @@ class _BucketStream:
 
     Streams are created lazily by the router (one per padded shape
     that actually sees traffic) and torn down by a ``None`` sentinel
-    on ``work`` when the engine closes.
+    on ``work`` — when the engine closes, or early for shapes outside
+    the configured buckets once ``max_dynamic_streams`` is reached
+    (least-recently-used first; the sentinel drains queued and
+    in-flight work to futures before the threads exit, so retirement
+    never drops a request).
     """
 
     def __init__(self, engine: "ServingEngine",
                  bucket: Tuple[int, int]):
         self.engine = engine
         self.bucket = bucket
+        self.last_used = time.monotonic()
         self.work: queue.Queue = queue.Queue()
         self.inflight: queue.Queue = queue.Queue(
             maxsize=max(engine.config.pipeline_depth, 1))
@@ -317,8 +336,16 @@ class ServingEngine:
         self._inflight_batches = 0
         # bucket -> _BucketStream, created lazily by the router thread
         # (the only writer); _streams_lock guards reads from other
-        # threads (health, close).
+        # threads (health, close). Streams for configured buckets are
+        # permanent; dynamic (out-of-bucket) streams are capped at
+        # max_dynamic_streams, retired LRU-first into _retired where
+        # they drain and exit (joined at close).
         self._streams: Dict[Tuple[int, int], _BucketStream] = {}
+        self._dedicated_buckets = frozenset(
+            InputPadder((*hw, 3), mode=self.config.pad_mode,
+                        factor=self.config.factor).padded_shape
+            for hw in self.config.buckets)
+        self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
         self._started = False
@@ -406,7 +433,11 @@ class ServingEngine:
             self._router.join(timeout)
             with self._streams_lock:
                 streams = list(self._streams.values())
-            for s in streams:
+            # Retired streams already got their sentinel; join them
+            # too so every accepted request resolved before close()
+            # returns. (_retired is only appended by the router
+            # thread, which has exited by now.)
+            for s in streams + self._retired:
                 s.join(timeout)
 
     def __enter__(self) -> "ServingEngine":
@@ -598,13 +629,37 @@ class ServingEngine:
 
     def _stream_for(self, bucket: Tuple[int, int]) -> _BucketStream:
         # Router-thread only: creation is single-threaded, the lock
-        # orders the dict write against concurrent readers.
+        # orders the dict writes against concurrent readers.
         stream = self._streams.get(bucket)
         if stream is None:
+            if bucket not in self._dedicated_buckets:
+                self._retire_idle_streams()
             stream = _BucketStream(self, bucket)
             with self._streams_lock:
                 self._streams[bucket] = stream
+        stream.last_used = time.monotonic()
         return stream
+
+    def _retire_idle_streams(self) -> None:
+        """Make room for one more dynamic stream under the
+        ``max_dynamic_streams`` cap: close the least-recently-used
+        streams of non-configured buckets (their ``None`` sentinel
+        drains queued and in-flight work before the threads exit — no
+        request is dropped) and move them to ``_retired`` for the
+        final join at close. Dedicated (configured-bucket) streams are
+        never retired."""
+        cap = max(1, self.config.max_dynamic_streams)
+        dynamic = [(b, s) for b, s in self._streams.items()
+                   if b not in self._dedicated_buckets]
+        overflow = len(dynamic) - (cap - 1)
+        if overflow <= 0:
+            return
+        dynamic.sort(key=lambda item: item[1].last_used)
+        for b, s in dynamic[:overflow]:
+            s.close()
+            self._retired.append(s)
+            with self._streams_lock:
+                del self._streams[b]
 
     def _route_loop(self) -> None:
         """Pull closed batches off the batcher and hand each to its

@@ -41,10 +41,18 @@ one engine and adds:
 * **Rolling hot reload** — :class:`FleetReloader` canaries a new
   committed checkpoint on **exactly one** replica (the full
   :class:`~raft_tpu.serving.reload.HotReloader` golden-pair gauntlet:
-  finite flow, EPE drift band, zero compiles), then waves the rest;
-  any wave failure (non-finite flow, a fresh compile, a staging error)
-  rolls the **whole fleet** back to the prior weights and pins the
-  step. Canary-rejected steps are pinned fleet-wide, never retried.
+  finite flow, EPE drift band, zero compiles), then waves the rest. A
+  wave *validation* failure (non-finite flow, a fresh compile) rolls
+  the **whole fleet** back to the prior weights and pins the step; a
+  *staging/infrastructure* fault on one replica (torn checkpoint read,
+  a device dying under the stage) skips just that replica instead of
+  pinning a good checkpoint fleet-wide. The reloader tracks the step
+  each replica serves, and the fleet's routing gate excludes any
+  replica whose weights differ from the fleet's — so a straggler
+  (skipped while unroutable, stage-faulted, or revived with stale
+  weights) never *serves* mixed weights; every poll re-stages such
+  stragglers once they are healthy. Canary-rejected steps are pinned
+  fleet-wide, never retried.
 * **Fleet-aggregated metrics** — :class:`FleetMetrics` pools the raw
   latency windows across replicas (fleet p50/p95/p99 over samples, not
   averaged percentiles), counts routed / failed-over / retried / shed
@@ -78,6 +86,10 @@ from raft_tpu.utils.padder import InputPadder
 logger = logging.getLogger(__name__)
 
 Bucket = Tuple[int, int]
+
+# Degradation reason an engine carries while it serves weights older
+# than the fleet's adopted step (it takes no traffic until re-synced).
+OUT_OF_SYNC = "out-of-sync"
 
 
 # -- consistent bucket routing ------------------------------------------
@@ -347,6 +359,9 @@ class ServingFleet:
         self.metrics = FleetMetrics(lambda: self._engines)
         self.warmup_stats: Dict[str, Dict[str, float]] = {}
         self._killed: Dict[str, object] = {}   # rid -> live predictor
+        # Attached by FleetReloader: adds the weight-sync gate to
+        # routing (replicas serving a stale step take no traffic).
+        self._reloader: Optional["FleetReloader"] = None
         self._closed = False
 
     # -- lifecycle -----------------------------------------------------
@@ -432,12 +447,26 @@ class ServingFleet:
                     buckets.append(b)
         return self.router.assignment(buckets)
 
+    def _routable(self, replica_id: str) -> bool:
+        """Health-routable AND weight-synced. A replica left behind by
+        a rolling reload (unroutable during the wave, a transient
+        stage fault, revived with its pre-kill predictor) passes the
+        health gate but still serves the OLD checkpoint — routing to
+        it would silently break the fleet's bit-interchangeability
+        contract. The attached reloader's sync gate keeps it out of
+        rotation until re-synced; without a reloader every healthy
+        replica is in sync by construction."""
+        if not is_routable(self._engines[replica_id].health_state()):
+            return False
+        reloader = self._reloader
+        return reloader is None or reloader.replica_in_sync(replica_id)
+
     def effective_owner(self, bucket: Bucket) -> Optional[str]:
         """The replica currently serving ``bucket``: the first owner in
-        HRW preference order whose health gate passes. ``None`` when no
-        replica is routable (the fleet would shed)."""
+        HRW preference order whose health and weight-sync gates pass.
+        ``None`` when no replica is routable (the fleet would shed)."""
         for rid in self.router.owners(bucket):
-            if is_routable(self._engines[rid].health_state()):
+            if self._routable(rid):
                 return rid
         return None
 
@@ -501,9 +530,9 @@ class ServingFleet:
         for rid in owners:
             if rid in tried:
                 continue
-            engine = self._engines[rid]
-            if not is_routable(engine.health_state()):
+            if not self._routable(rid):
                 continue
+            engine = self._engines[rid]
             try:
                 inner = engine.submit(image1, image2, priority=priority)
             except Exception as e:
@@ -563,12 +592,22 @@ class ServingFleet:
 
     def revive_replica(self, replica_id: str) -> None:
         """Undo :meth:`kill_replica`: reinstall the live predictor and
-        let the breaker close on its next successful probe."""
+        let the breaker close on its next successful probe. If a
+        rolling reload advanced the fleet while the replica was dead,
+        the captured predictor carries stale pre-kill weights — the
+        attached reloader re-stages the fleet's current step here;
+        until that lands (now, or on a later reloader poll if the
+        re-stage faults) the sync gate keeps the replica out of
+        routing, so revival can never put mixed weights back into
+        rotation."""
         engine = self._engines[replica_id]
         predictor = self._killed.pop(replica_id, None)
         if predictor is None:
             return
         engine._install_predictor(predictor)
+        reloader = self._reloader
+        if reloader is not None:
+            reloader.resync_replica(replica_id)
 
 
 def make_fleet(predictor, n_replicas: int,
@@ -639,13 +678,24 @@ class FleetReloader:
        through the serving-shaped batch; a cheaper re-validation — the
        canary already did the full gauntlet on identical weights) plus
        the ``max_wave_compiles`` gate, then swaps atomically. Replicas
-       that are unroutable (killed, breaker OPEN) are skipped and
-       reported; they re-sync on a later poll once healthy.
-    3. **Rollback** — if any wave step fails, every already-swapped
-       replica (canary included) gets its prior predictor reinstalled
-       (quietly — no extra swap tick), the step is pinned fleet-wide,
-       and each restored replica records a rollback (degraded, for the
-       operator). The fleet is never left serving mixed weights.
+       that are unroutable (killed, breaker OPEN) are skipped; a
+       replica whose *staging* faults (torn checkpoint read, device
+       dying under the stage — an infrastructure problem, not a bad
+       checkpoint) is likewise left behind rather than vetoing the
+       step. Both are reported (``skipped`` / ``wave_failed``) and
+       marked ``out-of-sync``.
+    3. **Rollback** — if any wave step fails *validation*, every
+       already-swapped replica (canary included) gets its prior
+       predictor reinstalled (quietly — no extra swap tick), the step
+       is pinned fleet-wide, and each restored replica records a
+       rollback (degraded, for the operator).
+    4. **Re-sync** — ``replica_steps`` records the step each replica
+       serves; :meth:`~ServingFleet._routable` excludes any replica
+       whose step differs from the fleet's, so a straggler never
+       serves stale weights. On every poll with nothing new to roll
+       out, routable stragglers are re-staged onto ``current_step``
+       (action ``resynced``) — no pinning, no fleet rollback: the
+       step is already canary-validated and serving.
 
     Pinning and ``current_step`` live here (fleet-level) and are shared
     into the per-poll canary reloader, so one bad export is rejected
@@ -669,8 +719,18 @@ class FleetReloader:
         self._ckptr = checkpointer
         self.current_step: Optional[int] = None
         self.pinned_steps: set = set()
+        # Step each replica currently serves (missing/None = the
+        # pre-reload baseline weights). The fleet's routing gate keys
+        # on this via replica_in_sync: a replica behind the fleet's
+        # step takes no traffic until re-synced.
+        self.replica_steps: Dict[str, Optional[int]] = {}
+        # Set while a wave is rolling: the target step, which the
+        # already-swapped canary validly serves before current_step
+        # advances (keeps the canary routable mid-wave).
+        self._wave_step: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        fleet._reloader = self
 
     # -- the rolling cycle ---------------------------------------------
 
@@ -707,61 +767,171 @@ class FleetReloader:
             return False, "non-finite flow from waved standby"
         return True, "ok"
 
+    def _stage_standby(self, eng, step: int):
+        """Stage + re-validate one replica's standby for ``step``.
+
+        Returns ``(standby, reason, compiles, infra)``; ``standby`` is
+        ``None`` on failure. ``infra`` distinguishes staging/device
+        *exceptions* (a torn checkpoint read, a device dying under the
+        stage — transient, retry this replica on a later poll) from
+        validation *verdicts* (non-finite flow, compile budget — the
+        step itself is bad and the caller rolls back + pins)."""
+        infra = False
+        standby = None
+        with CompileWatch() as watch:
+            try:
+                variables = load_step_variables(
+                    self.ckpt_dir, step, eng.predictor.variables)
+                candidate = eng.predictor.clone_with_variables(
+                    variables)
+                ok, reason = self._wave_check(eng, candidate)
+            except Exception as e:
+                ok, infra = False, True
+                reason = f"wave stage raised {type(e).__name__}: {e}"
+        if ok and watch.compiles > self.config.max_wave_compiles:
+            ok = False
+            reason = (f"wave triggered {watch.compiles} fresh "
+                      f"compile(s) (max "
+                      f"{self.config.max_wave_compiles}) — standby "
+                      "does not share the warmed executables")
+        if ok:
+            standby = candidate
+        return standby, reason, watch.compiles, infra
+
+    def replica_in_sync(self, replica_id: str) -> bool:
+        """Whether ``replica_id`` serves the fleet's adopted weights
+        (or the in-flight wave's target step — the already-swapped
+        canary validly serves the new step while the wave is still
+        rolling). The fleet's routing gate: an out-of-sync replica
+        takes no traffic, so a straggler can never hand back a
+        different bit-pattern than the rest of the fleet."""
+        served = self.replica_steps.get(replica_id)
+        if served == self.current_step:
+            return True
+        wave = self._wave_step
+        return wave is not None and served == wave
+
+    def resync_replica(self, replica_id: str) -> bool:
+        """Re-stage the fleet's ``current_step`` onto one replica that
+        missed a wave (unroutable then, a staging fault, or revived
+        with pre-kill weights). Failure never pins or rolls back — the
+        step is already canary-validated and serving fleet-wide; the
+        replica just stays out of routing until a later attempt lands.
+        Returns True when the replica now serves ``current_step``."""
+        step = self.current_step
+        if step is None or self.replica_steps.get(replica_id) == step:
+            return True
+        eng = self.fleet.engines[replica_id]
+        standby, reason, _, _ = self._stage_standby(eng, step)
+        if standby is None:
+            logger.warning(
+                "re-sync of replica %s to step %d failed: %s (replica "
+                "stays out of routing)", replica_id, step, reason)
+            eng.set_degraded(OUT_OF_SYNC)
+            return False
+        eng.swap_predictor(standby)
+        eng.clear_degraded(OUT_OF_SYNC)
+        self.replica_steps[replica_id] = step
+        logger.info("replica %s re-synced to fleet step %d",
+                    replica_id, step)
+        return True
+
+    def _resync_stale(self) -> Optional[Dict[str, object]]:
+        """Sweep for routable replicas serving a step other than the
+        fleet's and re-stage them. Returns an action record only when
+        at least one replica actually re-synced (``None`` otherwise,
+        so the poll reports ``none``)."""
+        step = self.current_step
+        if step is None:
+            return None
+        resynced = [
+            rid for rid, eng in self.fleet.engines.items()
+            if self.replica_steps.get(rid) != step
+            and is_routable(eng.health_state())
+            and self.resync_replica(rid)]
+        if not resynced:
+            return None
+        out_of_sync = [rid for rid in self.fleet.engines
+                       if self.replica_steps.get(rid) != step]
+        logger.info("re-synced %s to fleet step %d (still behind: %s)",
+                    resynced, step, out_of_sync or "none")
+        return {"action": "resynced", "step": step,
+                "resynced": resynced, "out_of_sync": out_of_sync}
+
     def poll_once(self) -> Dict[str, object]:
         """One rolling-reload cycle. Returns an action record::
 
             {"action": "none"}
             {"action": "swapped", "step": s, "epe": e,
              "canary_replica": rid, "waved": [...], "skipped": [...],
-             "wave_compiles": n}
+             "wave_failed": [...], "wave_compiles": n}
             {"action": "rolled_back", "step": s, "reason": r, ...}
+            {"action": "resynced", "step": s, "resynced": [...],
+             "out_of_sync": [...]}
         """
         engines = self.fleet.engines
-        canary_rid = next(
-            (rid for rid, eng in engines.items()
-             if is_routable(eng.health_state())), None)
-        if canary_rid is None:
+        routable = [rid for rid, eng in engines.items()
+                    if is_routable(eng.health_state())]
+        if not routable:
             return {"action": "none", "reason": "no routable replica"}
-        # Prior predictors, captured before anything swaps: the fleet
-        # rollback target.
+        in_sync = [rid for rid in routable if self.replica_in_sync(rid)]
+        if not in_sync:
+            # Every routable replica is behind the fleet's step:
+            # re-sync before judging any new step (a stale canary
+            # baseline would corrupt the EPE drift band).
+            return (self._resync_stale()
+                    or {"action": "none",
+                        "reason": "no in-sync routable replica"})
+        canary_rid = in_sync[0]
+        # Prior predictors and served steps, captured before anything
+        # swaps: the fleet rollback target.
         prior = {rid: eng.predictor for rid, eng in engines.items()}
+        prior_steps = dict(self.replica_steps)
         hr = self._canary_reloader(engines[canary_rid])
         act = dict(hr.poll_once())
         if act["action"] != "swapped":
             if act["action"] == "rolled_back":
                 act["canary_replica"] = canary_rid
-            return act
+                return act
+            # Nothing new to roll out: bring stragglers from earlier
+            # waves (skipped, stage-faulted, or revived replicas) back
+            # onto the fleet's step.
+            return self._resync_stale() or act
         step = int(act["step"])
+        self._wave_step = step   # the swapped canary serves it validly
+        self.replica_steps[canary_rid] = step
         waved: List[str] = []
         skipped: List[str] = []
-        with CompileWatch() as watch:
+        failed: List[str] = []
+        wave_compiles = 0
+        try:
             for rid, eng in engines.items():
                 if rid == canary_rid:
                     continue
                 if not is_routable(eng.health_state()):
                     skipped.append(rid)
                     continue
-                c0 = watch.so_far
-                try:
-                    variables = load_step_variables(
-                        self.ckpt_dir, step, eng.predictor.variables)
-                    standby = eng.predictor.clone_with_variables(
-                        variables)
-                    ok, reason = self._wave_check(eng, standby)
-                except Exception as e:
-                    ok, reason = False, (f"wave stage raised "
-                                         f"{type(e).__name__}: {e}")
-                compiles = watch.so_far - c0
-                if ok and compiles > self.config.max_wave_compiles:
-                    ok = False
-                    reason = (f"wave triggered {compiles} fresh "
-                              f"compile(s) on {rid} (max "
-                              f"{self.config.max_wave_compiles}) — "
-                              "standby does not share the warmed "
-                              "executables")
-                if not ok:
+                standby, reason, compiles, infra = self._stage_standby(
+                    eng, step)
+                wave_compiles += compiles
+                if standby is None and infra:
+                    # A staging/infrastructure fault on ONE replica
+                    # must not pin a canary-validated step fleet-wide:
+                    # leave the replica on its old weights — the sync
+                    # gate keeps it out of routing — and re-sync it on
+                    # a later poll.
+                    failed.append(rid)
+                    logger.warning(
+                        "wave stage of step %d failed on replica %s "
+                        "(%s); replica left behind, will re-sync on a "
+                        "later poll", step, rid, reason)
+                    continue
+                if standby is None:
+                    # The step itself failed validation on this
+                    # replica: whole-fleet rollback, pin.
                     restored = self._rollback_fleet(
-                        prior, [canary_rid, *waved], step, reason)
+                        prior, prior_steps, [canary_rid, *waved],
+                        step, reason)
                     logger.warning(
                         "rolling reload of step %d rolled back on "
                         "replica %s: %s (restored %s)", step, rid,
@@ -771,30 +941,43 @@ class FleetReloader:
                             "canary_replica": canary_rid,
                             "restored": restored}
                 eng.swap_predictor(standby)
+                self.replica_steps[rid] = step
                 waved.append(rid)
-        self.current_step = step
+            self.current_step = step
+        finally:
+            self._wave_step = None
+        for rid in (canary_rid, *waved):
+            engines[rid].clear_degraded(OUT_OF_SYNC)
+        for rid in (*skipped, *failed):
+            engines[rid].set_degraded(OUT_OF_SYNC)
         logger.info(
             "rolling reload: fleet now serving step %d (canary %s, "
-            "waved %s, skipped %s, %d wave compiles)", step, canary_rid,
-            waved, skipped, watch.compiles)
+            "waved %s, skipped %s, stage-failed %s, %d wave compiles)",
+            step, canary_rid, waved, skipped, failed, wave_compiles)
         act.update({"canary_replica": canary_rid, "waved": waved,
-                    "skipped": skipped, "wave_compiles": watch.compiles})
+                    "skipped": skipped, "wave_failed": failed,
+                    "wave_compiles": wave_compiles})
         return act
 
-    def _rollback_fleet(self, prior, swapped_rids: List[str], step: int,
+    def _rollback_fleet(self, prior, prior_steps,
+                        swapped_rids: List[str], step: int,
                         reason: str) -> List[str]:
         """Restore every already-swapped replica's prior predictor
         (quiet install — the canary's swap already ticked ``swaps``;
         the restore must not tick another), pin the step fleet-wide,
-        and record a rollback on each restored replica.
-        ``current_step`` stays at the pre-poll value (it is only
-        advanced after a fully successful wave)."""
+        and record a rollback on each restored replica. Only reached
+        on *validation* failures — infrastructure faults skip the
+        replica instead (see :meth:`poll_once`). ``current_step`` and
+        the restored replicas' ``replica_steps`` revert to their
+        pre-poll values (the step is only adopted after a fully
+        successful wave)."""
         self.pinned_steps.add(step)
         restored = []
         for rid in swapped_rids:
             eng = self.fleet.engines[rid]
             eng._install_predictor(prior[rid])
             eng.record_rollback(reason)
+            self.replica_steps[rid] = prior_steps.get(rid)
             restored.append(rid)
         return restored
 

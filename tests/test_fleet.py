@@ -420,6 +420,141 @@ class TestFleetRollingReload:
             rel.stop()
             fleet.close()
 
+    def test_skipped_replica_resyncs_once_routable(self, predictor,
+                                                   frames_and_refs,
+                                                   tmp_path):
+        """A replica skipped during a wave (breaker OPEN) must not
+        serve the old checkpoint when it recovers: the sync gate keeps
+        it out of routing, and the next poll re-stages the fleet's
+        current step onto it (no new checkpoint required)."""
+        from raft_tpu.serving import loadgen
+        frames, _ = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        refs_new = loadgen.batched_reference_flows(
+            predictor.clone_with_variables(
+                dict(predictor.variables, params=good)),
+            frames, max_batch=4)
+        try:
+            eng = fleet.engines["r1"]
+            for _ in range(eng.config.breaker_threshold):
+                eng.breaker.record_failure()
+            self._save(tmp_path, 3, good)
+            act = rel.poll_once()
+            assert act["action"] == "swapped"
+            assert act["skipped"] == ["r1"]
+            assert rel.current_step == 3
+            # r1 still carries the old weights, so the routing gate
+            # must exclude it even for buckets it owns.
+            assert not rel.replica_in_sync("r1")
+            for s in FLEET_SHAPES:
+                bucket = fleet.bucket_for((*s, 3))
+                assert fleet.effective_owner(bucket) == "r0"
+            # Same step, straggler still unroutable: nothing to do.
+            assert rel.poll_once()["action"] == "none"
+            # r1 heals; the next poll re-syncs it to step 3.
+            eng.breaker.record_success()
+            act = rel.poll_once()
+            assert act["action"] == "resynced" and act["step"] == 3
+            assert act["resynced"] == ["r1"]
+            assert act["out_of_sync"] == []
+            assert rel.replica_in_sync("r1")
+            assert eng.metrics.swaps == 1
+            assert eng.health_state() == "ready"   # out-of-sync cleared
+            # The whole fleet (r1 included) now serves the new weights
+            # bit-exact.
+            for i, (im1, im2) in enumerate(frames):
+                assert np.array_equal(
+                    fleet.submit(im1, im2).result(120), refs_new[i])
+            assert rel.poll_once()["action"] == "none"
+        finally:
+            rel.stop()
+            fleet.close()
+
+    def test_wave_infra_fault_skips_replica_without_pinning(
+            self, predictor, frames_and_refs, tmp_path):
+        """A transient staging fault (exception, not a validation
+        verdict) on one waved replica must not pin the
+        canary-validated step fleet-wide: the fleet adopts the step,
+        the faulted replica is left behind out of routing, and the
+        next poll re-syncs it."""
+        frames, _ = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        real_check = rel._wave_check
+        calls = {"n": 0}
+
+        def flaky_check(eng, standby):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient checkpoint read hiccup")
+            return real_check(eng, standby)
+
+        rel._wave_check = flaky_check
+        try:
+            self._save(tmp_path, 7, good)
+            act = rel.poll_once()
+            assert act["action"] == "swapped" and act["step"] == 7
+            assert act["waved"] == []
+            assert act["wave_failed"] == ["r1"]
+            assert 7 not in rel.pinned_steps     # good step NOT pinned
+            assert rel.current_step == 7
+            # r1 kept the old weights: health-routable (degraded, for
+            # the operator) but excluded by the sync gate.
+            assert fleet.engines["r1"].health_state() == "degraded"
+            assert not rel.replica_in_sync("r1")
+            assert fleet.engines["r1"].metrics.rollbacks == 0
+            assert fleet.engines["r0"].metrics.swaps == 1
+            for s in FLEET_SHAPES:
+                bucket = fleet.bucket_for((*s, 3))
+                assert fleet.effective_owner(bucket) == "r0"
+            # The hiccup clears; the next poll retries just r1.
+            act = rel.poll_once()
+            assert act["action"] == "resynced"
+            assert act["resynced"] == ["r1"]
+            assert rel.replica_in_sync("r1")
+            assert fleet.engines["r1"].metrics.swaps == 1
+            assert fleet.engines["r1"].health_state() == "ready"
+        finally:
+            rel.stop()
+            fleet.close()
+
+    def test_revive_after_reload_restages_current_step(
+            self, predictor, frames_and_refs, tmp_path):
+        """revive_replica must not put pre-kill weights back into
+        rotation after the fleet rolled forward: revival re-stages the
+        fleet's current step through the attached reloader before the
+        replica can take traffic."""
+        from raft_tpu.serving import loadgen
+        frames, _ = frames_and_refs
+        fleet, rel, good = self._setup(predictor, frames, tmp_path)
+        refs_new = loadgen.batched_reference_flows(
+            predictor.clone_with_variables(
+                dict(predictor.variables, params=good)),
+            frames, max_batch=4)
+        try:
+            victim = "r1"
+            eng = fleet.engines[victim]
+            fleet.kill_replica(victim)
+            for _ in range(eng.config.breaker_threshold):
+                eng.breaker.record_failure()     # unroutable, as live
+            self._save(tmp_path, 8, good)
+            act = rel.poll_once()
+            assert act["action"] == "swapped"
+            assert act["skipped"] == [victim]
+            # Revive: the captured pre-kill predictor is stale; the
+            # reloader re-stages step 8 before routing can reach it.
+            fleet.revive_replica(victim)
+            assert rel.replica_steps[victim] == 8
+            assert rel.replica_in_sync(victim)
+            assert eng.metrics.swaps == 1
+            eng.breaker.record_success()         # close the breaker
+            assert eng.health_state() == "ready"
+            for i, (im1, im2) in enumerate(frames):
+                assert np.array_equal(
+                    fleet.submit(im1, im2).result(120), refs_new[i])
+        finally:
+            rel.stop()
+            fleet.close()
+
 
 # -- the multi-replica chaos drill, end to end --------------------------
 

@@ -1156,6 +1156,36 @@ class TestConcurrentDispatch:
             gate.set()
             eng.close()
 
+    def test_dynamic_streams_capped_and_lru_retired(self, predictor):
+        """Arbitrary out-of-bucket shapes must not grow dispatch
+        threads without bound: dynamic streams are capped at
+        ``max_dynamic_streams`` with LRU-idle retirement, while
+        configured-bucket streams are permanent. Retirement drains the
+        stream's queue first, so no request is ever dropped."""
+        from raft_tpu.serving import loadgen
+        shapes = [(36, 60), (20, 28), (24, 36), (28, 44)]
+        frames = loadgen.make_frames(shapes, per_shape=1, seed=23)
+        refs = loadgen.batched_reference_flows(predictor, frames,
+                                               max_batch=1)
+        eng = _engine(predictor, max_batch=1, max_wait_ms=1.0,
+                      buckets=((36, 60),), max_dynamic_streams=2)
+        eng.start(warmup=False)
+        try:
+            for i, (im1, im2) in enumerate(frames):
+                assert np.array_equal(
+                    eng.submit(im1, im2).result(120), refs[i])
+                # The dedicated bucket never retires; dynamic streams
+                # stay within the cap at every step.
+                assert (40, 64) in eng._streams
+                dynamic = [b for b in eng._streams if b != (40, 64)]
+                assert len(dynamic) <= 2
+            assert len(eng._streams) <= 3
+            # Three distinct dynamic buckets saw traffic, so at least
+            # one stream was LRU-retired along the way.
+            assert len(eng._retired) >= 1
+        finally:
+            eng.close()
+
     def test_replica_id_stamped_on_future(self, predictor,
                                           frames_and_refs):
         frames, refs = frames_and_refs
