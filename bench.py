@@ -74,9 +74,23 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 METRIC = "sintel_image_pairs_per_sec_per_chip_iters12"
 UNIT = "image-pairs/sec"
 BASELINE_PAIRS_PER_SEC = 10.0   # PyTorch ref, 1xV100 (see module docstring)
-H, W = 440, 1024                # Sintel 436x1024 after pad-to-/8
-ITERS = 12
-BATCH = 24                      # materialized-arm knee (round-2 sweep:
+
+
+def _env_dim(name: str, default: int) -> int:
+    """Operating-point override for explicitly-requested CPU smoke
+    artifact captures (round 6: BENCH JSON regenerated on a CPU host at
+    a smoke point with honest labels). Any override flips the payload's
+    ``smoke_operating_point`` flag so a shrunken run can never be
+    mistaken for the TPU trajectory."""
+    raw = os.environ.get(name)
+    return int(raw) if raw else default
+
+
+H = _env_dim("RAFT_BENCH_H", 440)     # Sintel 436x1024 after pad-to-/8
+W = _env_dim("RAFT_BENCH_W", 1024)
+ITERS = _env_dim("RAFT_BENCH_ITERS", 12)
+BATCH = _env_dim("RAFT_BENCH_BATCH", 24)
+                                # materialized-arm knee (round-2 sweep:
                                 # its bf16 volume pyramid OOMs at b64)
 # Banded-arm operating point: the on-demand kernel stores no volume, so
 # its knee sits far higher. Round-4 sweep: 82.7 @ b24, 90.7 @ b64, 93.7
@@ -84,9 +98,12 @@ BATCH = 24                      # materialized-arm knee (round-2 sweep:
 # output store (batch_knee_probe, same day): 94.4 @ b64, 92.8 @ b96,
 # **98.7 @ b128** — the tout win compounds with batch, so the headline
 # arm moved to b128.
-ALT_BATCH = 128
+ALT_BATCH = _env_dim("RAFT_BENCH_ALT_BATCH", 128)
 WARMUP = 2
-REPS = 10
+REPS = _env_dim("RAFT_BENCH_REPS", 10)
+_SMOKE_POINT = any(os.environ.get(k) for k in (
+    "RAFT_BENCH_H", "RAFT_BENCH_W", "RAFT_BENCH_ITERS",
+    "RAFT_BENCH_BATCH", "RAFT_BENCH_ALT_BATCH", "RAFT_BENCH_REPS"))
 # sparse-family secondary metric: the fork's active training resolution
 # (reference train_standard.sh:6: 352x480)
 SPARSE_H, SPARSE_W, SPARSE_BATCH = 352, 480, 8
@@ -345,7 +362,7 @@ def _wait_for_backend(watchdog: _Watchdog) -> bool:
     return dev.platform == "cpu" and cpu_explicit
 
 
-def main():
+def main(gru: str = "ab"):
     watchdog = _Watchdog()
     cpu_smoke = _wait_for_backend(watchdog)
     if cpu_smoke:
@@ -412,13 +429,24 @@ def main():
         "value_all_pairs": round(pairs_per_sec, 3),
         "headline_engine": "all_pairs",
         "init_attempt_count": len(_INIT_ATTEMPTS),
+        # GRU-cell dispatch the headline ran under (RAFT_GRU_PALLAS,
+        # trace-time; 'auto' = fused Pallas kernel on TPU when eligible)
+        "gru": os.environ.get("RAFT_GRU_PALLAS") or "auto",
+        "resolution": f"{H}x{W}",
+        "iters": ITERS,
+        "reps": REPS,
     }
+    if _SMOKE_POINT:
+        # env-shrunken operating point (CPU artifact capture): mark it so
+        # this line is never read as the TPU trajectory
+        payload["smoke_operating_point"] = True
     # From here on a watchdog fire publishes the headline numbers.
     # Snapshot (never alias) — the watchdog thread reads _HEADLINE while
     # main keeps mutating payload with secondary-metric keys, and
     # dict()-copying a dict being resized concurrently raises.
     _HEADLINE = dict(payload)
     headline_fwd = fwd
+    headline_model = model
     if platform != "cpu":
         # On-demand banded-correlation arm (identical numerics, asserted
         # by tests): per iteration it touches only each query tile's
@@ -447,6 +475,7 @@ def main():
 
         if run_with_band_retry(alternate_arm, payload, "alternate"):
             headline_fwd, alt_rate = alt_jit[-1]
+            headline_model = modela
             payload["value"] = round(alt_rate, 3)
             payload["vs_baseline"] = round(
                 alt_rate / BASELINE_PAIRS_PER_SEC, 3)
@@ -473,6 +502,36 @@ def main():
     except Exception as e:
         payload["batch1_error"] = f"{type(e).__name__}: {e}"
     _HEADLINE = dict(payload)
+
+    if gru == "ab":
+        # GRU A/B arm (round 6, knee-provenance discipline like the
+        # banded-vs-all-pairs arms): re-trace the headline engine with
+        # the fused Pallas GRU cell forced ON ('1') and OFF ('0') and
+        # record both readings. Trace-time env flag, so each arm builds
+        # a fresh jit; the surrounding env is restored afterwards so the
+        # remaining sections run the headline's own dispatch. On CPU the
+        # forced-pallas arm runs the kernel under the Pallas interpreter
+        # — a parity tool, not a fast path — so a pallas<xla reading on
+        # a cpu-labelled artifact is expected and honest.
+        gru_prev = os.environ.get("RAFT_GRU_PALLAS")
+        for gmode, env_val in (("pallas", "1"), ("xla", "0")):
+            os.environ["RAFT_GRU_PALLAS"] = env_val
+            try:
+                def fwdg(i1, i2, m=headline_model):
+                    flow_up = m.apply(variables, i1, i2,
+                                      test_mode=True)[1]
+                    return flow_up, jnp.sum(flow_up)
+
+                payload[f"value_gru_{gmode}"] = round(
+                    throughput(payload["batch"], jax.jit(fwdg)), 3)
+            except Exception as e:   # the sibling arm must survive
+                payload[f"gru_{gmode}_error"] = f"{type(e).__name__}: {e}"
+        if gru_prev is None:
+            os.environ.pop("RAFT_GRU_PALLAS", None)
+        else:
+            os.environ["RAFT_GRU_PALLAS"] = gru_prev
+        _HEADLINE = dict(payload)
+
     if platform == "cpu":
         # full-size secondaries on CPU take hours; they are TPU
         # measurements, not part of the CPU smoke contract
@@ -741,7 +800,20 @@ if __name__ == "__main__":
             _serving_failure(f"{type(e).__name__}: {e}")
         sys.exit(0)
     try:
-        main()
+        ap = argparse.ArgumentParser(prog="bench.py")
+        ap.add_argument("--gru", choices=("ab", "pallas", "xla"),
+                        default="ab",
+                        help="GRU-cell arm: 'ab' (default) measures the "
+                             "headline under the ambient RAFT_GRU_PALLAS "
+                             "and adds a forced pallas-vs-xla A/B pass; "
+                             "'pallas'/'xla' force one dispatch for the "
+                             "whole run (recorded in the payload)")
+        args = ap.parse_args()
+        if args.gru == "pallas":
+            os.environ["RAFT_GRU_PALLAS"] = "1"
+        elif args.gru == "xla":
+            os.environ["RAFT_GRU_PALLAS"] = "0"
+        main(gru=args.gru)
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — artifact must parse

@@ -55,6 +55,16 @@ class FlowPredictor:
         ineligible shapes. Both engines share the same parameters;
         numerics agree to float accumulation order (golden-parity
         tested).
+
+    The SepConvGRU dispatch inside the scan body is a trace-time env
+    flag, not a constructor knob: ``RAFT_GRU_PALLAS`` (auto = fused
+    Pallas cell on TPU when eligible; see ``ops/gru_pallas.py``) is read
+    when each per-shape executable is traced, and the resolved mode is
+    recorded on the predictor as ``gru_impl`` at construction — both for
+    observability and so a misspelled value fails at predictor build
+    time, before the serving engine warms buckets against it. Flipping
+    the env var after warmup would retrace (a compile the serving
+    zero-compile contract forbids); set it before construction.
     """
 
     def __init__(self, model, variables, iters: int = 32,
@@ -88,6 +98,12 @@ class FlowPredictor:
                                         corr_dtype="auto")))
         self.variables = variables
         self.iters = iters
+        # Resolved RAFT_GRU_PALLAS mode ('auto'/'0'/'1') — validated here
+        # so bad values fail at build time, recorded for observability
+        # (bench/serving annotate payloads with it). The actual dispatch
+        # happens at trace time inside SepConvGRU.__call__.
+        from raft_tpu.ops.gru_pallas import resolve_mode
+        self.gru_impl = resolve_mode()
         # Optional sequence(spatial)-parallel execution: with a mesh the
         # forward runs through parallel.spatial.spatial_jit — image rows
         # sharded over the mesh's spatial axis, each device holding 1/d

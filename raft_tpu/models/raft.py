@@ -10,7 +10,17 @@ delta, which is a training-dynamics property, not an optimization.
 
 TPU mapping: fnet/cnet and the all-pairs correlation pyramid are the
 scan-invariant prologue (MXU matmuls), the scan body is the ConvGRU update;
-everything is static-shaped, so XLA compiles one fused program.
+everything is static-shaped, so XLA compiles one fused program. Inside the
+scan body the two per-iteration hot paths have Pallas kernels behind
+trace-time env flags: the correlation lookup (``RAFT_CORR_BACKEND``,
+``ops/corr_pallas.py``) and — for the non-small model — the SepConvGRU
+cell (``RAFT_GRU_PALLAS``, ``ops/gru_pallas.py``), which fuses both GRU
+steps into one launch so gate activations never round-trip HBM. Both
+flags are read when the scan body is traced, so a jitted executable bakes
+one dispatch for all iterations (the serving warmup contract depends on
+this — see ``serving/engine.py``); the hidden-state carry crosses the
+kernel boundary in its own layout and dtype (``ops/layout.py``
+invariant 4), keeping the scan free of per-iteration relayout copies.
 """
 
 from __future__ import annotations

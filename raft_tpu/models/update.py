@@ -15,6 +15,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.ops import gru_pallas
+
 # Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
 # (reference core/update.py:121, core/raft.py:74-85).
 UPSAMPLE_MASK_CHANNELS = 9 * 8 * 8
@@ -145,7 +147,31 @@ class SepConvGRU(nn.Module):
         q = nn.tanh(convq(jnp.concatenate([r * h, x], axis=-1)))
         return (1 - z) * h + z * q
 
+    def _packed_weights(self):
+        def pair(conv):
+            p = conv.variables["params"]
+            return (p["kernel"], p["bias"])
+
+        return gru_pallas.pack_weights(
+            (pair(self.convz1), pair(self.convr1), pair(self.convq1)),
+            (pair(self.convz2), pair(self.convr2), pair(self.convq2)),
+            self.hidden_dim)
+
     def __call__(self, h, x):
+        # Fused-cell dispatch (RAFT_GRU_PALLAS, trace-time): both GRU
+        # steps — six gate convs as shifted MXU matmuls, sigmoid/tanh/
+        # blend on the VPU — in one Pallas launch, so gate activations
+        # and the intermediate hidden state never round-trip HBM inside
+        # the refinement scan. auto = TPU only; '1' forces (interpret
+        # mode off-TPU, the CPU parity tests); '0' restores the conv
+        # path below bit-for-bit. The fused path computes the blends in
+        # the module's compute dtype (the carry's dtype in practice);
+        # params are read in place, so the torch-weight mapping and
+        # training gradients are unaffected.
+        if not self.is_initializing() and gru_pallas.should_fuse(
+                h, x, self.hidden_dim):
+            return gru_pallas.sepconv_gru(
+                h, x, self._packed_weights(), dtype=self.dtype)
         h = self._step(h, x, self.convz1, self.convr1, self.convq1)
         return self._step(h, x, self.convz2, self.convr2, self.convq2)
 

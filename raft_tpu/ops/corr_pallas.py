@@ -100,6 +100,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.ops import layout as klayout
+from raft_tpu.ops import vmem
+from raft_tpu.utils.envflags import env_bool, env_int_choice
+
 # Rows per banded chunk: one MXU matmul + unrolled sweep per chunk. 8 keeps
 # the dynamic-slice starts sublane-aligned for every 8-aligned level width.
 _CHUNK = 8
@@ -120,16 +124,10 @@ def _choose_tile(n: int) -> int:
     stack OOM (17.4 MB vs the 16 MB limit) at Sintel resolution —
     larger tiles cannot be admitted without also shrinking the resident
     pyramid the kernel depends on."""
-    env = os.environ.get("RAFT_CORR_TILE", "0")
-    try:
-        tile = int(env)
-    except ValueError:
-        raise ValueError(f"RAFT_CORR_TILE must be an integer multiple "
-                         f"of 128, got {env!r}") from None
-    if tile < 0 or tile % 128 or tile > 256:
-        raise ValueError(f"RAFT_CORR_TILE must be 128 or 256 (0/unset "
-                         f"= auto; larger tiles measured a Mosaic "
-                         f"scoped-VMEM OOM), got {env!r}")
+    tile = env_int_choice(
+        "RAFT_CORR_TILE", (0, 128, 256), 0,
+        hint="0/unset = auto; lane-dim blocks must be a multiple of 128 "
+             "and larger tiles measured a Mosaic scoped-VMEM OOM")
     tile = tile or (256 if n >= 256 else 128)
     return min(tile, _round_up(n, 128))
 
@@ -267,14 +265,12 @@ def _fwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
     out = jnp.concatenate(level_rows, axis=0)            # (L*win*win, TQ)
     if scale:
         out = out * inv_sqrt_c
-    # Emitting the consumer's dtype here is bit-identical to casting the
+    # Consumer dtype + axis order emitted at the boundary (layout-contract
+    # invariants 1-2, raft_tpu.ops.layout): bit-identical to casting the
     # float32 result outside the kernel, but saves the XLA-level
     # convert+copy at the custom-call boundary (measured ~2% of the b64
-    # headline step as pure layout tax).
-    if tout:
-        out_ref[0] = out.T.astype(out_ref.dtype)         # (TQ, L*win*win)
-    else:
-        out_ref[0] = out.astype(out_ref.dtype)
+    # headline step as pure layout tax). ``tout`` → (TQ, L*win*win).
+    klayout.boundary_store(out_ref, out, transpose=tout)
 
 
 def _bwd_kernel(cx_ref, cy_ref, f1_ref, *refs, radius: int, scale: bool,
@@ -390,16 +386,10 @@ def _pallas_fwd(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
     kernel = functools.partial(_fwd_kernel, radius=radius, scale=scale,
                                levels=levels, mxu_dtype=mxu_dtype,
                                band=band, rescale=rescale, tout=tout)
-    if tout:
-        out_specs = pl.BlockSpec((1, tq, nl * win * win),
-                                 lambda bi, ti: (bi, ti, 0))
-        out_shape = jax.ShapeDtypeStruct((b, np_, nl * win * win),
-                                         out_dtype)
-    else:
-        out_specs = pl.BlockSpec((1, nl * win * win, tq),
-                                 lambda bi, ti: (bi, 0, ti))
-        out_shape = jax.ShapeDtypeStruct((b, nl * win * win, np_),
-                                         out_dtype)
+    # Layout-contract invariant 3: output tiled over the query axis; the
+    # consumer-major order pairs with the kernel's transposed store.
+    out_specs, out_shape = klayout.query_tiled_out(
+        b, np_, nl * win * win, tq, out_dtype, consumer_major=tout)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -515,6 +505,40 @@ def _resolve_band(band) -> str:
     return band
 
 
+def corr_vmem_parts(pyramid_shapes, channels: int,
+                    dtype_bytes: int = 4, radius: int = 4,
+                    differentiable: bool = False,
+                    tq: int = 256) -> dict:
+    """Named scoped-VMEM buffer estimate for the fused corr kernel —
+    the shared currency of ``raft_tpu.ops.vmem`` (``fits`` for the
+    eligibility gate, ``preflight`` for the loud pre-launch check).
+
+    ``tq`` defaults to the worst admissible query tile (256) so the
+    eligibility gate stays tile-independent; the pre-launch preflight
+    passes the actual tile."""
+    win = 2 * radius + 1
+    resident = 0
+    df2 = 0
+    w2p_max = 8
+    for (h2, w2) in pyramid_shapes:
+        w2p = _round_up(w2, 8)
+        w2p_max = max(w2p_max, w2p)
+        level = _round_up(h2, _CHUNK) * w2p * channels
+        resident += level * dtype_bytes
+        if differentiable:
+            df2 += level * 4                     # f32 df2 output block
+    parts = {"pyramid_resident": resident}
+    # t1/u accumulator scratch at the actual window size, f32 — doubled
+    # for margin (chunk matmul operands, out block)
+    parts["tile_scratch"] = 2 * win * w2p_max * tq * 4
+    if differentiable:
+        parts["df2_blocks_f32"] = df2
+        # g block (L*win^2, TQ) + df1 scratch/out (TQ, C), all f32
+        parts["bwd_g_df1"] = (len(pyramid_shapes) * win * win * tq
+                              + 2 * tq * channels) * 4
+    return parts
+
+
 def fused_eligible(pyramid_shapes, channels: int,
                    dtype_bytes: int = 4, radius: int = 4,
                    differentiable: bool = False) -> bool:
@@ -530,29 +554,15 @@ def fused_eligible(pyramid_shapes, channels: int,
     than admitting a shape that compiles forward but fails Mosaic VMEM
     allocation in the backward. Training always runs on crops
     (SURVEY.md §2.5), which fit the tighter budget with a wide margin."""
-    total = 0
-    w2p_max = 8
     for (h2, w2) in pyramid_shapes:
         if h2 == 0 or w2 == 0:
             # Degenerate pooled level (tiny inputs): the jnp fallback
             # short-circuits it to zero windows; the kernel's BlockSpecs
             # can't express a zero-size input block.
             return False
-        w2p = _round_up(w2, 8)
-        w2p_max = max(w2p_max, w2p)
-        level = _round_up(h2, _CHUNK) * w2p * channels
-        total += level * dtype_bytes
-        if differentiable:
-            total += level * 4                   # f32 df2 output block
-    # t1/u accumulator scratch at the actual window size, tq=256, f32 —
-    # doubled for margin (chunk matmul operands, out block)
-    win = 2 * radius + 1
-    scratch = 2 * win * w2p_max * 256 * 4
-    if differentiable:
-        # g block (L*win^2, TQ) + df1 scratch/out (TQ, C), all f32
-        scratch += (len(pyramid_shapes) * win * win * 256
-                    + 2 * 256 * channels) * 4
-    return total + scratch <= 13 * 2 ** 20
+    return vmem.fits(corr_vmem_parts(pyramid_shapes, channels,
+                                     dtype_bytes, radius,
+                                     differentiable))
 
 
 def windowed_correlation_pallas_fused(
@@ -623,18 +633,28 @@ def windowed_correlation_pallas_fused(
     cx = cf[..., 0][:, None, :]                          # (B, 1, Np)
     cy = cf[..., 1][:, None, :]
 
+    # VMEM preflight (shared with the GRU kernel, raft_tpu.ops.vmem):
+    # fail loudly with an itemized requested-vs-16MB breakdown before
+    # handing Mosaic a config it would reject with a raw scoped-VMEM
+    # OOM after a long compile (the tile-512 case, BASELINE.md).
+    # Forward-pass estimate — the launch being admitted here; interpret
+    # mode has no VMEM to budget.
+    if not interpret:
+        vmem.preflight(
+            corr_vmem_parts([f2.shape[1:3] for f2 in pyramid2], c,
+                            jnp.dtype(fmap1.dtype).itemsize, radius,
+                            tq=tq),
+            f"corr fused kernel (tq={tq})")
+
     # Transposed output store (default ON): the kernel emits each output
     # tile query-major — (TQ, L*win*win) — deleting the XLA swapaxes
     # copy at the custom-call boundary for one in-VMEM per-tile
-    # transpose. Bit-exact (test_tout_bitexact); measured +1.4% on the
+    # transpose (layout-contract invariant 2, raft_tpu.ops.layout).
+    # Bit-exact (test_tout_bitexact); measured +1.4% on the
     # b64 headline (93.4 → 94.8 pairs/s, the copy.257 row of the
     # round-5 profile). RAFT_CORR_TOUT=0 restores the query-minor
     # store; trace-time read, like RAFT_CORR_BAND.
-    tout_env = os.environ.get("RAFT_CORR_TOUT", "1")
-    if tout_env not in ("0", "1"):
-        raise ValueError(f"RAFT_CORR_TOUT must be '0' or '1', got "
-                         f"{tout_env!r}")
-    tout = tout_env == "1"
+    tout = env_bool("RAFT_CORR_TOUT", True)
     out = _windowed(f1, f2s, cx, cy, radius, scale, interpret, levels, tq,
                     mxu_dtype, band, rescale, jnp.dtype(out_dtype), tout)
     if not tout:

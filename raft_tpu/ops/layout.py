@@ -1,0 +1,91 @@
+"""Shared layout contract for Pallas custom-call and scan boundaries.
+
+Why this module exists
+----------------------
+Round-5 profiling (BASELINE.md) showed that the *boundaries* of a custom
+kernel can cost as much as its body: an XLA ``transpose``/``convert`` copy
+at the custom-call edge measured ~12 ms/step (copy.257) until the corr
+kernel learned to emit each output tile already in the consumer's axis
+order and dtype (``RAFT_CORR_TOUT``).  That logic lived as ad-hoc branches
+inside ``corr_pallas.py``; this module extracts it so every kernel —
+corr, the fused GRU cell, and whatever comes next — inherits the win
+instead of re-deriving it.
+
+The contract (invariants for kernel authors)
+--------------------------------------------
+1. **Emit the consumer's dtype in the final store.**  Accumulate in
+   float32 inside the kernel, then cast *once* in the store
+   (``boundary_store``).  This is bit-identical to casting the float32
+   result outside the kernel (one rounding either way —
+   ``test_out_dtype_bitexact_vs_external_cast``) but deletes the XLA
+   ``convert``+copy at the custom-call boundary.
+2. **Emit the consumer's axis order in the final store.**  If the next op
+   wants ``(..., N, F)`` and the kernel naturally produces ``(F, N)``
+   tiles, transpose *in VMEM, per tile* (``boundary_store(...,
+   transpose=True)``) rather than letting XLA materialize a full-array
+   transpose in HBM.  Value-level transposes of VMEM-resident tiles are
+   cheap; HBM relayouts are not.
+3. **Tile the output over the axis the consumer iterates.**  Output
+   BlockSpecs index the *tiled* axis with the grid's tile index and pin
+   every other axis to 0 (``query_tiled_out``), so each block is written
+   exactly once and XLA can alias the buffer straight into the consumer.
+4. **Scan carries keep one layout for the whole scan.**  Arrays carried
+   through ``lax.scan`` (the RAFT refinement loop: hidden state, flow,
+   coords) must enter and leave a fused kernel in the *same* axis order
+   and dtype — ``(B, H, W, C)``, channel-minor, the carry's dtype —
+   otherwise XLA inserts a relayout copy on every iteration, which is
+   precisely the HBM round-trip the kernel exists to delete.  A kernel
+   that wants a different internal layout must reshape *inside* (VMEM),
+   not at the boundary (HBM).
+5. **Gradients are float32 at the boundary.**  ``out_dtype`` shapes only
+   the forward value; custom-VJP backward outputs are emitted float32 and
+   cast to the primal dtype by the wrapper (the corr kernel's contract).
+
+``corr_pallas.py`` (RAFT_CORR_TOUT) and ``gru_pallas.py`` both build on
+these helpers; the VMEM-budget side of kernel admission lives in
+``raft_tpu.ops.vmem``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def boundary_store(out_ref, value, *, transpose: bool = False) -> None:
+    """The canonical final store of a kernel output block.
+
+    Casts ``value`` (typically a float32 accumulator) to the output ref's
+    dtype — invariant 1 — and optionally transposes the last two axes in
+    VMEM first — invariant 2.  ``out_ref`` is expected to be a
+    ``(1, rows, cols)`` block ref (the leading 1 is the grid's batch
+    axis); ``value`` is the 2-D tile value.
+    """
+    if transpose:
+        value = value.T
+    out_ref[0] = value.astype(out_ref.dtype)
+
+
+def query_tiled_out(b: int, n: int, feat: int, tile: int, dtype, *,
+                    consumer_major: bool = True):
+    """Output BlockSpec + ShapeDtypeStruct for a kernel whose grid is
+    ``(batch, n // tile)`` and whose per-tile result is ``tile`` rows of
+    ``feat`` features (invariant 3).
+
+    ``consumer_major=True`` (the contract default) lays the array out as
+    ``(B, N, F)`` — the tiled axis major, features minor — which is what
+    channel-minor NHWC consumers read without a relayout; the kernel pairs
+    it with ``boundary_store(..., transpose=...)`` as needed.
+    ``consumer_major=False`` is the legacy query-minor order ``(B, F, N)``
+    (``RAFT_CORR_TOUT=0``), kept so the bit-exactness of the transposed
+    store stays testable against it.
+
+    Returns ``(block_spec, shape_struct)``.
+    """
+    if consumer_major:
+        spec = pl.BlockSpec((1, tile, feat), lambda bi, ti: (bi, ti, 0))
+        shape = jax.ShapeDtypeStruct((b, n, feat), dtype)
+    else:
+        spec = pl.BlockSpec((1, feat, tile), lambda bi, ti: (bi, 0, ti))
+        shape = jax.ShapeDtypeStruct((b, feat, n), dtype)
+    return spec, shape

@@ -1,0 +1,71 @@
+"""Scoped-VMEM budget shared by the Pallas kernels (corr + GRU).
+
+A TPU core has ~16 MB of VMEM; Mosaic additionally needs headroom for
+compiler-managed temporaries (matmul operand staging, double-buffered
+block windows).  Exceeding it does not fail gracefully: the 512-query-tile
+corr config died in Mosaic with a raw scoped-allocator OOM — ``17.41 MB
+vs 16 MB limit`` after a long compile (BASELINE.md "Query tile 512") —
+with no indication of *which* buffers blew the budget.
+
+This module gives kernels two shared pieces:
+
+* ``BUDGET_BYTES`` — the conservative admission budget (13 MiB) that
+  ``corr_pallas.fused_eligible`` has used since round 2; the 3 MiB gap to
+  the hard limit is the measured headroom Mosaic's own temporaries need.
+* ``preflight(parts, where)`` — a loud pre-launch check: given the
+  kernel's named buffer estimate, raise ``ValueError`` with the itemized
+  breakdown and the requested-vs-16 MB numbers *before* ``pallas_call``
+  hands the config to Mosaic, instead of after a multi-minute compile.
+
+Estimates are static (shape arithmetic only) and intentionally
+conservative — over-admitting reproduces the raw Mosaic OOM this module
+exists to prevent, while under-admitting merely falls back to the XLA
+path.  Interpret mode (CPU tests) has no VMEM, so wrappers skip the
+preflight when ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+#: Hard per-core scoped-VMEM limit Mosaic allocates against.
+LIMIT_BYTES = 16 * 2 ** 20
+
+#: Conservative admission budget: leaves ~3 MiB for Mosaic temporaries.
+BUDGET_BYTES = 13 * 2 ** 20
+
+
+def total_bytes(parts: Mapping[str, int]) -> int:
+    """Sum a kernel's named buffer estimate (bytes per name)."""
+    return sum(parts.values())
+
+
+def fits(parts: Mapping[str, int]) -> bool:
+    """Whether the estimate fits the conservative admission budget."""
+    return total_bytes(parts) <= BUDGET_BYTES
+
+
+def preflight(parts: Mapping[str, int], where: str) -> None:
+    """Raise a clear ``ValueError`` if ``parts`` exceeds the admission
+    budget — called by kernel wrappers immediately before ``pallas_call``
+    so an oversized config fails in microseconds with an itemized
+    breakdown instead of a raw Mosaic scoped-VMEM OOM after compile.
+
+    ``where`` names the kernel/config for the message (e.g.
+    ``"corr fused forward (tq=512)"``).
+    """
+    total = total_bytes(parts)
+    if total <= BUDGET_BYTES:
+        return
+    mb = 2 ** 20
+    items = ", ".join(f"{k}={v / mb:.2f} MB"
+                      for k, v in sorted(parts.items(),
+                                         key=lambda kv: -kv[1]))
+    raise ValueError(
+        f"{where}: estimated VMEM {total / mb:.2f} MB exceeds the "
+        f"{BUDGET_BYTES / mb:.0f} MB admission budget "
+        f"(hard per-core limit {LIMIT_BYTES / mb:.0f} MB, remainder is "
+        f"Mosaic temporary headroom). Breakdown: {items}. "
+        f"Shrink the tile or shard the input instead of letting Mosaic "
+        f"hit a raw scoped-VMEM OOM (BASELINE.md 'Query tile 512')."
+    )

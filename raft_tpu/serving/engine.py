@@ -33,7 +33,13 @@ mechanisms behind one ``submit() -> Future`` API:
   :func:`enable_persistent_compile_cache` points XLA's on-disk cache at
   the repo's ``.jax_cache/`` (the same wiring bench.py uses) so a
   serving process restart pays seconds, not minutes, before its first
-  request.
+  request. The zero-compile contract extends over the trace-time kernel
+  flags (``RAFT_CORR_BACKEND``/``RAFT_CORR_BAND``, ``RAFT_GRU_PALLAS``):
+  each bucket executable bakes the dispatch the environment held when it
+  was warmed — with the fused Pallas GRU cell enabled, warmup compiles
+  the kernel path once per bucket and steady-state requests stay at zero
+  compiles (probe-asserted in ``tests/test_gru_pallas.py``). Flip those
+  flags before engine construction, never between warmup and serving.
 
 On top of those sits the **robustness layer** (Clipper-style: degrade
 gracefully, never let one failure take out its co-batched neighbors):

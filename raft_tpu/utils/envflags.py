@@ -1,0 +1,99 @@
+"""Loud, uniform parsing of ``RAFT_*`` environment flags.
+
+Every kernel/runtime toggle in this repo is an environment variable read at
+trace time (``RAFT_CORR_TOUT``, ``RAFT_CORR_TILE``, ``RAFT_GRU_PALLAS``, ...).
+Historically each call site hand-validated its own string, so a misspelled
+value failed differently depending on which flag you fat-fingered — or worse,
+was silently treated as the default.  This module centralises the parsing so
+every flag fails loudly and identically:
+
+* ``env_bool``  — '0'/'1' flags (``RAFT_CORR_TOUT``).
+* ``env_enum``  — closed string sets (``RAFT_GRU_PALLAS`` in {'auto','0','1'}).
+* ``env_int_choice`` — closed integer sets with an optional sentinel for
+  "unset/auto" (``RAFT_CORR_TILE`` in {0, 128, 256}).
+
+All helpers raise ``ValueError`` naming the variable, the offending value and
+the accepted set, and all treat the empty string like an unset variable (shells
+routinely export empties when composing env incantations).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+
+def _get(name: str) -> str | None:
+    """Read ``name`` from the environment; empty string counts as unset."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    return raw
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Parse a '0'/'1' environment flag.
+
+    Unset (or empty) returns ``default``.  Anything other than the literal
+    strings '0' or '1' raises ``ValueError`` — boolean flags here deliberately
+    do not accept 'true'/'yes'/'on' spellings, so a typo can never silently
+    flip a kernel code path.
+    """
+    raw = _get(name)
+    if raw is None:
+        return default
+    if raw not in ("0", "1"):
+        raise ValueError(f"{name} must be '0' or '1', got {raw!r}")
+    return raw == "1"
+
+
+def env_enum(name: str, choices: Sequence[str], default: str) -> str:
+    """Parse an environment flag restricted to a closed set of strings.
+
+    Unset (or empty) returns ``default``; ``default`` must itself be a member
+    of ``choices`` so call sites cannot introduce an unreachable spelling.
+    """
+    if default not in choices:
+        raise ValueError(
+            f"default {default!r} for {name} is not among choices {tuple(choices)}"
+        )
+    raw = _get(name)
+    if raw is None:
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f"{name} must be one of {tuple(choices)}, got {raw!r}"
+        )
+    return raw
+
+
+def env_int_choice(
+    name: str,
+    choices: Sequence[int],
+    default: int,
+    *,
+    hint: str = "",
+) -> int:
+    """Parse an integer flag restricted to a closed set.
+
+    Unset (or empty) returns ``default``.  A value that does not parse as an
+    integer, or parses but is not in ``choices``, raises ``ValueError``; the
+    optional ``hint`` is appended to the message so call sites can explain the
+    constraint (e.g. why larger correlation tiles are rejected).
+    """
+    raw = _get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        suffix = f" ({hint})" if hint else ""
+        raise ValueError(
+            f"{name} must be an integer, one of {tuple(choices)}, got {raw!r}{suffix}"
+        ) from None
+    if val not in choices:
+        suffix = f" ({hint})" if hint else ""
+        raise ValueError(
+            f"{name} must be one of {tuple(choices)}, got {val}{suffix}"
+        )
+    return val

@@ -10,7 +10,14 @@ actionable without TensorBoard:
 * :func:`op_breakdown` — parse the captured ``*.xplane.pb`` protobuf
   directly (the tensorboard-plugin converter stack is not required) and
   aggregate per-HLO-op self times from the device's "XLA Ops" timeline.
-* :func:`print_breakdown` — the top-N table, normalized per step.
+* :func:`print_breakdown` — the top-N table, normalized per step. When
+  the trace carries per-op ``flops`` stats (TPU traces do; CPU traces
+  usually don't) each row also gets an achieved-TFLOP/s and an MFU
+  column, so "which op is the MFU wall" is answerable from the probe
+  artifact alone instead of cross-referencing a roofline by hand.
+* :func:`peak_tflops` — the MFU denominator: ``RAFT_PEAK_TFLOPS`` env
+  override, else the TPU-v5e bf16 figure (197) on TPU backends, else
+  unknown (CPU peak varies too much across hosts to guess).
 * :class:`HostStageTimer` — accumulated *host-side* wall time per named
   pipeline stage (pad / stack / dispatch / sync), for code whose cost
   the device tracer can't see. The serving engine threads one through
@@ -145,8 +152,46 @@ def op_breakdown(logdir: str) -> List[Tuple[str, float, int]]:
     return _collect_ops(logdir)[0]
 
 
+def peak_tflops() -> Optional[float]:
+    """MFU denominator in TFLOP/s: ``RAFT_PEAK_TFLOPS`` env override
+    (accepts any float; ``0``/empty = unknown), else 197 — TPU v5e bf16
+    peak per chip — when the default jax backend is a TPU, else ``None``
+    (unknown; MFU columns are suppressed rather than guessed)."""
+    raw = os.environ.get("RAFT_PEAK_TFLOPS", "")
+    if raw:
+        v = float(raw)
+        return v if v > 0 else None
+    try:
+        import jax
+
+        if jax.default_backend() == "tpu":
+            return 197.0
+    except Exception:  # pragma: no cover - no jax / no backend
+        pass
+    return None
+
+
+def _event_flops(plane, ev, stat_names) -> int:
+    """FLOP count of one xplane event: the ``flops`` stat, read from the
+    event's own stats first, then from its (shared) event metadata —
+    traces have carried it in either place across TF releases."""
+    for stats in (ev.stats, plane.event_metadata[ev.metadata_id].stats):
+        for st in stats:
+            if stat_names.get(st.metadata_id) != "flops":
+                continue
+            return int(st.int64_value or st.uint64_value
+                       or st.double_value)
+    return 0
+
+
 def _collect_ops(logdir: str):
-    """Shared collector: ``(rows, [(plane/line, total_ms), ...])``."""
+    """Shared collector:
+    ``(rows, [(plane/line, total_ms), ...], {op: flops})``.
+
+    ``rows`` keeps the historical ``[(name, total_ms, count), ...]``
+    shape (:func:`op_breakdown`'s public contract); flops ride in the
+    separate per-op dict, empty when the trace has no ``flops`` stats.
+    """
     xs = _load_xspace(logdir)
     # Candidate op-level timelines: "XLA Ops" (TPU device planes) and CPU
     # executor threads ("tf_XLA..."). The TPU plane also has an
@@ -163,30 +208,54 @@ def _collect_ops(logdir: str):
                 host_lines.append((plane, line))
     tot: collections.Counter = collections.Counter()
     cnt: collections.Counter = collections.Counter()
+    flops: collections.Counter = collections.Counter()
     lines_used = []
     for plane, line in device_lines or host_lines:
+        stat_names = {sid: meta.name
+                      for sid, meta in plane.stat_metadata.items()}
         line_ps = 0
         for ev in line.events:
             name = plane.event_metadata[ev.metadata_id].name
             tot[name] += ev.duration_ps
             cnt[name] += 1
+            flops[name] += _event_flops(plane, ev, stat_names)
             line_ps += ev.duration_ps
         if line_ps:
             lines_used.append((f"{plane.name}/{line.name}", line_ps / 1e9))
     rows = sorted(((k, ps / 1e9, cnt[k]) for k, ps in tot.items()),
                   key=lambda x: -x[1])
-    return rows, lines_used
+    return rows, lines_used, {k: v for k, v in flops.items() if v}
 
 
 def print_breakdown(logdir: str, steps: int = 1, top: int = 20) -> None:
-    """Print the top-``top`` ops, times divided by ``steps``."""
-    rows, lines_used = _collect_ops(logdir)
+    """Print the top-``top`` ops, times divided by ``steps``.
+
+    With per-op ``flops`` stats in the trace, each row gains the op's
+    achieved TFLOP/s and — when :func:`peak_tflops` knows the chip — its
+    MFU, plus a weighted whole-program MFU line. Both are *self-time*
+    utilizations (flops / op self time / peak), so memory-bound ops
+    honestly read near 0% rather than inheriting neighbors' compute.
+    """
+    rows, lines_used, flops = _collect_ops(logdir)
     total = sum(ms for _, ms, _ in rows)
+    peak = peak_tflops() if flops else None
     print(f"total device op time: {total / max(steps, 1):.2f} ms/step "
           f"({len(rows)} distinct ops, {len(lines_used)} op timelines)")
+    if flops and total:
+        agg = sum(flops.values()) / (total * 1e-3) / 1e12
+        line = f"achieved: {agg:.2f} TFLOP/s over device op time"
+        if peak:
+            line += f" = {100.0 * agg / peak:.1f}% MFU of {peak:g} peak"
+        print(line)
     if len(lines_used) > 1:
         for name, ms in lines_used:
             print(f"  contributing line: {name} "
                   f"({ms / max(steps, 1):.2f} ms/step)")
     for name, ms, c in rows[:top]:
-        print(f"{ms / max(steps, 1):9.3f} ms/step  x{c:5d}  {name[:90]}")
+        cols = f"{ms / max(steps, 1):9.3f} ms/step  x{c:5d}"
+        if name in flops and ms:
+            tf = flops[name] / (ms * 1e-3) / 1e12
+            cols += f"  {tf:7.2f} TF/s"
+            if peak:
+                cols += f" {100.0 * tf / peak:5.1f}% MFU"
+        print(f"{cols}  {name[:90]}")

@@ -34,6 +34,11 @@ if len(_res) != 2:
                      f"{os.environ['RAFT_KNEE_RES']!r}")
 H, W = int(_res[0]), int(_res[1])
 ITERS = int(os.environ.get("RAFT_KNEE_ITERS", "12"))
+# The round-6 fused GRU kernel changes the per-iteration cost, so the
+# knee may move; RAFT_KNEE_GRU pins RAFT_GRU_PALLAS for the whole sweep
+# and the payload records which arm produced the numbers.
+if os.environ.get("RAFT_KNEE_GRU"):
+    os.environ["RAFT_GRU_PALLAS"] = os.environ["RAFT_KNEE_GRU"]
 WARMUP, REPS = 2, 6
 BATCHES = tuple(int(b) for b in
                 os.environ.get("RAFT_KNEE_BATCHES", "24,32,48,64").split(","))
@@ -49,7 +54,8 @@ def main():
     base = RAFT(RAFTConfig(iters=ITERS, mixed_precision=True))
     variables = base.init({"params": rng, "dropout": rng}, img1, img1,
                           iters=1)
-    out = {"resolution": [H, W], "iters": ITERS, "reps": REPS}
+    out = {"resolution": [H, W], "iters": ITERS, "reps": REPS,
+           "gru": os.environ.get("RAFT_GRU_PALLAS") or "auto"}
 
     for name, alt in (("alternate", True), ("all_pairs", False)):
         model = RAFT(RAFTConfig(iters=ITERS, mixed_precision=True,

@@ -6,12 +6,20 @@ Sections:
   (jnp vs pallas backend), per-op breakdown.
 * ``headline`` — the bench.py headline forward at batch 24, per-op
   breakdown of one dispatch.
+* ``gru``      — the round-6 fused SepConvGRU kernel A/B: the non-small
+  headline forward with ``RAFT_GRU_PALLAS`` forced on then off.
+
+Every breakdown now carries per-op achieved TFLOP/s + MFU when the
+trace has ``flops`` stats (see ``raft_tpu/utils/profiling.py``), and a
+program-level MFU from XLA's own cost model — so the next MFU wall is
+nameable from this artifact alone, no TensorBoard round-trip.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -21,12 +29,38 @@ import jax.numpy as jnp
 from raft_tpu.utils import profiling
 
 
+def _program_flops(fn, *args):
+    """Whole-dispatch FLOP count from XLA's cost model, when ``fn`` is a
+    jitted callable (``.lower`` path); None otherwise / on any failure
+    (cost_analysis shape varied across jax releases)."""
+    if not hasattr(fn, "lower"):
+        return None
+    try:
+        cost = fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
 def _run(fn, *args):
     for _ in range(2):
         jnp.sum(fn(*args)).block_until_ready()
+    flops = _program_flops(fn, *args)
+    t0 = time.perf_counter()
     with profiling.trace() as t:
         out = fn(*args)
         float(jnp.sum(out))
+    wall = time.perf_counter() - t0
+    if flops:
+        tf = flops / wall / 1e12
+        line = (f"program: {flops / 1e12:.3f} TFLOP in {wall * 1e3:.1f} ms"
+                f" wall -> {tf:.2f} TFLOP/s")
+        peak = profiling.peak_tflops()
+        if peak:
+            line += f" = {100.0 * tf / peak:.1f}% MFU of {peak:g} peak"
+        print(line)
     profiling.print_breakdown(t.logdir, steps=1, top=14)
 
 
@@ -75,6 +109,37 @@ def headline():
     _run(fwd, img, img)
 
 
+def gru():
+    """Round-6 tentpole A/B: per-op breakdown of the non-small headline
+    forward with the fused SepConvGRU Pallas kernel forced on, then off.
+    The flag is read at trace time, so each arm builds a fresh jit."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 440, 1024
+    batch = int(os.environ.get("RAFT_PROBE_BATCH", "24"))
+    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+    img = jnp.broadcast_to(img1, (batch, H, W, 3))
+    prev = os.environ.get("RAFT_GRU_PALLAS")
+    try:
+        for label, flag in (("pallas", "1"), ("xla", "0")):
+            os.environ["RAFT_GRU_PALLAS"] = flag
+            fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
+                                                   test_mode=True)[1])
+            print(f"=== gru {batch}x{H}x{W} iters=12 gru={label}")
+            _run(fwd, img, img)
+    finally:
+        if prev is None:
+            os.environ.pop("RAFT_GRU_PALLAS", None)
+        else:
+            os.environ["RAFT_GRU_PALLAS"] = prev
+
+
 def sparse_b8():
     """VERDICT r2 #6: sparse_train b4->b8 doubles step time with flat
     samples/s and non-monotonic peak HBM. Per-op breakdown of one train
@@ -108,4 +173,5 @@ if __name__ == "__main__":
     names = sys.argv[1:] or ["msda", "headline"]
     print("devices:", jax.devices(), flush=True)
     for n in names:
-        {"msda": msda, "headline": headline, "sparse_b8": sparse_b8}[n]()
+        {"msda": msda, "headline": headline, "gru": gru,
+         "sparse_b8": sparse_b8}[n]()

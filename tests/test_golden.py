@@ -142,6 +142,21 @@ def test_golden_spatial_sharded_banded(monkeypatch):
     assert results["golden_parity_epe"] < 2e-3, results
 
 
+def test_golden_gru_pallas(monkeypatch):
+    """Round-6 fused SepConvGRU kernel end-to-end (the tentpole):
+    RAFT_GRU_PALLAS=1 routes every refinement iteration's update cell
+    through the Pallas kernel (interpret mode on CPU) and must reproduce
+    the same canonical-torch goldens through the whole predictor chain
+    — PNG read → jit → scan → convex upsampling."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    monkeypatch.setenv("RAFT_GRU_PALLAS", "1")
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"), iters=12)
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 2e-3, results
+
+
 def test_spatial_shards_rejects_other_families():
     from raft_tpu.evaluate import load_predictor
 
