@@ -785,7 +785,166 @@ def _serving_failure(msg: str) -> None:
            "error": msg})
 
 
+STREAMING_METRIC = "streaming_warm_vs_stateless_pairs_per_sec_speedup"
+
+
+def streaming_main():
+    """``python bench.py streaming`` — session-aware streaming serving
+    benchmark (warm start + encoder feature-map reuse).
+
+    Drives N concurrent streaming sessions over temporally coherent
+    synthetic streams and publishes their WARM steady-state throughput
+    against the thing they replace: the same streams submitted as
+    stateless ``(frame_k, frame_k+1)`` pairs through the same engine
+    (every pair pays two fnet passes and full iterations). The frames,
+    closed-loop client structure and timed-pair counts are identical
+    between the two arms, so the ratio isolates exactly what sessions
+    save: one encoder pass per warm frame plus the warm-start iteration
+    discount. Emits ONE BENCH-compatible JSON line.
+
+    Unlike the dispatch-gap serving benchmark this speedup is real on
+    ANY platform — the saved encoder pass and GRU iterations are
+    compute, not dispatch overhead — but CPU-smoke numbers still travel
+    with their platform label and the accuracy context (warm-vs-cold
+    flow drift per pair) so nobody mistakes a 1-core smoke point for a
+    TPU capture.
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+    from raft_tpu.serving.metrics import CompileWatch
+
+    platform = jax.devices()[0].platform
+    ncores = os.cpu_count() or 1
+    if platform == "tpu":
+        shape = (436, 1024)
+        small, iters, warm_iters = False, ITERS, 6
+        max_batch, n_streams, n_frames = 8, 16, 24
+        max_wait_ms = 5.0
+    else:
+        shape = (64, 96)
+        small, iters, warm_iters = True, 4, 2
+        max_batch, n_streams, n_frames = 4, 6, 12
+        max_wait_ms = 4.0
+
+    predictor = load_predictor("random", small=small, iters=iters)
+    cfg = ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        buckets=(shape,), warm_buckets=(shape,),
+        warm_iters=warm_iters, persistent_cache=True)
+    engine = ServingEngine(predictor, cfg)
+    t0 = time.perf_counter()
+    warm_stats = engine.warmup()
+    warmup = {
+        "seconds": round(time.perf_counter() - t0, 3),
+        "compiles": int(sum(v["compiles"] for v in warm_stats.values()))}
+    engine.start(warmup=False)
+    try:
+        with CompileWatch() as watch:
+            base = loadgen.run_pair_stream_load(
+                engine, n_streams, n_frames, shape=shape,
+                collect_flows=True)
+            stream = loadgen.run_stream_load(
+                engine, n_streams, n_frames, shape=shape,
+                collect_flows=True)
+    finally:
+        engine.close()
+
+    # Accuracy context: per-pair drift of the warm session flow vs the
+    # stateless flow over the SAME frames (pair 0 is the session's cold
+    # pair — same executable family, listed separately), plus both
+    # arms' EPE against the streams' constant ground-truth shift.
+    warm_drift, cold_drift, epe_stream, epe_base = [], [], [], []
+    for (gt, sflows), (_, bflows) in zip(stream["flows"], base["flows"]):
+        for k, (sf, bf) in enumerate(zip(sflows, bflows)):
+            d = float(np.mean(np.linalg.norm(sf - bf, axis=-1)))
+            (cold_drift if k == 0 else warm_drift).append(d)
+            epe_stream.append(
+                float(np.mean(np.linalg.norm(sf - gt, axis=-1))))
+            epe_base.append(
+                float(np.mean(np.linalg.norm(bf - gt, axis=-1))))
+
+    sessions = [rec["session"]
+                for rec in stream["per_stream"].values()]
+    hit_rates = [s["encoder_cache_hit_rate"] for s in sessions]
+    expected_rate = (n_frames - 1) / n_frames
+    speedup = (stream["pairs_per_s"] / base["pairs_per_s"]
+               if base["pairs_per_s"] else None)
+    lat = [rec["latency_ms"] for rec in stream["per_stream"].values()]
+    payload = {
+        "metric": STREAMING_METRIC,
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x",
+        "platform": platform,
+        "host_cores": ncores,
+        "model": "raft-small" if small else "raft-large",
+        "iters": iters,
+        "warm_iters": warm_iters,
+        "shape": list(shape),
+        "n_streams": n_streams,
+        "n_frames_per_stream": n_frames,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "warmup": warmup,
+        "streaming_pairs_per_sec": round(stream["pairs_per_s"], 3),
+        "stateless_pairs_per_sec": round(base["pairs_per_s"], 3),
+        "steady_pairs_per_arm": stream["steady_pairs"],
+        "dropped": stream["dropped"] + base["dropped"],
+        "per_stream_latency_p50_ms": round(
+            float(np.median([l["p50"] for l in lat])), 2),
+        "per_stream_latency_p99_ms": round(
+            float(max(l["p99"] for l in lat)), 2),
+        "encoder_cache_hit_rate_min": round(min(hit_rates), 4),
+        "encoder_cache_hit_rate_expected": round(expected_rate, 4),
+        "warm_pairs_total": sum(s["warm_pairs"] for s in sessions),
+        "cold_pairs_total": sum(s["cold_pairs"] for s in sessions),
+        "post_warmup_compiles": watch.compiles,
+        "warm_vs_stateless_flow_drift_epe": {
+            "warm_mean": round(float(np.mean(warm_drift)), 4),
+            "warm_max": round(float(np.max(warm_drift)), 4),
+            "cold_pair_mean": round(float(np.mean(cold_drift)), 4),
+        },
+        "epe_vs_gt": {
+            "streaming_mean": round(float(np.mean(epe_stream)), 4),
+            "stateless_mean": round(float(np.mean(epe_base)), 4),
+        },
+    }
+    if platform != "tpu":
+        # Honesty clause: this is a real compute saving (not a dispatch
+        # artifact), so the ≥1.3x criterion IS meaningful on CPU — but
+        # the absolute pairs/s and the random-weight EPE context are
+        # smoke numbers, not a TPU capture, and say so.
+        payload["criterion_note"] = (
+            "warm speedup comes from skipping one fnet pass per frame "
+            f"and running {warm_iters} vs {iters} GRU iterations — a "
+            "compute saving measurable on this "
+            f"{ncores}-core {platform} smoke host; absolute pairs/s "
+            "and the random-weight EPE context are NOT TPU numbers")
+        payload["tpu_reference_context"] = {
+            "file": "BENCH_r05 (round-5 on-chip capture)",
+            "note": "no committed TPU streaming capture yet; stateless "
+                    "serving context only — labelled context, not a "
+                    "substitute measurement",
+        }
+    _emit(payload)
+
+
+def _streaming_failure(msg: str) -> None:
+    _emit({"metric": STREAMING_METRIC, "value": None, "unit": "x",
+           "error": msg})
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "streaming":
+        try:
+            streaming_main()
+        except SystemExit:
+            raise
+        except BaseException as e:  # noqa: BLE001 — artifact must parse
+            _streaming_failure(f"{type(e).__name__}: {e}")
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         try:
             ap = argparse.ArgumentParser(prog="bench.py serving")

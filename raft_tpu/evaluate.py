@@ -69,7 +69,8 @@ class FlowPredictor:
 
     def __init__(self, model, variables, iters: int = 32,
                  batch_size: Optional[int] = None, mesh=None,
-                 corr_impl: str = "fixed"):
+                 corr_impl: str = "fixed",
+                 warm_iters: Optional[int] = None):
         if corr_impl not in ("fixed", "auto"):
             raise ValueError(f"corr_impl must be 'fixed' or 'auto', "
                              f"got {corr_impl!r}")
@@ -98,6 +99,17 @@ class FlowPredictor:
                                         corr_dtype="auto")))
         self.variables = variables
         self.iters = iters
+        # Warm-frame iteration count for the streaming refine path
+        # (None → same as iters). RAFT accuracy is near-monotone in GRU
+        # iterations and a warm frame starts from the propagated
+        # previous flow, so streams trade a few iterations for latency
+        # without falling off a cliff (the paper's warm-start mode).
+        # Part of the refine executable's cache key, so changing it
+        # mid-run compiles a new executable rather than corrupting a
+        # cached one.
+        if warm_iters is not None and warm_iters < 1:
+            raise ValueError(f"warm_iters must be >= 1, got {warm_iters}")
+        self.warm_iters = warm_iters
         # Resolved RAFT_GRU_PALLAS mode ('auto'/'0'/'1') — validated here
         # so bad values fail at build time, recorded for observability
         # (bench/serving annotate payloads with it). The actual dispatch
@@ -151,10 +163,13 @@ class FlowPredictor:
                 else allpairs)
 
     def _fn(self, shape, warm: bool) -> Callable:
-        # Donation only applies to the plain-jit path: warm start feeds
-        # flow_init alongside the images (kept simple), and spatial_jit
-        # manages its own sharding/placement.
-        donate = bool(self.donate_images) and not warm and self.mesh is None
+        # Donation applies to the plain-jit path, warm included: only
+        # the image buffers (argnums 1, 2) are donated — flow_init (arg
+        # 3) is fresh host data each call and is left alone, so
+        # donate+warm compose instead of silently disabling donation
+        # (which blocked TPU-default configs from ever warm-starting).
+        # spatial_jit manages its own sharding/placement.
+        donate = bool(self.donate_images) and self.mesh is None
         key = (shape, warm, self.iters, donate)
         if key not in self._cache:
             if self.mesh is not None:
@@ -262,6 +277,100 @@ class FlowPredictor:
         (B, H, W, 2)) numpy."""
         flow_low, flow_up = self.dispatch_batch(images1, images2)
         return np.asarray(flow_low), np.asarray(flow_up)
+
+    # ----- streaming (session) entry points -------------------------------
+    # The stateless forward runs fnet twice per pair (twin-image trick).
+    # For a temporally coherent stream, frame t's fmap2 IS frame t+1's
+    # fmap1, so the session path splits the forward into two jitted
+    # entry points: encode (fnet only) and refine (corr + cnet + scan,
+    # fed precomputed fmaps) — one encoder pass per warm frame instead
+    # of two, plus fewer GRU iterations when warm. Cache keys extend the
+    # stateless (shape, warm, iters, donate) convention so warm and cold
+    # frames hit distinct pre-warmed executables (the serving engine's
+    # zero-post-warmup-compile contract covers all three).
+
+    def _require_session_path(self, what: str) -> None:
+        from raft_tpu.models.raft import RAFT
+        if self.mesh is not None:
+            raise ValueError(
+                f"the streaming {what} path is not supported with "
+                "spatially-sharded eval — the cached feature maps would "
+                "need their own sharding specs")
+        if not isinstance(self.model, RAFT):
+            raise ValueError(
+                f"the streaming {what} path applies to the canonical "
+                "RAFT family only (other families have no split "
+                "encode/refine entry point)")
+
+    def encode_dispatch(self, images):
+        """Non-blocking encoder-only forward: (B, H, W, 3) image stack →
+        (B, H/8, W/8, C) *device* feature map (fnet, inference mode).
+        The input stack is donated when ``donate_images`` is on (it is a
+        fresh host buffer every call in the serving steady state); the
+        returned fmap is NOT donated anywhere — the engine syncs and
+        slices it into per-session host caches."""
+        img = jnp.asarray(images)
+        key = (img.shape, "encode")
+        if key not in self._cache:
+            self._require_session_path("encode")
+            from raft_tpu.models.raft import RAFT
+            donate = bool(self.donate_images) and self.mesh is None
+
+            def run(variables, images):
+                return self.model.apply(variables, images,
+                                        method=RAFT.encode_features)
+
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1,) if donate else ())
+        return self._cache[key](self.variables, img)
+
+    def refine_dispatch(self, images1, fmap1, fmap2, flow_init=None,
+                        warm: bool = False):
+        """Non-blocking refine-only forward with precomputed feature
+        maps: (B, H, W, 3) first images (cnet input), (B, H/8, W/8, C)
+        fmaps → ``(flow_low, flow_up)`` device arrays.
+
+        ``warm=True`` requires ``flow_init`` (B, H/8, W/8, 2) and runs
+        ``warm_iters`` (→ ``iters`` when unset); cold refine takes no
+        flow_init argument at all — a distinct executable, same contract
+        as the stateless warm/cold split. Donated when enabled: images1
+        and fmap1 (both fresh per-batch host buffers). fmap2 is NEVER
+        donated — it is the encode output the engine syncs after this
+        dispatch to seed the next frame's fmap1 caches."""
+        if warm and flow_init is None:
+            raise ValueError("warm refine requires flow_init")
+        if not warm and flow_init is not None:
+            raise ValueError("cold refine takes no flow_init (warm=True "
+                             "selects the warm executable)")
+        img1 = jnp.asarray(images1)
+        fm1 = jnp.asarray(fmap1)
+        fm2 = jnp.asarray(fmap2)
+        iters_used = (self.warm_iters if warm and self.warm_iters
+                      else self.iters)
+        donate = bool(self.donate_images) and self.mesh is None
+        key = (img1.shape, ("refine", bool(warm)), iters_used, donate)
+        if key not in self._cache:
+            self._require_session_path("refine")
+            model = self._pick_engine(img1.shape)
+            if warm:
+                def run(variables, image1, fmap1, fmap2, flow_init,
+                        model=model):
+                    return model.apply(
+                        variables, image1, None, iters=iters_used,
+                        flow_init=flow_init, fmap1=fmap1, fmap2=fmap2,
+                        test_mode=True)
+            else:
+                def run(variables, image1, fmap1, fmap2, model=model):
+                    return model.apply(
+                        variables, image1, None, iters=iters_used,
+                        fmap1=fmap1, fmap2=fmap2, test_mode=True)
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1, 2) if donate else ())
+        fn = self._cache[key]
+        if warm:
+            return fn(self.variables, img1, fm1, fm2,
+                      jnp.asarray(flow_init))
+        return fn(self.variables, img1, fm1, fm2)
 
 
 def _predict_dataset(predictor, dataset, mode: Optional[str] = None):

@@ -158,13 +158,38 @@ class RAFT(nn.Module):
             self.cnet = BasicEncoder(cfg.hdim + cfg.cdim, "batch",
                                      cfg.dropout, dtype=dtype)
 
+    def encode_features(self, image):
+        """Feature-encoder (fnet) pass alone, inference mode: [0, 255]
+        NHWC image → feature map at 1/8 resolution.
+
+        The streaming serving path uses this as its own jitted entry
+        point: for a temporally coherent stream, frame t's ``fmap2`` is
+        frame t+1's ``fmap1``, so each warm frame needs exactly ONE
+        encoder pass plus a cached map handed to ``__call__`` via the
+        ``fmap1``/``fmap2`` kwargs. fnet uses instance norm (per-sample
+        statistics), so encoding images separately is mathematically
+        identical to the twin-image concatenated pass in ``__call__`` —
+        parity is executable-level, not bit-exact, hence the tolerance
+        tests in tests/test_streaming.py.
+        """
+        dtype = (jnp.bfloat16 if self.config.mixed_precision
+                 else jnp.float32)
+        x = 2.0 * (image.astype(dtype) / 255.0) - 1.0
+        return self.fnet(x, train=False, deterministic=True)
+
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
                  flow_init=None, test_mode: bool = False,
-                 train: bool = False, freeze_bn: bool = False):
+                 train: bool = False, freeze_bn: bool = False,
+                 fmap1=None, fmap2=None):
         """``freeze_bn`` keeps BatchNorm in eval (running-average) mode
         while the rest trains — the reference's post-chairs freeze
-        (``core/raft.py:60-63``, ``train.py:414-415``)."""
+        (``core/raft.py:60-63``, ``train.py:414-415``).
+
+        ``fmap1``/``fmap2``: precomputed feature maps (both or neither,
+        from :meth:`encode_features`). When given, the fnet pass is
+        skipped entirely and ``image2`` may be ``None`` — the
+        refine-only entry point of the streaming serving path."""
         cfg = self.config
         norm_train = train and not freeze_bn
         iters = iters if iters is not None else cfg.iters
@@ -181,15 +206,23 @@ class RAFT(nn.Module):
             raise ValueError("normalized_coords is not supported by the "
                              "canonical RAFT path")
 
+        if (fmap1 is None) != (fmap2 is None):
+            raise ValueError("fmap1 and fmap2 must be given together")
+
         dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
         image1 = 2.0 * (image1.astype(dtype) / 255.0) - 1.0
-        image2 = 2.0 * (image2.astype(dtype) / 255.0) - 1.0
 
-        # Twin-image trick: one fnet pass over both images concatenated on
-        # the batch axis (reference extractor_origin.py:168-171).
-        fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
-                          train=norm_train, deterministic=not train)
-        fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        if fmap1 is None:
+            image2 = 2.0 * (image2.astype(dtype) / 255.0) - 1.0
+            # Twin-image trick: one fnet pass over both images
+            # concatenated on the batch axis (reference
+            # extractor_origin.py:168-171).
+            fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                              train=norm_train, deterministic=not train)
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        else:
+            fmap1 = fmap1.astype(dtype)
+            fmap2 = fmap2.astype(dtype)
 
         corr_state = _build_corr_state(cfg, fmap1, fmap2,
                                        inference=bool(test_mode))

@@ -34,6 +34,12 @@ forward itself:
   response-level failover, fleet-wide rolling hot reload
   (canary-one-then-wave, whole-fleet rollback on drift), and
   fleet-aggregated metrics.
+* :mod:`~raft_tpu.serving.session` — stateful streaming sessions
+  (``open_stream``): warm-start ``flow_init`` from the previous pair's
+  flow at reduced ``warm_iters``, plus encoder feature-map reuse (one
+  fnet pass per warm frame instead of two). The fleet adds sticky
+  rendezvous pinning with state-drop + cold-restart failover
+  (:class:`~raft_tpu.serving.fleet.FleetStreamSession`).
 """
 
 from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
@@ -45,13 +51,15 @@ from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
                                      make_engine)
 from raft_tpu.serving.fleet import (BucketRouter, FleetMetrics,
                                     FleetReloadConfig, FleetReloader,
-                                    ServingFleet, make_fleet)
+                                    FleetStreamSession, ServingFleet,
+                                    make_fleet)
 from raft_tpu.serving.health import (CircuitBreaker, EngineUnhealthy,
                                      HEALTH_CODES, ROUTABLE, is_routable)
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
 from raft_tpu.serving.reload import (CanaryResult, HotReloader,
                                      ReloadConfig, load_step_variables)
+from raft_tpu.serving.session import StreamSession
 
 __all__ = [
     "BacklogFull",
@@ -63,6 +71,7 @@ __all__ = [
     "FleetMetrics",
     "FleetReloadConfig",
     "FleetReloader",
+    "FleetStreamSession",
     "HEALTH_CODES",
     "HotReloader",
     "PRIORITIES",
@@ -77,6 +86,7 @@ __all__ = [
     "ServingFleet",
     "ServingMetrics",
     "ShapeBucketBatcher",
+    "StreamSession",
     "enable_persistent_compile_cache",
     "is_routable",
     "load_step_variables",

@@ -48,14 +48,25 @@ class QueuedRequest:
     submit timestamp (latency accounting + batching deadline), an
     optional queue-timeout deadline (monotonic; ``None`` = wait
     forever), its priority class, a fault-injection poison mark, and
-    the future the client is waiting on."""
+    the future the client is waiting on.
+
+    Stream (session) requests additionally carry ``session`` (the
+    :class:`~raft_tpu.serving.session.StreamSession` whose state the
+    completion updates), the cached ``fmap1`` host feature map of
+    ``image1``, and — warm frames only — the forward-splatted
+    ``flow_init``. Their bucket keys extend the padded-shape tuple with
+    a ``"warm"``/``"cold"`` tag so warm frames batch separately from
+    cold (distinct executables, different iteration counts); the
+    batcher itself is generic over hashable bucket keys."""
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
-                 "deadline", "priority", "poisoned", "future")
+                 "deadline", "priority", "poisoned", "session",
+                 "flow_init", "fmap1", "future")
 
-    def __init__(self, image1, image2, padder, bucket: Tuple[int, int],
+    def __init__(self, image1, image2, padder, bucket,
                  t_submit: float, deadline: Optional[float] = None,
-                 priority: str = PRIORITY_HIGH, poisoned: bool = False):
+                 priority: str = PRIORITY_HIGH, poisoned: bool = False,
+                 session=None, flow_init=None, fmap1=None):
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -67,6 +78,9 @@ class QueuedRequest:
         self.deadline = deadline
         self.priority = priority
         self.poisoned = poisoned
+        self.session = session
+        self.flow_init = flow_init
+        self.fmap1 = fmap1
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:

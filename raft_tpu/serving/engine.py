@@ -176,6 +176,19 @@ class ServingConfig:
         future is stamped with ``future.replica_id`` so load
         generators and fleet drills can attribute each response (and
         each failure) to the engine that produced it.
+      warm_buckets: raw ``(H, W)`` shapes expected to carry *stream*
+        traffic (``open_stream``). Warmup pre-compiles the session
+        path's three executables per shape — encode, cold refine (full
+        ``iters``), warm refine (``warm_iters``) — and their
+        ``(padded, "warm")``/``(padded, "cold")`` dispatch streams are
+        dedicated (never LRU-retired). Stream traffic outside this set
+        still serves, paying first-contact compiles.
+      warm_iters: GRU iterations for WARM stream pairs (cold pairs and
+        stateless requests keep the predictor's full ``iters``). The
+        streaming quality/latency dial: warm frames start from the
+        propagated previous flow, so they converge in fewer iterations.
+        ``None`` leaves the predictor's own ``warm_iters`` (→ full
+        ``iters`` when unset there too).
     """
 
     max_batch: int = 8
@@ -192,6 +205,8 @@ class ServingConfig:
     breaker_threshold: int = 5
     breaker_cooldown_s: float = 30.0
     replica_id: Optional[str] = None
+    warm_buckets: Tuple[Tuple[int, int], ...] = ()
+    warm_iters: Optional[int] = None
 
 
 class _BucketStream:
@@ -226,7 +241,7 @@ class _BucketStream:
         self.work: queue.Queue = queue.Queue()
         self.inflight: queue.Queue = queue.Queue(
             maxsize=max(engine.config.pipeline_depth, 1))
-        name = f"serving-{bucket[0]}x{bucket[1]}"
+        name = "serving-" + "x".join(str(p) for p in bucket)
         self.dispatcher = threading.Thread(
             target=self._dispatch_loop, name=f"{name}-dispatch",
             daemon=True)
@@ -276,9 +291,13 @@ class _BucketStream:
             if item is None:
                 break
             batch, out = item
+            is_stream = bool(batch) and batch[0].session is not None
             try:
                 with eng.stages.stage("sync"):
                     flow_up = np.asarray(out[1])   # blocks until done
+                    if is_stream:
+                        flow_low = np.asarray(out[0])
+                        fmap2 = np.asarray(out[2])
             except Exception as e:
                 with eng._state_lock:
                     eng._inflight_batches -= 1
@@ -291,6 +310,14 @@ class _BucketStream:
             now = time.monotonic()
             with eng.stages.stage("unpad"):
                 for j, r in enumerate(batch):
+                    if is_stream:
+                        # State handoff BEFORE resolving the future:
+                        # this pair's fmap2 slice is the session's next
+                        # fmap1, its low-res flow the next flow_init
+                        # seed. The client's next submit serializes on
+                        # the future, so it always sees restored state.
+                        r.session._complete(fmap2[j:j + 1].copy(),
+                                            flow_low[j].copy())
                     r.future.set_result(r.padder.unpad(flow_up[j]))
                     eng.metrics.record_done(now - r.t_submit)
 
@@ -330,6 +357,11 @@ class ServingEngine:
             donate = jax.default_backend() == "tpu"
         predictor.donate_images = donate
         self._donate = donate
+        if self.config.warm_iters is not None:
+            # Part of the refine executable cache key — set before any
+            # warmup/serve compile so warm buckets warm the right
+            # executable.
+            predictor.warm_iters = self.config.warm_iters
         self.metrics = ServingMetrics()
         self.stages = HostStageTimer()
         self.breaker = CircuitBreaker(
@@ -346,11 +378,20 @@ class ServingEngine:
         # permanent; dynamic (out-of-bucket) streams are capped at
         # max_dynamic_streams, retired LRU-first into _retired where
         # they drain and exit (joined at close).
-        self._streams: Dict[Tuple[int, int], _BucketStream] = {}
+        self._streams: Dict[Tuple, _BucketStream] = {}
+        # Stateless buckets key on the padded (H, W); stream (session)
+        # buckets extend it with a "warm"/"cold" tag — warm frames batch
+        # separately from cold (different executables and iteration
+        # counts), and both tags of a configured warm bucket keep
+        # permanent dispatch streams.
         self._dedicated_buckets = frozenset(
             InputPadder((*hw, 3), mode=self.config.pad_mode,
                         factor=self.config.factor).padded_shape
-            for hw in self.config.buckets)
+            for hw in self.config.buckets) | frozenset(
+            (*InputPadder((*hw, 3), mode=self.config.pad_mode,
+                          factor=self.config.factor).padded_shape, kind)
+            for hw in self.config.warm_buckets
+            for kind in ("warm", "cold"))
         self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
@@ -367,6 +408,7 @@ class ServingEngine:
         self._degraded_reasons: set = set()
         self._state_lock = threading.Lock()
         self._submit_seq = 0
+        self._stream_seq = 0
         m = self.metrics
         m.set_gauge_source("queue_depth", self.batcher.pending)
         m.set_gauge_source("inflight_batches",
@@ -381,7 +423,7 @@ class ServingEngine:
     def start(self, warmup: bool = True) -> "ServingEngine":
         if self._started:
             raise RuntimeError("engine already started")
-        if warmup and self.config.buckets:
+        if warmup and (self.config.buckets or self.config.warm_buckets):
             self.warmup()
         self._router = threading.Thread(
             target=self._route_loop, name="serving-route", daemon=True)
@@ -398,8 +440,15 @@ class ServingEngine:
         triggers a fresh XLA compile. Returns per-bucket
         ``{"compiles": n, "seconds": s}`` stats. ``buckets`` overrides
         the configured set (the fleet warms spare buckets through it —
-        cache hits when the executable cache is shared)."""
-        stats: Dict[Tuple[int, int], Dict[str, float]] = {}
+        cache hits when the executable cache is shared).
+
+        ``warm_buckets`` (configured-set runs only) each warm the
+        session path's three executables — encode, cold refine, warm
+        refine — through the exact stream-dispatch code, recorded under
+        the ``(ph, pw, "session")`` key. With that done, mixed
+        warm/cold stream traffic on those shapes runs at zero
+        post-warmup compiles, the same contract as stateless buckets."""
+        stats: Dict[Tuple, Dict[str, float]] = {}
         self._warming = True
         try:
             for raw_hw in (self.config.buckets
@@ -420,9 +469,41 @@ class ServingEngine:
                     np.asarray(out[1])        # sync: compile + one run
                 stats[(ph, pw)] = {"compiles": float(w.compiles),
                                    "seconds": time.perf_counter() - t0}
+            for raw_hw in (self.config.warm_buckets
+                           if buckets is None else ()):
+                stats.update(self._warmup_session_bucket(raw_hw))
         finally:
             self._warming = False
         return stats
+
+    def _warmup_session_bucket(self, raw_hw) -> Dict[Tuple, Dict]:
+        """Pre-compile one stream bucket's encode / cold-refine /
+        warm-refine executables through the real session dispatch
+        entries (``encode_dispatch`` / ``refine_dispatch``)."""
+        padder = InputPadder((*raw_hw, 3), mode=self.config.pad_mode,
+                             factor=self.config.factor)
+        ph, pw = padder.padded_shape
+        mb = self.config.max_batch
+        t0 = time.perf_counter()
+        with CompileWatch() as w:
+            z = np.zeros((mb, ph, pw, 3), np.float32)
+            fm = np.asarray(self.predictor.encode_dispatch(z))
+            # Distinct host copies per donated arg (fmap1 is donated,
+            # fmap2 never — it's the cache handoff the completion
+            # thread syncs).
+            out = self.predictor.refine_dispatch(
+                np.zeros_like(z), fm.copy(), fm)
+            np.asarray(out[1])
+            # flow_init lives at the model's stride-8 feature
+            # resolution (independent of the pad factor)
+            init = np.zeros((mb, ph // 8, pw // 8, 2), np.float32)
+            out = self.predictor.refine_dispatch(
+                np.zeros_like(z), fm.copy(), fm, flow_init=init,
+                warm=True)
+            np.asarray(out[1])
+        return {(ph, pw, "session"): {
+            "compiles": float(w.compiles),
+            "seconds": time.perf_counter() - t0}}
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting requests, drain every queued/in-flight request
@@ -556,24 +637,7 @@ class ServingEngine:
         (background class: batched after HIGH, first shed under a full
         backlog). Thread-safe.
         """
-        if not self._started:
-            raise RuntimeError("engine not started (call start())")
-        if self._closed:
-            raise RuntimeError("engine is closed")
-        if self._fatal is not None:
-            raise RuntimeError(
-                "serving engine hit a fatal dispatch error") \
-                from self._fatal
-        if not self.breaker.admits():
-            # Fail fast: the device path is failing consistently;
-            # queueing would only delay the same failure.
-            self.metrics.record_breaker_fastfail()
-            self.metrics.record_reject()
-            raise EngineUnhealthy(
-                f"circuit breaker open after "
-                f"{self.breaker.consecutive_failures} consecutive "
-                f"dispatch failures; retrying after "
-                f"{self.config.breaker_cooldown_s:.1f}s cooldown")
+        self._check_accepting()
         if image1.shape != image2.shape:
             raise ValueError(f"frame shapes differ: {image1.shape} vs "
                              f"{image2.shape}")
@@ -592,6 +656,33 @@ class ServingEngine:
                             priority=priority,
                             poisoned=active_injector()
                             .poisons_request(seq))
+        return self._enqueue_request(req)
+
+    def _check_accepting(self) -> None:
+        """The submit-time admission gates, shared by the stateless and
+        stream paths."""
+        if not self._started:
+            raise RuntimeError("engine not started (call start())")
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self._fatal is not None:
+            raise RuntimeError(
+                "serving engine hit a fatal dispatch error") \
+                from self._fatal
+        if not self.breaker.admits():
+            # Fail fast: the device path is failing consistently;
+            # queueing would only delay the same failure.
+            self.metrics.record_breaker_fastfail()
+            self.metrics.record_reject()
+            raise EngineUnhealthy(
+                f"circuit breaker open after "
+                f"{self.breaker.consecutive_failures} consecutive "
+                f"dispatch failures; retrying after "
+                f"{self.config.breaker_cooldown_s:.1f}s cooldown")
+
+    def _enqueue_request(self, req: QueuedRequest):
+        """Stamp, enqueue, and account one built request; returns its
+        future (shared tail of the stateless and stream submit paths)."""
         if self.config.replica_id is not None:
             # Response attribution inside a fleet: loadgen and the
             # fleet drills read this off the future to name the engine
@@ -602,7 +693,7 @@ class ServingEngine:
         except BacklogFull:
             # Shed counted on top of the rejection: the shed rate is
             # the capacity signal, the reject total the error rate.
-            self.metrics.record_shed(priority)
+            self.metrics.record_shed(req.priority)
             self.metrics.record_reject()
             raise
         except RuntimeError:
@@ -617,8 +708,71 @@ class ServingEngine:
             self.metrics.record_shed(evicted.priority)
             self.metrics.record_reject()
         self.metrics.record_submit(self.batcher.pending(),
-                                   priority=priority)
+                                   priority=req.priority)
         return req.future
+
+    # -- streaming (session) API ----------------------------------------
+
+    def open_stream(self, stream_id: Optional[str] = None):
+        """Open a :class:`~raft_tpu.serving.session.StreamSession`
+        against this engine — the stateful per-stream API: feed frames
+        one at a time, the session carries the previous flow (warm
+        start) and the previous frame's feature map (encoder cache)
+        between them. Cheap: no resources are held until the first
+        frame arrives."""
+        from raft_tpu.serving.session import StreamSession
+        if stream_id is None:
+            with self._state_lock:
+                self._stream_seq += 1
+                stream_id = f"stream-{self._stream_seq}"
+        return StreamSession(self, stream_id)
+
+    def _prime_encode(self, padded_frame: np.ndarray) -> np.ndarray:
+        """Standalone encode of one padded frame (session prime /
+        re-prime): tail-pad to the bucket's ``max_batch`` so it reuses
+        the SAME encode executable the stream batches run — a prime
+        never compiles on a warmed bucket. Synchronous, in the client
+        thread (like padding, host prep rides the producers). Returns
+        the ``(1, H/8, W/8, C)`` host feature map."""
+        self._check_accepting()
+        stack = np.repeat(padded_frame[None], self.config.max_batch, 0)
+        with self._swap_lock:
+            predictor = self.predictor
+        c0 = xla_compile_count()
+        fmap = predictor.encode_dispatch(stack)
+        out = np.asarray(fmap)[:1].copy()
+        self.metrics.record_encoder_cache(hit=False)
+        compiles = xla_compile_count() - c0
+        if compiles:
+            self.metrics.record_batch(1, 1, compiles=compiles)
+        return out
+
+    def _submit_stream(self, session, image1, image2, padder, fmap1,
+                       flow_init, priority: str = PRIORITY_HIGH):
+        """Enqueue one stream pair (called by ``StreamSession.submit``
+        with already-padded frames and the cached fmap1). Warm pairs
+        (``flow_init`` given) and cold pairs batch in separate
+        ``(ph, pw, "warm"/"cold")`` buckets — distinct executables,
+        distinct iteration counts — alongside, never inside, stateless
+        traffic."""
+        self._check_accepting()
+        warm = flow_init is not None
+        t_submit = time.monotonic()
+        timeout = self.config.queue_timeout_ms
+        deadline = (t_submit + timeout / 1e3) if timeout else None
+        with self._state_lock:
+            self._submit_seq += 1
+            seq = self._submit_seq
+        req = QueuedRequest(
+            image1, image2, padder,
+            bucket=(*padder.padded_shape, "warm" if warm else "cold"),
+            t_submit=t_submit, deadline=deadline, priority=priority,
+            poisoned=active_injector().poisons_request(seq),
+            session=session, flow_init=flow_init, fmap1=fmap1)
+        fut = self._enqueue_request(req)
+        self.metrics.record_stream_submit(warm)
+        self.metrics.record_encoder_cache(hit=True)
+        return fut
 
     def predict(self, image1: np.ndarray, image2: np.ndarray,
                 timeout: Optional[float] = 120.0) -> np.ndarray:
@@ -726,6 +880,45 @@ class ServingEngine:
             predictor = self.predictor
         return predictor.dispatch_batch(i1, i2)
 
+    def _dispatch_stream_arrays(self, batch: List[QueuedRequest]):
+        """Stack and dispatch one stream (session) batch: ONE encoder
+        pass over the new frames, cached fmap1s re-fed from the
+        sessions' host caches, then the warm or cold refine executable.
+        Returns device ``(flow_low, flow_up, fmap2)`` — fmap2 rides
+        along so the completion thread can hand each slice back to its
+        session as the next pair's fmap1. Same fault-injection and
+        swap-lock contract as ``_dispatch_arrays``; numpy-only host
+        prep (eager ``jnp`` stacking would compile tiny executables and
+        break the zero-compile contract)."""
+        n = len(batch)
+        mb = self.config.max_batch
+        warm = batch[0].flow_init is not None
+        with self.stages.stage("stack"):
+            i1 = np.stack([r.image1 for r in batch])
+            i2 = np.stack([r.image2 for r in batch])
+            fm1 = np.concatenate([r.fmap1 for r in batch])
+            finit = (np.stack([r.flow_init for r in batch])
+                     if warm else None)
+            if n < mb:
+                reps = mb - n
+                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
+                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
+                fm1 = np.concatenate([fm1, np.repeat(fm1[-1:], reps, 0)])
+                if warm:
+                    finit = np.concatenate(
+                        [finit, np.repeat(finit[-1:], reps, 0)])
+        inj = active_injector()
+        if any(r.poisoned for r in batch):
+            raise RuntimeError(
+                "injected poisoned input in dispatched batch")
+        inj.maybe_fail_serving_dispatch()
+        with self._swap_lock:
+            predictor = self.predictor
+        fmap2 = predictor.encode_dispatch(i2)
+        flow_low, flow_up = predictor.refine_dispatch(
+            i1, fm1, fmap2, flow_init=finit, warm=warm)
+        return flow_low, flow_up, fmap2
+
     def _dispatch_one(self, batch: List[QueuedRequest],
                       inflight: queue.Queue) -> None:
         # Expire requests whose time-in-queue budget ran out while they
@@ -755,14 +948,17 @@ class ServingEngine:
             self.metrics.record_error(len(batch))
             return
         n = len(batch)
-        i1, i2 = self._stack(batch)
         c0 = xla_compile_count()
         try:
             with self.stages.stage("dispatch"):
                 # Non-blocking: device_put + async dispatch. The device
                 # computes while this thread loops back to stack the
                 # next batch.
-                out = self._dispatch_arrays(batch, i1, i2)
+                if batch[0].session is not None:
+                    out = self._dispatch_stream_arrays(batch)
+                else:
+                    i1, i2 = self._stack(batch)
+                    out = self._dispatch_arrays(batch, i1, i2)
         except Exception as e:
             self.breaker.record_failure()
             self._isolate_failed_batch(batch, e)
@@ -791,16 +987,29 @@ class ServingEngine:
             self.metrics.record_error(len(batch))
             return
         for r in batch:
+            is_stream = r.session is not None
             try:
-                i1, i2 = self._stack([r])
-                out = self._dispatch_arrays([r], i1, i2)
-                with self.stages.stage("sync"):
-                    flow_up = np.asarray(out[1])
+                if is_stream:
+                    out = self._dispatch_stream_arrays([r])
+                    with self.stages.stage("sync"):
+                        flow_up = np.asarray(out[1])
+                        flow_low = np.asarray(out[0])
+                        fmap2 = np.asarray(out[2])
+                else:
+                    i1, i2 = self._stack([r])
+                    out = self._dispatch_arrays([r], i1, i2)
+                    with self.stages.stage("sync"):
+                        flow_up = np.asarray(out[1])
             except Exception as e:
+                # A failed stream pair drops its session state: the
+                # fmap/flow handoff was consumed at submit, so the next
+                # submit on that session re-primes and restarts cold.
                 r.future.set_exception(e)
                 self.metrics.record_error(1)
                 self.breaker.record_failure()
                 continue
+            if is_stream:
+                r.session._complete(fmap2[:1].copy(), flow_low[0].copy())
             r.future.set_result(r.padder.unpad(flow_up[0]))
             self.metrics.record_done(time.monotonic() - r.t_submit)
             self.metrics.record_isolated_retry()

@@ -67,6 +67,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import hashlib
+import itertools
 import logging
 import threading
 from collections import Counter, OrderedDict
@@ -120,10 +121,19 @@ class BucketRouter:
         self._ids: List[str] = list(dict.fromkeys(replica_ids))
 
     @staticmethod
+    def _score_key(key: str, replica_id: str) -> int:
+        digest = hashlib.blake2b(
+            f"{key}|{replica_id}".encode(), digest_size=8).digest()
+        return int.from_bytes(digest, "big")
+
+    @staticmethod
     def _score(bucket: Bucket, replica_id: str) -> int:
-        key = f"{bucket[0]}x{bucket[1]}|{replica_id}".encode()
-        return int.from_bytes(
-            hashlib.blake2b(key, digest_size=8).digest(), "big")
+        # Bucket keys render as "HxW" — the historical digest input, so
+        # assignments stay stable across this refactor (golden tests
+        # pin them). Stream keys use a "stream:" prefix and can never
+        # collide with the "HxW" namespace.
+        return BucketRouter._score_key(
+            f"{bucket[0]}x{bucket[1]}", replica_id)
 
     @property
     def replica_ids(self) -> List[str]:
@@ -136,6 +146,16 @@ class BucketRouter:
     def remove_replica(self, replica_id: str) -> None:
         if replica_id in self._ids:
             self._ids.remove(replica_id)
+
+    def owners_for_key(self, key: str) -> List[str]:
+        """All replicas in preference order for an arbitrary string
+        key — the same rendezvous scoring buckets use, so any stable
+        identifier (e.g. ``"stream:<id>"``) gets a deterministic,
+        minimal-churn preference chain."""
+        return sorted(
+            self._ids,
+            key=lambda rid: (self._score_key(key, rid), rid),
+            reverse=True)
 
     def owners(self, bucket: Bucket) -> List[str]:
         """All replicas in preference order for ``bucket`` (index 0 is
@@ -359,6 +379,7 @@ class ServingFleet:
         self.metrics = FleetMetrics(lambda: self._engines)
         self.warmup_stats: Dict[str, Dict[str, float]] = {}
         self._killed: Dict[str, object] = {}   # rid -> live predictor
+        self._stream_seq = itertools.count()
         # Attached by FleetReloader: adds the weight-sync gate to
         # routing (replicas serving a stale step take no traffic).
         self._reloader: Optional["FleetReloader"] = None
@@ -394,7 +415,12 @@ class ServingFleet:
         for rid, eng in self._engines.items():
             stats: Dict[str, float] = {"seconds": 0.0, "compiles": 0.0,
                                        "buckets": 0.0}
-            if warmup and eng.config.buckets:
+            if warmup and (eng.config.buckets or eng.config.warm_buckets):
+                # Stateless buckets are replica-owned (split by the
+                # router); warm_buckets stay on EVERY replica so a
+                # pinned stream can cold-restart anywhere — with the
+                # shared executable cache only the first replica's warm
+                # pays compiles, the rest are cache hits.
                 for per_bucket in eng.warmup().values():
                     stats["seconds"] += per_bucket["seconds"]
                     stats["compiles"] += per_bucket["compiles"]
@@ -518,6 +544,20 @@ class ServingFleet:
         """Synchronous convenience wrapper over :meth:`submit`."""
         return self.submit(image1, image2).result(timeout)
 
+    def open_stream(self, stream_id: Optional[str] = None
+                    ) -> "FleetStreamSession":
+        """Open a sticky streaming session against the fleet: the
+        stream rendezvous-pins to one replica (state is replica-local —
+        spraying frames across replicas would cold-start every pair)
+        and fails over with an explicit state drop + cold restart when
+        its replica dies. Same frame-at-a-time surface as
+        ``ServingEngine.open_stream``."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        if stream_id is None:
+            stream_id = f"stream-{next(self._stream_seq)}"
+        return FleetStreamSession(self, stream_id)
+
     def _dispatch(self, outer, image1, image2, priority, bucket: Bucket,
                   tried: set, hops: int, last_exc) -> None:
         """Walk the bucket's owner-preference chain and hand the
@@ -608,6 +648,241 @@ class ServingFleet:
         reloader = self._reloader
         if reloader is not None:
             reloader.resync_replica(replica_id)
+
+
+# -- sticky streaming ---------------------------------------------------
+
+_STREAM_COUNTERS = ("pairs", "warm_pairs", "cold_pairs",
+                    "encoder_hits", "encoder_misses")
+
+
+class FleetStreamSession:
+    """A streaming session pinned to one replica, with failover.
+
+    Stream state (previous frame, cached fmap, previous flow) lives in
+    a replica-local :class:`~raft_tpu.serving.session.StreamSession`,
+    so unlike stateless traffic a stream cannot be balanced per
+    request: it **rendezvous-pins** to the first routable replica in
+    ``BucketRouter.owners_for_key("stream:<id>")`` preference order —
+    deterministic across restarts, and spreading streams uniformly
+    across the fleet without any shared assignment table.
+
+    When the pinned replica fails a pair (mid-flight death) or refuses
+    a submit (breaker OPEN, closed), the session **drops its state
+    explicitly** and cold-restarts on the next routable replica in the
+    chain: re-prime from the held previous raw frame (an honest extra
+    encoder MISS), resubmit the pair cold (no ``flow_init``), warm
+    resumes on the pair after. The client's future never sees the hop —
+    zero dropped responses (``scripts/serve_drill.py --drill
+    streaming``) — and with the fleet's shared executable cache the
+    restart compiles nothing. ``RequestTimedOut`` is never failed over
+    (the client's queue budget is spent), matching ``ServingFleet
+    .submit``.
+
+    Single-client like the engine session: ``submit`` serializes on the
+    previous pair's (outer) future, so failover for pair N fully
+    settles before pair N+1 touches the session.
+    """
+
+    def __init__(self, fleet: ServingFleet, stream_id: str):
+        self.fleet = fleet
+        self.stream_id = stream_id
+        self.failovers = 0
+        self._key = f"stream:{stream_id}"
+        self._session = None           # replica-local StreamSession
+        self._replica_id: Optional[str] = None
+        self._prev_raw: Optional[np.ndarray] = None   # last raw frame
+        self._base = {k: 0 for k in _STREAM_COUNTERS}
+        self._pending = None
+        self._lock = threading.Lock()
+
+    # -- pinning --------------------------------------------------------
+
+    @property
+    def replica_id(self) -> Optional[str]:
+        """The replica currently holding this stream's state (``None``
+        before the first frame)."""
+        return self._replica_id
+
+    def _attach(self, tried: set) -> str:
+        """Pin the first routable replica (preference order, minus
+        ``tried``) and open a fresh engine session there. Raises
+        :class:`EngineUnhealthy` when the chain is exhausted."""
+        self._detach()
+        for rid in self.fleet.router.owners_for_key(self._key):
+            if rid not in tried and self.fleet._routable(rid):
+                eng = self.fleet.engines[rid]
+                self._session = eng.open_stream(
+                    f"{self.stream_id}@{rid}")
+                self._replica_id = rid
+                return rid
+        raise EngineUnhealthy(
+            f"no routable replica left for stream {self.stream_id} "
+            f"(tried: {', '.join(sorted(tried)) or 'none'})")
+
+    def _detach(self) -> None:
+        """Drop the current engine session, folding its counters into
+        the stream's running totals first."""
+        if self._session is None:
+            return
+        s = self._session.stats()
+        for k in _STREAM_COUNTERS:
+            self._base[k] += s[k]
+        self._session.drop()
+        self._session = None
+
+    # -- client API -----------------------------------------------------
+
+    def submit(self, frame: np.ndarray, priority: str = PRIORITY_HIGH):
+        """Feed the next frame. ``None`` for a priming frame, else a
+        fleet-owned future of the pair's unpadded ``(H, W, 2)`` flow
+        (``future.replica_id`` stamped at resolution). Raises
+        :class:`EngineUnhealthy` when no routable replica accepts."""
+        if self.fleet._closed:
+            raise RuntimeError("fleet is closed")
+        # Serialize on the previous pair's OUTER future: any failover
+        # it triggered has fully settled (state re-pinned or dropped)
+        # by the time it resolves. Its error surfaced on that future
+        # already — swallowed here, the stream restarts cold.
+        pending = self._pending
+        if pending is not None:
+            try:
+                pending.result()
+            except Exception:
+                pass
+        frame = np.ascontiguousarray(frame)
+        with self._lock:
+            self._pending = None
+            tried: set = set()
+            last_exc = None
+            while True:
+                if self._session is None:
+                    try:
+                        self._attach(tried)
+                    except EngineUnhealthy as e:
+                        self.fleet.metrics.record_shed()
+                        raise e from last_exc
+                rid = self._replica_id
+                prev_raw = self._prev_raw
+                try:
+                    if (self._session.prev_frame is None
+                            and prev_raw is not None):
+                        # Fresh session mid-stream (failover or drop):
+                        # re-prime from the held previous frame so this
+                        # pair still spans (prev, frame) — cold restart.
+                        self._session.submit(prev_raw, priority)
+                    inner = self._session.submit(frame, priority)
+                except Exception as e:
+                    # Refused or died at the door: hop to the next
+                    # owner. (A timeout cannot raise here — it lands on
+                    # the inner future — so every submit-time error is
+                    # retryable.)
+                    tried.add(rid)
+                    last_exc = e
+                    self.fleet.metrics.record_retry(rid)
+                    if prev_raw is not None:
+                        self.failovers += 1
+                    self._detach()
+                    continue
+                self._prev_raw = frame
+                if inner is None:
+                    return None          # primed — no pair yet
+                primary = self.fleet.router.owners_for_key(self._key)[0]
+                self.fleet.metrics.record_routed(
+                    rid, failover=(rid != primary))
+                outer: concurrent.futures.Future = \
+                    concurrent.futures.Future()
+                outer.replica_id = None
+                tried.add(rid)
+                inner.add_done_callback(
+                    lambda f, rid=rid: self._on_reply(
+                        outer, f, rid, prev_raw, frame, priority, tried))
+                self._pending = outer
+                return outer
+
+    def drop(self) -> None:
+        """Explicitly release the stream: replica-local state is
+        dropped; a later ``submit`` re-pins and primes from scratch."""
+        with self._lock:
+            self._detach()
+            self._prev_raw = None
+            self._pending = None
+
+    def stats(self) -> dict:
+        """Stream-lifetime accounting, summed across every replica the
+        stream has lived on. Counters are per ATTEMPT, not per client
+        response: a failed-over pair was enqueued on both the dying and
+        the rescuing replica and counts on each, and the restart's
+        extra encoder MISS is visible — the numbers stay honest about
+        what failover actually cost."""
+        with self._lock:
+            out = dict(self._base)
+            if self._session is not None:
+                s = self._session.stats()
+                for k in _STREAM_COUNTERS:
+                    out[k] += s[k]
+            total = out["encoder_hits"] + out["encoder_misses"]
+            out["encoder_cache_hit_rate"] = (
+                out["encoder_hits"] / total if total else 0.0)
+            out["stream_id"] = self.stream_id
+            out["replica_id"] = self._replica_id
+            out["failovers"] = self.failovers
+            return out
+
+    # -- failover -------------------------------------------------------
+
+    def _on_reply(self, outer, inner, rid: str, prev_raw, frame,
+                  priority, tried: set) -> None:
+        exc = inner.exception()
+        if exc is None:
+            outer.replica_id = getattr(inner, "replica_id", rid)
+            outer.set_result(inner.result())
+            return
+        if isinstance(exc, RequestTimedOut) or self.fleet._closed:
+            # Queue budget spent / nothing left to hop to. The engine
+            # session's state was consumed and not restored, so the
+            # next submit re-primes on the same replica by itself.
+            outer.replica_id = rid
+            outer.set_exception(exc)
+            return
+        self.fleet.metrics.record_retry(rid)
+        with self._lock:
+            try:
+                self._failover(outer, prev_raw, frame, priority, tried,
+                               exc)
+            except Exception as e:   # never lose a future to a retry bug
+                if not outer.done():
+                    outer.replica_id = rid
+                    outer.set_exception(e)
+
+    def _failover(self, outer, prev_raw, frame, priority, tried: set,
+                  last_exc) -> None:
+        """Re-home the stream and resubmit the failed pair cold.
+        Caller holds the lock; runs in the failed replica's completion
+        thread — the prime's synchronous encode lands on the NEW
+        replica, so it never re-enters the failing engine."""
+        while True:
+            try:
+                rid = self._attach(tried)
+            except EngineUnhealthy as e:
+                self.fleet.metrics.record_shed()
+                outer.set_exception(last_exc or e)
+                return
+            tried.add(rid)
+            try:
+                self._session.submit(prev_raw, priority)   # prime (MISS)
+                inner = self._session.submit(frame, priority)
+            except Exception as e:
+                last_exc = e
+                self.fleet.metrics.record_retry(rid)
+                self._detach()
+                continue
+            self.failovers += 1
+            self.fleet.metrics.record_routed(rid, failover=True)
+            inner.add_done_callback(
+                lambda f, rid=rid: self._on_reply(
+                    outer, f, rid, prev_raw, frame, priority, tried))
+            return
 
 
 def make_fleet(predictor, n_replicas: int,

@@ -25,6 +25,12 @@ client. Two reference modes:
   independence (pinned in tests/test_serving.py: a sample's result is
   bit-identical regardless of batch position or the other entries).
   Bit-exact vs serving on ANY topology; the pytest suite uses this.
+
+Streaming scenarios (``bench.py streaming``, the streaming drill) get
+their own generators: :func:`make_stream_frames` builds temporally
+coherent sliding-window streams with constant ground-truth flow, and
+:func:`run_stream_load` / :func:`run_pair_stream_load` measure warm
+session steady state vs the stateless pair path over identical frames.
 """
 
 from __future__ import annotations
@@ -111,6 +117,216 @@ def sequential_baseline(predictor, frames, n_requests: int,
     dt = time.perf_counter() - t0
     return {"seconds": dt,
             "throughput_rps": n_requests / dt if dt > 0 else 0.0}
+
+
+def make_stream_frames(shape: Tuple[int, int], n_frames: int,
+                       shift: Tuple[int, int] = (2, 1), seed: int = 0
+                       ) -> Tuple[List[np.ndarray], np.ndarray]:
+    """A temporally coherent synthetic stream: ``n_frames`` sliding-
+    window crops of one larger random field, the window moving by
+    ``shift = (sx, sy)`` whole pixels per frame. Every scene point is
+    static in field coordinates, so the ground-truth flow between ANY
+    consecutive pair is the constant ``(-sx, -sy)`` — returned as the
+    ``(H, W, 2)`` second element. Block-structured noise (4x4 blocks)
+    rather than per-pixel noise so correlation actually has texture to
+    match at RAFT's 1/8-resolution cost volume."""
+    h, w = shape
+    sx, sy = shift
+    fh = h + n_frames * abs(sy) + 4
+    fw = w + n_frames * abs(sx) + 4
+    rng = np.random.default_rng(seed)
+    coarse = rng.uniform(0, 255, ((fh + 3) // 4, (fw + 3) // 4, 3))
+    field = np.repeat(np.repeat(coarse, 4, axis=0), 4, axis=1)
+    field = field[:fh, :fw].astype(np.float32)
+    frames = []
+    for k in range(n_frames):
+        y0 = k * sy if sy >= 0 else (n_frames - 1 - k) * -sy
+        x0 = k * sx if sx >= 0 else (n_frames - 1 - k) * -sx
+        frames.append(np.ascontiguousarray(
+            field[y0:y0 + h, x0:x0 + w]))
+    gt = np.empty((h, w, 2), np.float32)
+    gt[..., 0] = -sx
+    gt[..., 1] = -sy
+    return frames, gt
+
+
+def _stream_summary(per_stream: List[Dict[str, object]]
+                    ) -> Dict[str, object]:
+    """Fold per-stream records into the report both stream runners
+    share: the steady-state window is ``[min t0, max t1]`` across
+    streams (conservative — the slowest finisher closes it)."""
+    steady = sum(len(s["latencies_s"]) for s in per_stream)
+    t0 = min(s["t0"] for s in per_stream)
+    t1 = max(s["t1"] for s in per_stream)
+    dt = t1 - t0
+    dropped = sum(s["dropped"] for s in per_stream)
+    out_streams = {}
+    for s in per_stream:
+        lats = sorted(s["latencies_s"])
+        rec = {
+            "steady_pairs": len(lats),
+            "dropped": s["dropped"],
+            "latency_ms": {
+                "p50": _percentile(lats, 50) * 1e3,
+                "p99": _percentile(lats, 99) * 1e3,
+                "mean": (sum(lats) / len(lats) * 1e3) if lats else 0.0,
+            },
+        }
+        if s.get("session") is not None:
+            rec["session"] = s["session"]
+        out_streams[s["name"]] = rec
+    return {
+        "streams": len(per_stream),
+        "steady_pairs": steady,
+        "dropped": dropped,
+        "seconds": dt,
+        "pairs_per_s": steady / dt if dt > 0 else 0.0,
+        "per_stream": out_streams,
+    }
+
+
+def run_stream_load(server, n_streams: int, n_frames: int,
+                    shape: Tuple[int, int] = (64, 96),
+                    shift: Tuple[int, int] = (2, 1), seed: int = 0,
+                    timeout: float = 120.0, collect_flows: bool = False
+                    ) -> Dict[str, object]:
+    """Drive ``n_streams`` concurrent streaming sessions (engine or
+    fleet — anything with ``open_stream``), one closed-loop client
+    thread per stream over a :func:`make_stream_frames` sequence.
+
+    Each client primes and completes its first (cold) pair UNTIMED,
+    then all clients cross a barrier together and the remaining
+    ``n_frames - 2`` warm pairs are timed — so ``pairs_per_s`` is warm
+    steady state, directly comparable to :func:`run_pair_stream_load`'s
+    stateless number over the identical frames. Returns the
+    :func:`_stream_summary` report plus per-stream ``session`` stats
+    (hit rates, warm/cold split, failovers for a fleet) and, with
+    ``collect_flows``, each stream's ``(gt, flows)`` for EPE checks."""
+    barrier = threading.Barrier(n_streams)
+    per_stream: List[Optional[Dict[str, object]]] = [None] * n_streams
+    flows_out: List[Optional[Tuple[np.ndarray, List[np.ndarray]]]] = \
+        [None] * n_streams
+    errors: List[BaseException] = []
+
+    def client(si: int):
+        try:
+            frames, gt = make_stream_frames(
+                shape, n_frames, shift=shift, seed=seed + si)
+            sess = server.open_stream(f"load-{si}")
+            lats: List[float] = []
+            flows: List[np.ndarray] = []
+            dropped = 0
+            try:
+                sess.submit(frames[0])                   # prime
+                flow = sess.submit(frames[1]).result(timeout)  # cold
+                if collect_flows:
+                    flows.append(flow)
+            except Exception:
+                dropped += 1
+            barrier.wait()
+            t0 = time.perf_counter()
+            for frame in frames[2:]:
+                t_req = time.perf_counter()
+                try:
+                    flow = sess.submit(frame).result(timeout)
+                except Exception:
+                    dropped += 1
+                    continue
+                lats.append(time.perf_counter() - t_req)
+                if collect_flows:
+                    flows.append(flow)
+            t1 = time.perf_counter()
+            per_stream[si] = {
+                "name": f"load-{si}", "latencies_s": lats, "t0": t0,
+                "t1": t1, "dropped": dropped,
+                "session": sess.stats()}
+            flows_out[si] = (gt, flows)
+        except BaseException as e:   # don't hang the join on a bug
+            errors.append(e)
+            barrier.abort()
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"stream-load-{i}")
+               for i in range(n_streams)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if errors:
+        raise errors[0]
+    out = _stream_summary([s for s in per_stream if s is not None])
+    if collect_flows:
+        out["flows"] = flows_out
+    return out
+
+
+def run_pair_stream_load(engine, n_streams: int, n_frames: int,
+                         shape: Tuple[int, int] = (64, 96),
+                         shift: Tuple[int, int] = (2, 1), seed: int = 0,
+                         timeout: float = 120.0,
+                         collect_flows: bool = False
+                         ) -> Dict[str, object]:
+    """The stateless comparator for :func:`run_stream_load`: the SAME
+    streams (same seeds, same frames, same closed-loop one-client-per-
+    stream structure) submitted as independent ``(frame_k, frame_k+1)``
+    pairs through ``engine.submit`` — every pair pays both encoder
+    passes and full iterations. First pair untimed, barrier, then the
+    same ``n_frames - 2`` timed pairs, so the two reports' steady-state
+    ``pairs_per_s`` divide into the streaming speedup directly."""
+    barrier = threading.Barrier(n_streams)
+    per_stream: List[Optional[Dict[str, object]]] = [None] * n_streams
+    flows_out: List[Optional[Tuple[np.ndarray, List[np.ndarray]]]] = \
+        [None] * n_streams
+    errors: List[BaseException] = []
+
+    def client(si: int):
+        try:
+            frames, gt = make_stream_frames(
+                shape, n_frames, shift=shift, seed=seed + si)
+            lats: List[float] = []
+            flows: List[np.ndarray] = []
+            dropped = 0
+            try:
+                flow = engine.submit(frames[0], frames[1]).result(timeout)
+                if collect_flows:
+                    flows.append(flow)
+            except Exception:
+                dropped += 1
+            barrier.wait()
+            t0 = time.perf_counter()
+            for k in range(1, n_frames - 1):
+                t_req = time.perf_counter()
+                try:
+                    flow = engine.submit(
+                        frames[k], frames[k + 1]).result(timeout)
+                except Exception:
+                    dropped += 1
+                    continue
+                lats.append(time.perf_counter() - t_req)
+                if collect_flows:
+                    flows.append(flow)
+            t1 = time.perf_counter()
+            per_stream[si] = {
+                "name": f"load-{si}", "latencies_s": lats, "t0": t0,
+                "t1": t1, "dropped": dropped, "session": None}
+            flows_out[si] = (gt, flows)
+        except BaseException as e:
+            errors.append(e)
+            barrier.abort()
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"pair-load-{i}")
+               for i in range(n_streams)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if errors:
+        raise errors[0]
+    out = _stream_summary([s for s in per_stream if s is not None])
+    if collect_flows:
+        out["flows"] = flows_out
+    return out
 
 
 def run_load(engine, frames, n_requests: int, concurrency: int = 8,

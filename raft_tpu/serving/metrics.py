@@ -139,6 +139,14 @@ class ServingMetrics:
         self.rollbacks = 0         # canary-failed reloads rolled back
         self.isolated_retries = 0  # batch-failure singles that served
         self.breaker_fastfails = 0  # requests failed fast while OPEN
+        # streaming (session) accounting: warm vs cold pair submits, and
+        # the encoder feature-map cache — a hit is a pair whose fmap1
+        # came from the previous frame's cached fmap2 (one encoder pass
+        # instead of two), a miss is a session prime/re-prime encode.
+        self.warm_requests = 0
+        self.cold_stream_requests = 0
+        self.encoder_hits = 0
+        self.encoder_misses = 0
         # name -> zero-arg callable; the engine wires live gauges
         # (queue depth, in-flight batches, health code, breaker trips)
         # so snapshot() reads the instantaneous value.
@@ -206,6 +214,30 @@ class ServingMetrics:
         the queue)."""
         with self._lock:
             self.breaker_fastfails += n
+
+    def record_stream_submit(self, warm: bool) -> None:
+        """A stream-session pair accepted (on top of ``record_submit``,
+        which counts it in the request totals): ``warm`` pairs refine
+        from the propagated previous flow at ``warm_iters``, cold pairs
+        are a session's first pair (or its post-state-drop restart) at
+        full ``iters``."""
+        with self._lock:
+            if warm:
+                self.warm_requests += 1
+            else:
+                self.cold_stream_requests += 1
+
+    def record_encoder_cache(self, hit: bool) -> None:
+        """Encoder feature-map cache accounting: a hit is a pair served
+        with a cached fmap1 (one fnet pass), a miss is a session prime
+        or post-failure re-prime (a standalone fnet pass). Per stream of
+        N frames the steady state is 1 miss + (N-1) hits → hit rate
+        (N-1)/N; failovers/state drops add honest misses."""
+        with self._lock:
+            if hit:
+                self.encoder_hits += 1
+            else:
+                self.encoder_misses += 1
 
     def record_batch(self, size: int, padded_to: int,
                      compiles: int = 0) -> None:
@@ -294,6 +326,16 @@ class ServingMetrics:
                 "serving_isolated_retries": float(self.isolated_retries),
                 "serving_breaker_fastfails": float(
                     self.breaker_fastfails),
+                "serving_warm_requests": float(self.warm_requests),
+                "serving_cold_stream_requests": float(
+                    self.cold_stream_requests),
+                "serving_encoder_hits": float(self.encoder_hits),
+                "serving_encoder_misses": float(self.encoder_misses),
+                "serving_encoder_cache_hit_rate": (
+                    self.encoder_hits
+                    / (self.encoder_hits + self.encoder_misses)
+                    if (self.encoder_hits + self.encoder_misses)
+                    else 0.0),
             }
             gauges = dict(self._gauge_sources)
         for name, fn in gauges.items():

@@ -1,0 +1,202 @@
+"""Per-stream serving session: warm-start state + encoder fmap reuse.
+
+Real flow traffic (video calls, dashcams, robotics) is temporally
+coherent streams, and the stateless ``submit(image1, image2)`` API
+throws away two stream-native wins the RAFT paper names:
+
+* **Warm start** — frame t's refined flow, forward-splatted along
+  itself (``utils/warm_start.forward_interpolate``), initializes frame
+  t+1's ``coords1``, so warm frames converge in fewer GRU iterations
+  (``warm_iters``).
+* **Encoder feature-map reuse** — frame t's ``fmap2`` IS frame t+1's
+  ``fmap1``: each warm frame needs exactly ONE fnet pass (the new
+  frame) instead of the twin-image two.
+
+A :class:`StreamSession` carries that state between an engine's frames:
+
+* ``prev_frame`` — the last padded frame (next pair's image1).
+* ``fmap`` — its cached feature map, host numpy ``(1, H/8, W/8, C)``.
+  Host-side on purpose: the completion thread syncs the batch fmap2
+  anyway, a host cache never pins device memory per session, and
+  re-stacking caches with ``np.concatenate`` keeps the dispatch path
+  free of eager ``jnp`` ops (which would each compile a tiny executable
+  and break the engine's zero-post-warmup-compile contract).
+* ``flow_low`` — the last pair's low-res flow, splatted into the next
+  pair's ``flow_init`` in the *client* thread at submit time (host work
+  rides the producers, like padding).
+
+Lifecycle: the first ``submit`` *primes* (a synchronous standalone
+encode — one cache MISS — and no pair; returns ``None``); every later
+``submit`` forms the pair ``(prev_frame, frame)`` whose fmap1 comes
+from the cache (a HIT). The first pair after a prime is COLD (no
+``flow_init``, full ``iters``); subsequent pairs are WARM. State is
+consumed at submit and restored by the completion thread, so a failed
+pair leaves ``fmap`` empty and the next submit honestly re-primes (a
+second MISS) and restarts COLD — the same state-drop semantics the
+fleet's failover path relies on (``fleet.FleetStreamSession``).
+
+Sessions are single-client: ``submit`` serializes on the previous
+pair's future (the state handoff is sequential by construction), so a
+stream contributes at most one in-flight pair — cross-stream batching,
+not intra-stream pipelining, fills the warm buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu.serving.batcher import PRIORITY_HIGH
+from raft_tpu.utils.warm_start import forward_interpolate
+
+
+class StreamSession:
+    """One client's stream state against one engine. Built by
+    ``ServingEngine.open_stream``; the fleet wraps it with sticky
+    routing + failover (``ServingFleet.open_stream``)."""
+
+    def __init__(self, engine, stream_id: str):
+        self.engine = engine
+        self.stream_id = stream_id
+        self.padder = None
+        self.frame_shape = None
+        self.prev_frame: Optional[np.ndarray] = None   # padded host frame
+        self.fmap: Optional[np.ndarray] = None         # (1, H/8, W/8, C)
+        self.flow_low: Optional[np.ndarray] = None     # (H/8, W/8, 2)
+        self.pairs = 0
+        self.warm_pairs = 0
+        self.cold_pairs = 0
+        self.encoder_hits = 0
+        self.encoder_misses = 0
+        self._pending = None
+        self._lock = threading.Lock()
+
+    # -- client API -----------------------------------------------------
+
+    @property
+    def warm_ready(self) -> bool:
+        """Whether the next pair would run warm (a previous flow is
+        cached to splat into its ``flow_init``)."""
+        return self.flow_low is not None
+
+    def submit(self, frame: np.ndarray, priority: str = PRIORITY_HIGH):
+        """Feed the next frame. Returns ``None`` for a priming frame
+        (state seeded, no flow to compute yet), else the future of the
+        pair ``(previous frame, frame)`` → unpadded ``(H, W, 2)`` flow.
+
+        Serializes on the previous pair (its completion hands this one
+        its fmap and flow state); a failed previous pair is swallowed
+        here — its error already surfaced on its own future — and this
+        pair restarts the stream cold."""
+        # Serialize on the previous pair OUTSIDE the lock: its
+        # completion thread takes the lock in _complete() before
+        # resolving the future we are waiting on.
+        pending = self._pending
+        if pending is not None:
+            try:
+                pending.result()
+            except Exception:
+                pass
+        with self._lock:
+            self._pending = None
+            frame = np.ascontiguousarray(frame)
+            if self.padder is None:
+                from raft_tpu.utils.padder import InputPadder
+                self.frame_shape = frame.shape
+                self.padder = InputPadder(
+                    frame.shape, mode=self.engine.config.pad_mode,
+                    factor=self.engine.config.factor)
+            elif frame.shape != self.frame_shape:
+                raise ValueError(
+                    f"stream {self.stream_id} frames must keep one "
+                    f"shape (session state is shape-bound): got "
+                    f"{frame.shape}, expected {self.frame_shape}")
+            # pad() returns the bare array for a single input
+            padded = self.padder.pad(frame)
+
+            if self.prev_frame is None:
+                # First frame ever (or after drop()): prime only.
+                self._prime(padded)
+                return None
+            if self.fmap is None:
+                # Previous pair failed (or never ran): its fmap handoff
+                # was consumed and not restored. Re-prime the held frame
+                # — an honest extra MISS — and restart cold.
+                self._prime(self.prev_frame)
+
+            warm = self.flow_low is not None
+            flow_init = forward_interpolate(self.flow_low) if warm else None
+            fmap1 = self.fmap
+            # Consume the state: the completion thread restores it from
+            # this pair's outputs before resolving the future.
+            self.fmap = None
+            self.flow_low = None
+            prev = self.prev_frame
+            self.prev_frame = padded
+            fut = self.engine._submit_stream(
+                self, prev, padded, self.padder, fmap1, flow_init,
+                priority)
+            # Count only pairs that actually enqueued (a rejected
+            # submit raised above; the consumed state stays cleared and
+            # the next submit honestly re-primes).
+            self.pairs += 1
+            if warm:
+                self.warm_pairs += 1
+            else:
+                self.cold_pairs += 1
+            self.encoder_hits += 1
+            self._pending = fut
+            return fut
+
+    def drop(self) -> None:
+        """Explicitly drop all stream state. The next ``submit`` primes
+        from scratch (full cold restart) — the fleet calls this when a
+        stream leaves a replica on failover."""
+        with self._lock:
+            self.prev_frame = None
+            self.fmap = None
+            self.flow_low = None
+            self.padder = None
+            self.frame_shape = None
+            self._pending = None
+
+    def stats(self) -> dict:
+        """Per-session accounting (the loadgen's per-stream attribution
+        and the tests' lifecycle asserts read this)."""
+        with self._lock:
+            total = self.encoder_hits + self.encoder_misses
+            return {
+                "stream_id": self.stream_id,
+                "pairs": self.pairs,
+                "warm_pairs": self.warm_pairs,
+                "cold_pairs": self.cold_pairs,
+                "encoder_hits": self.encoder_hits,
+                "encoder_misses": self.encoder_misses,
+                "encoder_cache_hit_rate": (self.encoder_hits / total
+                                           if total else 0.0),
+            }
+
+    # -- engine-side hooks ----------------------------------------------
+
+    def _prime(self, padded_frame: np.ndarray) -> None:
+        """Standalone synchronous encode of one frame (caller holds the
+        session lock — runs in the client thread, like padding)."""
+        self.fmap = self.engine._prime_encode(padded_frame)
+        self.flow_low = None
+        self.prev_frame = padded_frame
+        self.encoder_misses += 1
+
+    def _complete(self, fmap2: np.ndarray, flow_low: np.ndarray) -> None:
+        """Completion-thread handoff: this pair's fmap2 becomes the next
+        pair's fmap1, its low-res flow the next ``flow_init`` seed. Runs
+        BEFORE the pair's future resolves, and the client's next submit
+        serializes on that future — no lock needed for ordering, but
+        taken anyway so ``drop()`` from another thread can't interleave
+        half-restored state."""
+        with self._lock:
+            if self.prev_frame is None:
+                return  # drop() raced the completion: stay dropped
+            self.fmap = fmap2
+            self.flow_low = flow_low

@@ -21,6 +21,15 @@
    zero fresh XLA compiles after warmup (the standby serves through the
    shared bucket executables), and the breaker provably opens and
    recovers under injected dispatch errors on the same engine.
+4. fleet (``--drill fleet``) — 3-replica fleet: kill a replica under
+   50 concurrent clients (zero dropped/bit-incorrect, router
+   re-balances), rolling reload (one canary, zero wave compiles), NaN
+   checkpoint rolls the whole fleet back.
+5. streaming (``--drill streaming``) — N sticky streaming sessions
+   against a 3-replica fleet; kill the most-pinned replica mid-run:
+   affected streams drop state and cold-restart elsewhere with zero
+   dropped responses and zero fresh compiles, their stats honestly
+   showing the restart's extra encoder MISS.
 
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
@@ -565,11 +574,98 @@ def drill_fleet(root):
     assert fleet.health()["state"] == "closed"
 
 
+def drill_streaming(root):
+    """3-replica fleet under N-stream session load: kill the replica
+    most streams are pinned to mid-run — every affected stream drops
+    its state, cold-restarts on another replica (honest extra encoder
+    MISS) and keeps flowing: zero dropped responses, zero shed, and
+    zero fresh XLA compiles anywhere (restart primes hit the shared
+    executable cache)."""
+    import numpy as np
+    from collections import Counter
+
+    from raft_tpu.serving import (CompileWatch, ServingConfig, loadgen,
+                                  make_fleet)
+
+    predictor = _make_predictor()
+    n_streams, n_frames, shape = 6, 12, (36, 60)
+    fleet = make_fleet(predictor, 3, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, warm_buckets=(shape,),
+        warm_iters=1, breaker_threshold=2, breaker_cooldown_s=120.0))
+    fleet.start()
+    warm_compiles = sum(s["compiles"] for s in fleet.warmup_stats.values())
+    # Sticky pins are deterministic (rendezvous over stream ids): the
+    # victim is known before any traffic flows.
+    pins = [fleet.router.owners_for_key(f"stream:load-{i}")[0]
+            for i in range(n_streams)]
+    victim, n_pinned = Counter(pins).most_common(1)[0]
+    print(f"  pins: {dict(Counter(pins))}; victim {victim} "
+          f"({n_pinned} streams); warmup compiles {warm_compiles:g} "
+          f"(shared cache: every other replica warms for free)")
+    assert n_pinned >= 1
+
+    out = {}
+    t_kill = [None]
+
+    def load():
+        out.update(loadgen.run_stream_load(
+            fleet, n_streams, n_frames, shape=shape, timeout=120.0))
+
+    def victim_responses():
+        return fleet.engines[victim].metrics.responses
+
+    try:
+        with CompileWatch() as watch:
+            loader = threading.Thread(target=load, name="stream-load")
+            loader.start()
+            _await_metric(victim_responses, 2, 120,
+                          "victim responses before kill")
+            fleet.kill_replica(victim)
+            t_kill[0] = time.monotonic()
+            loader.join(300)
+            assert not loader.is_alive(), "stream load generator wedged"
+    finally:
+        fleet.close()
+
+    sessions = {name: rec["session"]
+                for name, rec in out["per_stream"].items()}
+    failovers = sum(s["failovers"] for s in sessions.values())
+    moved = [name for name, s in sessions.items()
+             if s["failovers"] > 0]
+    print(f"  kill {victim} mid-run: {out['steady_pairs']} steady pairs, "
+          f"{out['dropped']} dropped, {failovers} stream failover(s) "
+          f"({moved}), {watch.compiles} post-warmup compiles")
+    print("  fleet:", fleet.metrics.report())
+    assert out["dropped"] == 0, f"dropped {out['dropped']} responses"
+    assert failovers >= 1, "no stream ever failed over"
+    assert watch.compiles == 0, \
+        f"{watch.compiles} fresh compile(s) — cold restarts must serve " \
+        "through the shared executable cache"
+    assert fleet.metrics.shed == 0, f"shed {fleet.metrics.shed}"
+    expected_rate = (n_frames - 1) / n_frames
+    for name, s in sessions.items():
+        assert s["replica_id"] != victim, \
+            f"{name} still pinned to the dead replica"
+        if s["failovers"] == 0:
+            # Untouched stream: exactly one prime MISS, perfect rate.
+            assert s["encoder_misses"] == 1 and np.isclose(
+                s["encoder_cache_hit_rate"], expected_rate), s
+        else:
+            # Restarted stream: the cold restart is an HONEST extra
+            # MISS and an extra cold pair, never hidden by the stats.
+            assert s["encoder_misses"] >= 2, s
+            assert s["cold_pairs"] >= 2, s
+    print(f"  all {n_streams} streams live off {victim}; untouched "
+          f"streams at hit rate {expected_rate:.3f}, restarted ones "
+          f"show their extra MISS")
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
     drill_reload_under_load,
     drill_fleet,
+    drill_streaming,
 ]
 
 

@@ -1,0 +1,403 @@
+"""Streaming serving suite: warm-start splatting, the split
+encode/refine predictor path, per-stream sessions (cold→warm
+lifecycle, encoder-cache accounting, state drop on failure), sticky
+fleet streams with failover, and the stream load generator.
+
+All CPU-deterministic and `not slow`-eligible: random-weights
+RAFT-small at iters=2 over tiny frames. Accuracy assertions are
+tolerance bands, not bit-equality — the split encode/refine path runs
+different executables than the fused twin-image pass (instance-norm
+fnet makes them mathematically identical, float-order distinct)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils.warm_start import forward_interpolate
+
+SHAPE = (36, 60)              # pads to the (40, 64) bucket
+MAX_BATCH = 2
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+def _stream_frames(n_frames, seed=0, shape=SHAPE):
+    from raft_tpu.serving.loadgen import make_stream_frames
+    return make_stream_frames(shape, n_frames, seed=seed)
+
+
+def _engine(predictor, **kw):
+    from raft_tpu.serving.engine import ServingConfig, ServingEngine
+    cfg = ServingConfig(max_batch=MAX_BATCH, max_wait_ms=2.0,
+                        buckets=(SHAPE,), warm_buckets=(SHAPE,),
+                        warm_iters=1, **kw)
+    return ServingEngine(predictor, cfg)
+
+
+@pytest.fixture(scope="module")
+def engine(predictor):
+    """One warmed, started engine shared by the read-only session
+    tests (each opens its own stream; engine-level counters are only
+    ever asserted as deltas)."""
+    eng = _engine(predictor)
+    eng.start()
+    yield eng
+    eng.close()
+
+
+# -- forward splatting ---------------------------------------------------
+
+class TestForwardInterpolate:
+    def test_constant_integer_shift_is_exact(self):
+        flow = np.zeros((16, 20, 2), np.float32)
+        flow[..., 0] = 3.0
+        flow[..., 1] = -2.0
+        out = forward_interpolate(flow)
+        # Every landing pixel receives exactly the constant motion;
+        # vacated/out-of-frame pixels are hole-filled from neighbors —
+        # with a constant field that is the same constant.
+        np.testing.assert_allclose(out[..., 0], 3.0)
+        np.testing.assert_allclose(out[..., 1], -2.0)
+
+    def test_all_out_of_frame_returns_zeros(self):
+        flow = np.full((8, 10, 2), 100.0, np.float32)
+        assert np.array_equal(forward_interpolate(flow),
+                              np.zeros((8, 10, 2), np.float32))
+
+    def test_scipy_griddata_parity(self):
+        pytest.importorskip("scipy")
+        from raft_tpu.utils.warm_start import forward_interpolate_scipy
+        rng = np.random.default_rng(7)
+        y, x = np.meshgrid(np.linspace(0, np.pi, 24),
+                           np.linspace(0, np.pi, 30), indexing="ij")
+        flow = np.stack([2.0 * np.sin(y) + 0.5,
+                         1.5 * np.cos(x) - 0.5], axis=-1)
+        flow += rng.normal(0, 0.05, flow.shape)
+        flow = flow.astype(np.float32)
+        ours = forward_interpolate(flow)
+        ref = forward_interpolate_scipy(flow)
+        diff = np.abs(ours - ref)
+        # Nearest-pixel scatter vs griddata nearest interpolation agree
+        # everywhere except sub-pixel rounding at cell boundaries.
+        assert float(diff.mean()) < 0.05
+        assert float(diff.max()) < 0.5
+
+
+# -- split encode/refine predictor path ----------------------------------
+
+class TestSplitEncodeRefine:
+    def test_split_matches_fused_call(self, predictor):
+        from raft_tpu.utils.padder import InputPadder
+        rng = np.random.default_rng(11)
+        im1, im2 = (rng.uniform(0, 255, (*SHAPE, 3)).astype(np.float32)
+                    for _ in range(2))
+        padder = InputPadder(im1.shape, mode="sintel")
+        p1, p2 = padder.pad(im1, im2)
+        low_ref, up_ref = predictor(p1, p2)
+        f1 = np.asarray(predictor.encode_dispatch(p1[None]))
+        f2 = np.asarray(predictor.encode_dispatch(p2[None]))
+        low, up = predictor.refine_dispatch(p1[None], f1, f2)
+        # Same math, different executables: tolerance, not bit-equality.
+        np.testing.assert_allclose(np.asarray(low)[0],
+                                   np.asarray(low_ref), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(up)[0],
+                                   np.asarray(up_ref), atol=1e-4)
+
+    def test_warm_refine_requires_matching_flow_init(self, predictor):
+        rng = np.random.default_rng(3)
+        p = rng.uniform(0, 255, (1, 40, 64, 3)).astype(np.float32)
+        f = np.asarray(predictor.encode_dispatch(p))
+        with pytest.raises(ValueError, match="flow_init"):
+            predictor.refine_dispatch(p, f, f, warm=True)
+        with pytest.raises(ValueError, match="flow_init"):
+            predictor.refine_dispatch(
+                p, f, f, flow_init=np.zeros((1, 5, 8, 2), np.float32),
+                warm=False)
+
+    def test_warm_composes_with_donate_images(self):
+        from raft_tpu.evaluate import load_predictor
+        pred = load_predictor("random", small=True, iters=2)
+        pred.donate_images = True
+        rng = np.random.default_rng(5)
+        p1, p2 = (rng.uniform(0, 255, (1, 40, 64, 3)).astype(np.float32)
+                  for _ in range(2))
+        f1 = np.asarray(pred.encode_dispatch(p1.copy()))
+        f2 = np.asarray(pred.encode_dispatch(p2.copy()))
+        init = np.zeros((1, 5, 8, 2), np.float32)
+        low, up = pred.refine_dispatch(p1, f1, f2, flow_init=init,
+                                       warm=True)
+        assert np.isfinite(np.asarray(up)).all()
+        # flow_init is never donated: reusable across warm frames.
+        low2, _ = pred.refine_dispatch(p2, f2.copy(), f2,
+                                       flow_init=init, warm=True)
+        assert np.isfinite(np.asarray(low2)).all()
+
+
+# -- engine sessions -----------------------------------------------------
+
+class TestStreamSession:
+    def test_cold_warm_lifecycle_and_hit_rate(self, engine):
+        frames, _ = _stream_frames(5, seed=1)
+        sess = engine.open_stream("lifecycle")
+        assert sess.submit(frames[0]) is None        # prime
+        assert not sess.warm_ready
+        flows = [sess.submit(f).result(60) for f in frames[1:]]
+        assert sess.warm_ready
+        for flow in flows:
+            assert flow.shape == (*SHAPE, 2) and np.isfinite(flow).all()
+        st = sess.stats()
+        assert st["pairs"] == 4
+        assert st["cold_pairs"] == 1 and st["warm_pairs"] == 3
+        assert st["encoder_misses"] == 1 and st["encoder_hits"] == 4
+        # The criterion: (N-1)/N for an N-frame stream, exactly.
+        assert st["encoder_cache_hit_rate"] == pytest.approx(4 / 5)
+
+    def test_frame_shape_is_pinned(self, engine):
+        frames, _ = _stream_frames(2, seed=2)
+        sess = engine.open_stream()
+        sess.submit(frames[0])
+        with pytest.raises(ValueError, match="shape"):
+            sess.submit(np.zeros((40, 64, 3), np.float32))
+
+    def test_zero_postwarmup_compiles_mixed_traffic(self, engine):
+        from raft_tpu.serving.metrics import CompileWatch
+        frames, _ = _stream_frames(4, seed=3)
+        rng = np.random.default_rng(4)
+        im1, im2 = (rng.uniform(0, 255, (*SHAPE, 3)).astype(np.float32)
+                    for _ in range(2))
+        with CompileWatch() as watch:
+            sess = engine.open_stream()
+            sess.submit(frames[0])
+            futs = []
+            for f in frames[1:]:                      # cold + warm pairs
+                futs.append(sess.submit(f))
+                futs.append(engine.submit(im1, im2))  # stateless alongside
+                futs[-2].result(60)
+            for fut in futs:
+                fut.result(60)
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) in mixed warm/cold/" \
+            "stateless traffic after warmup"
+
+    def test_explicit_drop_restarts_cold(self, engine):
+        frames, _ = _stream_frames(5, seed=5)
+        sess = engine.open_stream()
+        sess.submit(frames[0])
+        sess.submit(frames[1]).result(60)
+        sess.drop()
+        assert sess.submit(frames[2]) is None         # re-prime
+        sess.submit(frames[3]).result(60)
+        st = sess.stats()
+        assert st["encoder_misses"] == 2 and st["cold_pairs"] == 2
+
+    def test_dispatch_failure_drops_state_and_reprimes(self, predictor):
+        from raft_tpu.resilience import FaultInjector, set_injector
+        eng = _engine(predictor, breaker_threshold=100)
+        eng.start()
+        try:
+            frames, _ = _stream_frames(4, seed=6)
+            sess = eng.open_stream("faulty")
+            sess.submit(frames[0])
+            sess.submit(frames[1]).result(60)         # cold pair ok
+            set_injector(FaultInjector(serving_dispatch_errors=1))
+            try:
+                fut = sess.submit(frames[2])          # warm attempt dies
+                with pytest.raises(RuntimeError):
+                    fut.result(60)
+            finally:
+                set_injector(None)
+            # State was consumed and not restored: the next submit
+            # honestly re-primes (second MISS) and restarts cold.
+            flow = sess.submit(frames[3]).result(60)
+            assert np.isfinite(flow).all()
+            st = sess.stats()
+            assert st["encoder_misses"] == 2
+            assert st["cold_pairs"] == 2
+            assert st["warm_pairs"] == 1              # the failed attempt
+            assert st["pairs"] == 3
+        finally:
+            eng.close()
+
+    def test_warm_flow_within_drift_band_of_stateless(self, predictor):
+        """Warm pairs (splatted init, reduced iters) must stay in a
+        drift band of the stateless full-iteration flow over the SAME
+        coherent frames — the accuracy half of the streaming trade."""
+        eng = _engine(predictor)
+        eng.start()
+        try:
+            frames, _ = _stream_frames(5, seed=8)
+            stateless = []
+            for k in range(len(frames) - 1):
+                stateless.append(
+                    eng.submit(frames[k], frames[k + 1]).result(60))
+            sess = eng.open_stream()
+            sess.submit(frames[0])
+            session_flows = [sess.submit(f).result(60)
+                             for f in frames[1:]]
+        finally:
+            eng.close()
+        # Cold session pair: same full-iters math as stateless, split
+        # executables — tight band. Warm pairs: fewer GRU iterations
+        # from a splatted init — bounded drift, not divergence (the
+        # random-weight model's flows are O(10) px; a blowup or NaN
+        # would clear 100 easily).
+        cold = float(np.mean(np.linalg.norm(
+            session_flows[0] - stateless[0], axis=-1)))
+        assert cold < 1e-3
+        for sf, bf in zip(session_flows[1:], stateless[1:]):
+            drift = float(np.mean(np.linalg.norm(sf - bf, axis=-1)))
+            assert np.isfinite(drift) and drift < 100.0
+
+
+# -- sticky fleet streams ------------------------------------------------
+
+class TestFleetStreaming:
+    def test_router_key_digests_are_stable(self):
+        """Golden pins: the generic ``_score_key`` refactor must keep
+        bucket digests bit-identical (assignments would silently churn
+        fleet-wide otherwise) and streams get the same HRW machinery."""
+        from raft_tpu.serving.fleet import BucketRouter
+        assert BucketRouter._score_key("40x64", "r0") == \
+            1655992062275917682
+        assert BucketRouter._score_key("40x64", "r1") == \
+            16269337235696228788
+        assert BucketRouter._score((40, 64), "r2") == \
+            17951444619648513762
+        r = BucketRouter(["r0", "r1", "r2"])
+        assert r.owners((40, 64)) == r.owners_for_key("40x64")
+        assert r.owners((40, 64)) == ["r2", "r1", "r0"]
+        assert r.owners_for_key("stream:s0") == ["r0", "r1", "r2"]
+
+    def test_sticky_pin_and_failover_cold_restart(self, predictor):
+        from raft_tpu.serving.engine import ServingConfig
+        from raft_tpu.serving.fleet import make_fleet
+        from raft_tpu.serving.metrics import CompileWatch
+        fleet = make_fleet(predictor, 3, ServingConfig(
+            max_batch=MAX_BATCH, max_wait_ms=2.0, warm_buckets=(SHAPE,),
+            warm_iters=1, breaker_threshold=2,
+            breaker_cooldown_s=120.0))
+        fleet.start()
+        try:
+            frames, _ = _stream_frames(7, seed=9)
+            sess = fleet.open_stream("s0")
+            with CompileWatch() as watch:
+                assert sess.submit(frames[0]) is None
+                pinned = sess.replica_id
+                # Deterministic rendezvous pin.
+                assert pinned == fleet.router.owners_for_key(
+                    "stream:s0")[0]
+                for f in frames[1:3]:
+                    assert np.isfinite(sess.submit(f).result(60)).all()
+                assert sess.replica_id == pinned      # sticky
+                fleet.kill_replica(pinned)
+                for f in frames[3:]:
+                    flow = sess.submit(f).result(60)
+                    assert np.isfinite(flow).all()
+                    assert flow.shape == (*SHAPE, 2)
+            st = sess.stats()
+            assert sess.replica_id != pinned
+            assert st["failovers"] >= 1
+            # Explicit state drop: the restart re-primed (extra MISS)
+            # and restarted cold on the new replica.
+            assert st["encoder_misses"] == 2
+            assert st["cold_pairs"] >= 2
+            # Shared executable cache: the whole failover, restart
+            # included, compiled nothing.
+            assert watch.compiles == 0
+            assert fleet.metrics.shed == 0
+            assert sum(fleet.metrics.retries.values()) >= 1
+        finally:
+            fleet.close()
+
+    def test_stream_sheds_when_no_replica_routable(self, predictor):
+        from raft_tpu.serving.engine import ServingConfig
+        from raft_tpu.serving.fleet import make_fleet
+        from raft_tpu.serving.health import EngineUnhealthy
+        fleet = make_fleet(predictor, 2, ServingConfig(
+            max_batch=MAX_BATCH, max_wait_ms=2.0, warm_buckets=(SHAPE,),
+            warm_iters=1, breaker_threshold=1,
+            breaker_cooldown_s=120.0))
+        fleet.start()
+        try:
+            frames, _ = _stream_frames(3, seed=10)
+            sess = fleet.open_stream("doomed")
+            sess.submit(frames[0])
+            sess.submit(frames[1]).result(60)
+            for rid in fleet.replica_ids:
+                fleet.kill_replica(rid)
+            # Trip both breakers (threshold 1) so routing gates close.
+            with pytest.raises(Exception):
+                sess.submit(frames[2]).result(60)
+            with pytest.raises(EngineUnhealthy):
+                for f in frames:
+                    sess.submit(f)
+            assert fleet.metrics.shed >= 1
+        finally:
+            fleet.close()
+
+
+# -- stream load generator -----------------------------------------------
+
+class TestStreamLoadgen:
+    def test_make_stream_frames_is_coherent_with_constant_gt(self):
+        from raft_tpu.serving.loadgen import make_stream_frames
+        frames, gt = make_stream_frames((24, 32), 5, shift=(2, 1),
+                                        seed=0)
+        assert len(frames) == 5
+        for k in range(4):
+            # Sliding window: frame k shifted by (sy=1, sx=2) IS frame
+            # k+1 over the overlap — real temporal coherence, exactly.
+            np.testing.assert_array_equal(frames[k][1:, 2:],
+                                          frames[k + 1][:-1, :-2])
+        assert gt.shape == (24, 32, 2)
+        assert np.all(gt[..., 0] == -2) and np.all(gt[..., 1] == -1)
+
+    def test_run_stream_load_accounting(self, engine):
+        from raft_tpu.serving.loadgen import run_stream_load
+        n_streams, n_frames = 2, 5
+        out = run_stream_load(engine, n_streams, n_frames, shape=SHAPE,
+                              seed=20, timeout=60.0)
+        assert out["dropped"] == 0
+        assert out["steady_pairs"] == n_streams * (n_frames - 2)
+        assert out["pairs_per_s"] > 0
+        for rec in out["per_stream"].values():
+            s = rec["session"]
+            assert s["encoder_cache_hit_rate"] == pytest.approx(
+                (n_frames - 1) / n_frames)
+            assert rec["latency_ms"]["p99"] >= rec["latency_ms"]["p50"]
+
+    def test_pair_stream_load_matches_stream_structure(self, engine):
+        from raft_tpu.serving.loadgen import run_pair_stream_load
+        out = run_pair_stream_load(engine, 2, 4, shape=SHAPE, seed=21,
+                                   timeout=60.0)
+        assert out["dropped"] == 0
+        assert out["steady_pairs"] == 2 * (4 - 2)
+        assert "session" not in next(iter(out["per_stream"].values()))
+
+
+# -- serving metrics gauges ----------------------------------------------
+
+class TestStreamingMetrics:
+    def test_warm_cold_counters_and_hit_rate_gauge(self, predictor):
+        eng = _engine(predictor)
+        eng.start()
+        try:
+            frames, _ = _stream_frames(4, seed=30)
+            sess = eng.open_stream()
+            sess.submit(frames[0])
+            for f in frames[1:]:
+                sess.submit(f).result(60)
+            snap = eng.metrics.snapshot()
+            assert snap["serving_warm_requests"] == 2.0
+            assert snap["serving_cold_stream_requests"] == 1.0
+            assert snap["serving_encoder_hits"] == 3.0
+            assert snap["serving_encoder_misses"] == 1.0
+            assert snap["serving_encoder_cache_hit_rate"] == \
+                pytest.approx(3 / 4)
+        finally:
+            eng.close()
