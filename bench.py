@@ -676,6 +676,9 @@ def serving_main(replicas: int = 1):
         padded_slots = lambda: engine.metrics.padded_slots  # noqa: E731
         queue_peak = lambda: engine.metrics.queue_depth_peak  # noqa: E731
         compiles = lambda: engine.metrics.compiles  # noqa: E731
+        quality_hist = engine.metrics.quality_histogram
+        early_exit_saved = lambda: (  # noqa: E731
+            engine.metrics.early_exit_iters_saved)
         close = engine.close
     else:
         fleet = make_fleet(predictor, replicas, cfg)
@@ -699,6 +702,16 @@ def serving_main(replicas: int = 1):
             e.metrics.queue_depth_peak for e in engines)
         compiles = lambda: sum(  # noqa: E731
             e.metrics.compiles for e in engines)
+
+        def quality_hist():
+            merged = {}
+            for e in engines:
+                for k, v in e.metrics.quality_histogram().items():
+                    merged[k] = merged.get(k, 0) + v
+            return merged
+
+        early_exit_saved = lambda: sum(  # noqa: E731
+            e.metrics.early_exit_iters_saved for e in engines)
         close = fleet.close
 
     try:
@@ -741,6 +754,15 @@ def serving_main(replicas: int = 1):
         "padded_slots": padded_slots(),
         "queue_depth_peak": queue_peak(),
         "post_warmup_compiles": compiles(),
+        # Served-quality accounting (graceful brownout): which GRU
+        # iteration counts responses were actually served at. With no
+        # iters_ladder configured this is all full quality — the key
+        # still ships so round-over-round artifacts are comparable.
+        "quality_histogram": {str(k): v for k, v in
+                              sorted(quality_hist().items(),
+                                     reverse=True)},
+        "early_exit_iters_saved": early_exit_saved(),
+        "iters_ladder": list(cfg.iters_ladder),
         "responses_bit_exact": res["ok"],
         "dropped": len(res["dropped"]),
         "mismatched": len(res["mismatched"]),

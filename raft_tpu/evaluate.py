@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 import os.path as osp
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,7 +70,8 @@ class FlowPredictor:
     def __init__(self, model, variables, iters: int = 32,
                  batch_size: Optional[int] = None, mesh=None,
                  corr_impl: str = "fixed",
-                 warm_iters: Optional[int] = None):
+                 warm_iters: Optional[int] = None,
+                 early_exit: Optional[Tuple[float, int]] = None):
         if corr_impl not in ("fixed", "auto"):
             raise ValueError(f"corr_impl must be 'fixed' or 'auto', "
                              f"got {corr_impl!r}")
@@ -110,6 +111,22 @@ class FlowPredictor:
         if warm_iters is not None and warm_iters < 1:
             raise ValueError(f"warm_iters must be >= 1, got {warm_iters}")
         self.warm_iters = warm_iters
+        # Convergence early exit (tol, patience) for the PER-REQUEST-
+        # ITERS dispatch path only (see :meth:`dispatch_batch`'s
+        # ``iters=`` kwarg): when set, those executables thread
+        # ``early_exit`` into the model's masked refine scan and return
+        # a third ``(B,)`` per-sample iterations-used array. ``None``
+        # (default) keeps every executable — including the iters path —
+        # byte-identical to the pre-knob trace. Part of the cache key.
+        if early_exit is not None:
+            tol, patience = early_exit
+            if not (tol > 0.0):
+                raise ValueError(f"early_exit tol must be > 0, got {tol}")
+            if int(patience) < 1:
+                raise ValueError(
+                    f"early_exit patience must be >= 1, got {patience}")
+            early_exit = (float(tol), int(patience))
+        self.early_exit = early_exit
         # Resolved RAFT_GRU_PALLAS mode ('auto'/'0'/'1') — validated here
         # so bad values fail at build time, recorded for observability
         # (bench/serving annotate payloads with it). The actual dispatch
@@ -259,17 +276,63 @@ class FlowPredictor:
         clone.variables = variables
         return clone
 
-    def dispatch_batch(self, images1: np.ndarray, images2: np.ndarray):
+    def _iters_fn(self, shape, iters: int) -> Callable:
+        """Per-request-iters executable: same forward as :meth:`_fn`'s
+        stateless cold path but with an explicit GRU iteration count —
+        the serving brownout ladder's compile unit. The cache key's
+        second element is the tuple ``("iters", k, early_exit)``, which
+        can never equal the stateless ``warm`` bool, the ``"encode"``
+        tag, or the ``("refine", warm)`` tag — the four executable
+        families stay disjoint in the one shared cache (clones included).
+        With ``self.early_exit`` set, the executable returns
+        ``(flow_low, flow_up, iters_used)``; otherwise the usual pair.
+        """
+        iters = int(iters)
+        if iters < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
+        if self.mesh is not None:
+            raise ValueError(
+                "per-request iters is not supported with spatially-"
+                "sharded eval — degraded-quality buckets would need "
+                "their own sharding specs")
+        donate = bool(self.donate_images)
+        ee = self.early_exit
+        key = (shape, ("iters", iters, ee), donate)
+        if key not in self._cache:
+            model = self._pick_engine(shape)
+
+            def run(variables, image1, image2, flow_init=None,
+                    model=model):
+                return model.apply(
+                    variables, image1, image2, iters=iters,
+                    flow_init=flow_init, test_mode=True, early_exit=ee)
+
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1, 2) if donate else ())
+        return self._cache[key]
+
+    def dispatch_batch(self, images1: np.ndarray, images2: np.ndarray,
+                       iters: Optional[int] = None):
         """Non-blocking batched forward: (B, H, W, 3) stacks →
         ``(flow_low, flow_up)`` *device* arrays, returned as soon as the
         computation is dispatched (JAX async dispatch). The caller syncs
         when it reads them (``np.asarray``), so host work — stacking the
         next batch, padding — overlaps device compute. This is the
         serving engine's pipelining primitive; :meth:`predict_batch` is
-        the blocking wrapper."""
+        the blocking wrapper.
+
+        ``iters``: per-request GRU iteration count (the brownout
+        ladder). ``None`` dispatches the default ``self.iters``
+        executable — bit-identical to the pre-knob path. An explicit
+        count routes through :meth:`_iters_fn`; with the predictor's
+        ``early_exit`` set that path returns a third per-sample
+        iterations-used array."""
         img1 = jnp.asarray(images1)
         img2 = jnp.asarray(images2)
-        fn = self._fn(img1.shape, False)
+        if iters is None:
+            fn = self._fn(img1.shape, False)
+        else:
+            fn = self._iters_fn(img1.shape, iters)
         return fn(self.variables, img1, img2, None)
 
     def predict_batch(self, images1: np.ndarray, images2: np.ndarray):
@@ -325,7 +388,7 @@ class FlowPredictor:
         return self._cache[key](self.variables, img)
 
     def refine_dispatch(self, images1, fmap1, fmap2, flow_init=None,
-                        warm: bool = False):
+                        warm: bool = False, iters: Optional[int] = None):
         """Non-blocking refine-only forward with precomputed feature
         maps: (B, H, W, 3) first images (cnet input), (B, H/8, W/8, C)
         fmaps → ``(flow_low, flow_up)`` device arrays.
@@ -333,20 +396,34 @@ class FlowPredictor:
         ``warm=True`` requires ``flow_init`` (B, H/8, W/8, 2) and runs
         ``warm_iters`` (→ ``iters`` when unset); cold refine takes no
         flow_init argument at all — a distinct executable, same contract
-        as the stateless warm/cold split. Donated when enabled: images1
-        and fmap1 (both fresh per-batch host buffers). fmap2 is NEVER
-        donated — it is the encode output the engine syncs after this
-        dispatch to seed the next frame's fmap1 caches."""
+        as the stateless warm/cold split. ``iters`` overrides the
+        iteration count for WARM refine only (the stream brownout
+        ladder; cold/prime pairs keep the cold policy by contract) —
+        it selects a distinct executable through the same cache-key
+        slot the warm/cold split already uses, so no new key shapes.
+        Donated when enabled: images1 and fmap1 (both fresh per-batch
+        host buffers). fmap2 is NEVER donated — it is the encode output
+        the engine syncs after this dispatch to seed the next frame's
+        fmap1 caches."""
         if warm and flow_init is None:
             raise ValueError("warm refine requires flow_init")
         if not warm and flow_init is not None:
             raise ValueError("cold refine takes no flow_init (warm=True "
                              "selects the warm executable)")
+        if iters is not None and not warm:
+            raise ValueError("per-request iters applies to warm refine "
+                             "only — cold/prime pairs keep the cold "
+                             "policy")
+        if iters is not None and int(iters) < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
         img1 = jnp.asarray(images1)
         fm1 = jnp.asarray(fmap1)
         fm2 = jnp.asarray(fmap2)
-        iters_used = (self.warm_iters if warm and self.warm_iters
-                      else self.iters)
+        if iters is not None:
+            iters_used = int(iters)
+        else:
+            iters_used = (self.warm_iters if warm and self.warm_iters
+                          else self.iters)
         donate = bool(self.donate_images) and self.mesh is None
         key = (img1.shape, ("refine", bool(warm)), iters_used, donate)
         if key not in self._cache:

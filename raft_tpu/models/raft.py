@@ -40,9 +40,17 @@ from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
 
 class _UpdateStep(nn.Module):
     """One refinement iteration, the ``lax.scan`` body
-    (reference ``core/raft.py:123-140``)."""
+    (reference ``core/raft.py:123-140``).
+
+    ``early_exit``: optional static ``(tol, patience)`` pair enabling
+    per-sample convergence masking in the test_mode mask-free loop (see
+    ``__call__``). ``None`` (the default) leaves the body byte-for-byte
+    identical to the plain scan — the disabled path is not a runtime
+    branch, the masking code is statically absent from the trace.
+    """
 
     config: RAFTConfig
+    early_exit: Optional[Tuple[float, int]] = None
 
     def setup(self):
         dtype = (jnp.bfloat16 if self.config.mixed_precision
@@ -62,16 +70,56 @@ class _UpdateStep(nn.Module):
         ``RAFT.__call__``). ``_tick`` is a dummy scanned input that
         sets the trip count (``nn.scan(length=None)``), letting ONE
         lifted scan instance — one parameter scope — serve both call
-        lengths."""
-        net, coords1 = carry
-        coords1 = jax.lax.stop_gradient(coords1)
+        lengths.
+
+        With ``early_exit=(tol, patience)`` set, the mask-free test_mode
+        branch carries ``(net, coords1, consec, done, used)`` instead of
+        ``(net, coords1)``: every iteration still computes the update
+        (the scan stays one static-shaped executable — the win is
+        accounting and a stable numeric contract, not wall-clock on a
+        dense batch), but a sample whose low-res delta-flow norm has sat
+        below ``tol`` for ``patience`` consecutive iterations is frozen
+        — its ``net``/``coords1`` stop advancing, so its result is the
+        value it converged to, independent of how many further
+        iterations the rest of the batch needs. ``used`` counts the
+        iterations each sample actually consumed."""
+        masked = (self.early_exit is not None and compute_up is None
+                  and not self.is_initializing())
+        if masked:
+            net_prev, coords1_prev, consec, done, used = carry
+        else:
+            net_prev, coords1_prev = carry
+        coords1 = jax.lax.stop_gradient(coords1_prev)
         corr = _lookup(self.config, corr_state, coords1)
-        corr = corr.astype(net.dtype)
-        flow = (coords1 - coords0).astype(net.dtype)
+        corr = corr.astype(net_prev.dtype)
+        flow = (coords1 - coords0).astype(net_prev.dtype)
         net, up_mask, delta_flow = self.update_block(
-            net, inp, corr, flow, compute_mask=compute_up)
+            net_prev, inp, corr, flow, compute_mask=compute_up)
         coords1 = coords1 + delta_flow.astype(jnp.float32)
         new_flow = coords1 - coords0
+
+        if masked:
+            tol, patience = self.early_exit
+            # Per-sample mean L2 norm of this iteration's low-res delta
+            # — the paper's convergence signal: RAFT's updates shrink
+            # monotonically toward the fixed point, so a plateau below
+            # tol is a stable stop criterion.
+            delta32 = delta_flow.astype(jnp.float32)
+            delta_norm = jnp.sqrt(
+                jnp.mean(jnp.sum(delta32 * delta32, axis=-1),
+                         axis=(1, 2)))
+            below = delta_norm < jnp.float32(tol)
+            consec = jnp.where(done, consec,
+                               jnp.where(below, consec + 1, 0))
+            keep = done[:, None, None, None]
+            # Freeze on the PREVIOUS done flag: the iteration on which a
+            # sample converges still applies its (sub-tol) update; only
+            # later iterations are masked out.
+            net = jnp.where(keep, net_prev, net)
+            coords1 = jnp.where(keep, coords1_prev, coords1)
+            used = used + jnp.where(done, 0, 1).astype(jnp.int32)
+            done = done | (consec >= patience)
+            return (net, coords1, consec, done, used), ()
 
         if compute_up is None and not self.is_initializing():
             # test_mode non-final: no mask, no upsample, no per-
@@ -181,7 +229,8 @@ class RAFT(nn.Module):
     def __call__(self, image1, image2, iters: Optional[int] = None,
                  flow_init=None, test_mode: bool = False,
                  train: bool = False, freeze_bn: bool = False,
-                 fmap1=None, fmap2=None):
+                 fmap1=None, fmap2=None,
+                 early_exit: Optional[Tuple[float, int]] = None):
         """``freeze_bn`` keeps BatchNorm in eval (running-average) mode
         while the rest trains — the reference's post-chairs freeze
         (``core/raft.py:60-63``, ``train.py:414-415``).
@@ -189,7 +238,16 @@ class RAFT(nn.Module):
         ``fmap1``/``fmap2``: precomputed feature maps (both or neither,
         from :meth:`encode_features`). When given, the fnet pass is
         skipped entirely and ``image2`` may be ``None`` — the
-        refine-only entry point of the streaming serving path."""
+        refine-only entry point of the streaming serving path.
+
+        ``early_exit``: static ``(tol, patience)`` enabling per-sample
+        convergence masking in the test_mode refine loop (see
+        ``_UpdateStep``). test_mode-only; when set the return becomes
+        ``(flow_low, flow_up, iters_used)`` with ``iters_used`` an
+        ``(B,)`` int32 of refinement iterations each sample actually
+        consumed (the final mask-computing iteration always runs and is
+        included). ``None`` (default) leaves every code path and output
+        byte-identical to before the knob existed."""
         cfg = self.config
         norm_train = train and not freeze_bn
         iters = iters if iters is not None else cfg.iters
@@ -243,6 +301,9 @@ class RAFT(nn.Module):
         # upsampling-mask head and convex upsampling; training needs every
         # intermediate upsampled flow for the sequence loss.
         last_only = test_mode and not self.is_initializing()
+        if early_exit is not None and not test_mode:
+            raise ValueError("early_exit is a test_mode-only knob")
+        ee = early_exit if last_only else None
         carry = (net, coords1)
         # length=None: the trip count comes from the scanned dummy
         # tick, so the SAME lifted instance (one "update" parameter
@@ -259,9 +320,26 @@ class RAFT(nn.Module):
                      nn.broadcast),
             out_axes=0,
             length=None,
-        )(cfg, name="update")
+        )(cfg, ee, name="update")
 
         if last_only:
+            if ee is not None:
+                consec = jnp.zeros((B,), jnp.int32)
+                done = jnp.zeros((B,), bool)
+                used = jnp.zeros((B,), jnp.int32)
+                carry = (net, coords1, consec, done, used)
+                if iters > 1:
+                    carry, _ = scan(carry, jnp.zeros(iters - 1), None,
+                                    corr_state, inp, coords0)
+                net, coords1, consec, done, used = carry
+                carry = (net, coords1)
+                carry, flow_up = scan(carry, jnp.zeros(1), True,
+                                      corr_state, inp, coords0)
+                net, coords1 = carry
+                flow_low = coords1 - coords0
+                # The mask-computing final iteration runs for every
+                # sample (one executable, one upsample), hence +1.
+                return flow_low, flow_up[0], used + 1
             if iters > 1:
                 carry, _ = scan(carry, jnp.zeros(iters - 1), None,
                                 corr_state, inp, coords0)

@@ -17,6 +17,11 @@ forward itself:
 * :mod:`~raft_tpu.serving.health` — engine health states, the dispatch
   :class:`~raft_tpu.serving.health.CircuitBreaker`, and the
   :class:`~raft_tpu.serving.health.EngineUnhealthy` fail-fast error.
+* :mod:`~raft_tpu.serving.brownout` — graceful brownout under
+  overload: the :class:`~raft_tpu.serving.brownout.BrownoutController`
+  steps LOW traffic down a pre-warmed GRU-iteration quality ladder
+  (degraded answers before dropped ones) and back up with hysteresis;
+  zero fresh compiles, HIGH traffic never degraded.
 * :mod:`~raft_tpu.serving.reload` — hot checkpoint reload: watch the
   trainer's commit-gated checkpoints, canary-validate a standby model
   on golden pairs (zero-compile via the shared executable cache), swap
@@ -46,6 +51,7 @@ from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
                                       PRIORITY_LOW, BacklogFull,
                                       QueuedRequest, RequestTimedOut,
                                       ShapeBucketBatcher)
+from raft_tpu.serving.brownout import BrownoutController
 from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
                                      enable_persistent_compile_cache,
                                      make_engine)
@@ -63,6 +69,7 @@ from raft_tpu.serving.session import StreamSession
 
 __all__ = [
     "BacklogFull",
+    "BrownoutController",
     "BucketRouter",
     "CanaryResult",
     "CircuitBreaker",

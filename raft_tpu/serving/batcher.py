@@ -56,17 +56,20 @@ class QueuedRequest:
     ``image1``, and — warm frames only — the forward-splatted
     ``flow_init``. Their bucket keys extend the padded-shape tuple with
     a ``"warm"``/``"cold"`` tag so warm frames batch separately from
-    cold (distinct executables, different iteration counts); the
-    batcher itself is generic over hashable bucket keys."""
+    cold (distinct executables, different iteration counts); degraded-
+    quality (brownout) requests extend it with an integer iters level
+    instead — ``(ph, pw, iters)``. The batcher itself is generic over
+    hashable bucket keys."""
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
                  "deadline", "priority", "poisoned", "session",
-                 "flow_init", "fmap1", "future")
+                 "flow_init", "fmap1", "degradable", "future")
 
     def __init__(self, image1, image2, padder, bucket,
                  t_submit: float, deadline: Optional[float] = None,
                  priority: str = PRIORITY_HIGH, poisoned: bool = False,
-                 session=None, flow_init=None, fmap1=None):
+                 session=None, flow_init=None, fmap1=None,
+                 degradable: bool = False):
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -81,6 +84,10 @@ class QueuedRequest:
         self.session = session
         self.flow_init = flow_init
         self.fmap1 = fmap1
+        # Controller-managed quality: True marks a LOW request the
+        # brownout ladder may re-bucket while it waits (engine-set;
+        # explicit client-chosen iters stay where they were queued).
+        self.degradable = degradable
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:
@@ -192,6 +199,57 @@ class ShapeBucketBatcher:
             del self._buckets[newest_key]
         self._pending -= 1
         return victim
+
+    def rebucket_low(self,
+                     mapper: Callable[[QueuedRequest], Optional[object]]
+                     ) -> int:
+        """Move queued LOW requests between buckets (the brownout
+        ladder's step transitions): ``mapper`` sees each queued LOW
+        request and returns the bucket key it should move to, or
+        ``None`` to leave it where it is (the policy — which requests
+        the ladder manages — lives in the caller). Returns the number
+        of requests moved.
+
+        **Deadline anchoring:** a moved request keeps its original
+        ``t_submit`` (the batching ``max_wait`` anchor — its wait so
+        far still counts toward closing the new bucket) and its
+        original queue-timeout ``deadline``. Re-bucketing changes only
+        which executable will serve the request, never how long it is
+        allowed to wait — stepping the ladder must not silently reset
+        ``max_wait_ms``. FIFO order among movers from one source lane
+        is preserved; movers append behind any LOW requests already
+        queued in the target bucket."""
+        moved = 0
+        with self._cond:
+            # Two passes: decide every move first, then apply — a
+            # request moved into a bucket later in iteration order must
+            # not be re-examined (or bounced again) this call.
+            moves: List[Tuple[QueuedRequest, object]] = []
+            for key in list(self._buckets):
+                bucket = self._buckets[key]
+                if not bucket.low:
+                    continue
+                keep: deque = deque()
+                for req in bucket.low:
+                    new_key = mapper(req)
+                    if new_key is None or new_key == req.bucket:
+                        keep.append(req)
+                    else:
+                        moves.append((req, new_key))
+                bucket.low = keep
+                if not len(bucket):
+                    del self._buckets[key]
+            for req, new_key in moves:
+                req.bucket = new_key
+                self._buckets.setdefault(new_key, _Bucket()) \
+                    .low.append(req)
+                moved += 1
+            if moved:
+                # Moved (older) requests can make the target bucket
+                # full or past-deadline right now — wake the dispatcher
+                # to re-evaluate.
+                self._cond.notify_all()
+        return moved
 
     def pending(self) -> int:
         with self._cond:

@@ -1,0 +1,172 @@
+"""Graceful brownout: shed *quality* before shedding *requests*.
+
+RAFT's accuracy is a near-monotone function of GRU iteration count (the
+paper evaluates at 12/24/32 iterations and EPE degrades smoothly, not
+cliff-like, as iterations shrink) — which makes iteration count the one
+serving-time knob that trades answer quality for capacity continuously.
+Under overload the engine's existing pressure valves are all binary per
+request: shed LOW, time out, or fail fast. This module adds the
+graduated valve in front of them: a :class:`BrownoutController` watches
+the engine's queue-depth/inflight pressure and steps LOW-priority
+traffic down a configured **quality ladder** (e.g. full 12 → 8 → 6 → 4
+iterations) one rung at a time, and back up with hysteresis as the
+backlog drains. Requests are only shed once the ladder is exhausted —
+a degraded answer beats a dropped one.
+
+Contract highlights (enforced by the engine, drilled by
+``scripts/serve_drill.py --drill brownout``):
+
+* **HIGH traffic is never degraded.** The ladder applies to
+  ``PRIORITY_LOW`` submits (and LOW warm stream pairs) only; an
+  explicit ``submit(iters=...)`` is a client *choice*, not a
+  degradation, and is honored for either class.
+* **Zero fresh compiles.** Every ladder level's executable is
+  pre-compiled by warmup alongside the full-quality bucket, so
+  stepping down the ladder swaps batcher buckets, never compiles.
+* **Hysteresis, not flapping.** Steps (either direction) are one rung
+  per observation and rate-limited by ``dwell_s``; stepping down
+  requires pressure at/above ``high_water``, stepping up requires it
+  at/below ``low_water`` — the gap between the two watermarks plus the
+  dwell is the flap damping.
+
+The controller is deliberately JAX-free, thread-safe and
+clock-injectable (the same testing discipline as
+:class:`~raft_tpu.serving.health.CircuitBreaker`), and keeps its own
+observability counters: ladder ``transitions`` (every level change,
+either direction) and accumulated ``time_in_brownout_s`` (wall time at
+any level below full quality), both streamed as gauges through
+:class:`~raft_tpu.serving.metrics.ServingMetrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Sequence, Tuple
+
+
+class BrownoutController:
+    """Watermark ladder controller for adaptive quality under overload.
+
+    Args:
+      ladder: strictly-descending GRU iteration counts BELOW full
+        quality, best first (e.g. ``(8, 6, 4)`` under a full quality of
+        12). Level 0 means full quality; level ``k`` (1-based) serves
+        LOW traffic at ``ladder[k - 1]`` iterations.
+      high_water: pressure (queued + in-flight requests) at or above
+        which the controller steps DOWN one rung.
+      low_water: pressure at or below which it steps back UP one rung.
+        Must be strictly below ``high_water`` (the hysteresis band).
+      dwell_s: minimum seconds between level changes in either
+        direction (flap damping; also paces multi-rung descents).
+      clock: injectable monotonic clock (tests drive transitions
+        without sleeping).
+    """
+
+    def __init__(self, ladder: Sequence[int], high_water: int,
+                 low_water: int = 0, dwell_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        ladder = tuple(int(v) for v in ladder)
+        if not ladder:
+            raise ValueError("brownout ladder must name at least one "
+                             "degraded iters level")
+        if any(v < 1 for v in ladder):
+            raise ValueError(f"ladder levels must be >= 1, got {ladder}")
+        if any(a <= b for a, b in zip(ladder, ladder[1:])):
+            raise ValueError("ladder must be strictly descending "
+                             f"(best quality first), got {ladder}")
+        if high_water < 1:
+            raise ValueError(f"high_water must be >= 1, got {high_water}")
+        if not (0 <= low_water < high_water):
+            raise ValueError(
+                f"need 0 <= low_water < high_water for hysteresis, got "
+                f"low_water={low_water}, high_water={high_water}")
+        if dwell_s < 0:
+            raise ValueError(f"dwell_s must be >= 0, got {dwell_s}")
+        self.ladder = ladder
+        self.high_water = int(high_water)
+        self.low_water = int(low_water)
+        self.dwell_s = float(dwell_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = 0
+        self._last_change = -float("inf")
+        self._entered_brownout = 0.0   # valid while _level > 0
+        self._brownout_accum = 0.0
+        self.transitions = 0           # level changes, either direction
+
+    # -- reading ---------------------------------------------------------
+
+    @property
+    def level(self) -> int:
+        """Current ladder position: 0 = full quality, ``len(ladder)`` =
+        deepest degradation."""
+        with self._lock:
+            return self._level
+
+    @property
+    def exhausted(self) -> bool:
+        """True at the bottom rung — the engine's signal that the next
+        pressure valve is request shedding, there is no quality left to
+        give."""
+        with self._lock:
+            return self._level == len(self.ladder)
+
+    def iters_for(self, full_iters: int) -> int:
+        """The iteration count LOW traffic should serve at right now."""
+        with self._lock:
+            if self._level == 0:
+                return int(full_iters)
+            return self.ladder[self._level - 1]
+
+    def time_in_brownout_s(self) -> float:
+        """Accumulated wall time spent at any level > 0, including the
+        in-progress episode."""
+        with self._lock:
+            total = self._brownout_accum
+            if self._level > 0:
+                total += self._clock() - self._entered_brownout
+            return total
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "level": self.level,
+            "ladder": list(self.ladder),
+            "exhausted": self.exhausted,
+            "transitions": self.transitions,
+            "time_in_brownout_s": self.time_in_brownout_s(),
+            "high_water": self.high_water,
+            "low_water": self.low_water,
+        }
+
+    # -- driving ---------------------------------------------------------
+
+    def observe(self, pressure: float) -> Tuple[int, int]:
+        """Feed one pressure sample; returns ``(old_level, new_level)``.
+
+        At most one rung moves per call, and only if ``dwell_s`` has
+        elapsed since the last change — the caller (the engine's router
+        loop) samples continuously, so descent speed is paced by the
+        dwell, not by the sample rate."""
+        with self._lock:
+            old = self._level
+            now = self._clock()
+            if now - self._last_change < self.dwell_s:
+                return old, old
+            if pressure >= self.high_water and self._level < len(self.ladder):
+                self._change_to(self._level + 1, now)
+            elif pressure <= self.low_water and self._level > 0:
+                self._change_to(self._level - 1, now)
+            return old, self._level
+
+    def _change_to(self, new_level: int, now: float) -> None:
+        """Caller holds the lock."""
+        if new_level == self._level:
+            return
+        if self._level == 0 and new_level > 0:
+            self._entered_brownout = now
+        elif self._level > 0 and new_level == 0:
+            self._brownout_accum += now - self._entered_brownout
+        self._level = new_level
+        self._last_change = now
+        self.transitions += 1

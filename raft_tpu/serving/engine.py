@@ -89,6 +89,7 @@ from raft_tpu.serving import health as health_mod
 from raft_tpu.serving.batcher import (PRIORITY_HIGH, PRIORITY_LOW,
                                       BacklogFull, QueuedRequest,
                                       RequestTimedOut, ShapeBucketBatcher)
+from raft_tpu.serving.brownout import BrownoutController
 from raft_tpu.serving.health import CircuitBreaker, EngineUnhealthy
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
@@ -189,6 +190,27 @@ class ServingConfig:
         propagated previous flow, so they converge in fewer iterations.
         ``None`` leaves the predictor's own ``warm_iters`` (→ full
         ``iters`` when unset there too).
+      iters_ladder: strictly-descending GRU iteration counts below the
+        predictor's full ``iters`` (e.g. ``(8, 6, 4)`` under 12) — the
+        graceful-brownout quality ladder. Warmup pre-compiles every
+        configured bucket at every ladder level (and warm stream
+        buckets at each capped warm level), ``submit(iters=...)``
+        accepts exactly ``{full iters} ∪ ladder`` (anything else is a
+        ``ValueError`` — never a silent compile), and the
+        :class:`~raft_tpu.serving.brownout.BrownoutController` steps
+        LOW traffic down these levels under pressure. Empty = no
+        ladder: ``submit(iters=full)`` still works, everything else is
+        rejected.
+      brownout_high_water: pressure (queued requests plus in-flight
+        batches) at or above which the brownout controller steps LOW
+        traffic one rung down the ladder. ``0`` (default) disables the
+        controller — the ladder is then only reachable via explicit
+        ``submit(iters=...)``.
+      brownout_low_water: pressure at or below which the controller
+        steps back up one rung (must be < high_water — the hysteresis
+        band).
+      brownout_dwell_ms: minimum milliseconds between ladder steps in
+        either direction (flap damping).
     """
 
     max_batch: int = 8
@@ -207,6 +229,10 @@ class ServingConfig:
     replica_id: Optional[str] = None
     warm_buckets: Tuple[Tuple[int, int], ...] = ()
     warm_iters: Optional[int] = None
+    iters_ladder: Tuple[int, ...] = ()
+    brownout_high_water: int = 0
+    brownout_low_water: int = 0
+    brownout_dwell_ms: float = 250.0
 
 
 class _BucketStream:
@@ -308,6 +334,16 @@ class _BucketStream:
                 eng._inflight_batches -= 1
             eng.breaker.record_success()
             now = time.monotonic()
+            served_iters = eng._bucket_iters(self.bucket)
+            if not is_stream and len(out) > 2:
+                # Early-exit path: out[2] is per-sample iterations
+                # actually run (tail-pad slots excluded from the
+                # savings — they aren't served work).
+                used = np.asarray(out[2])[:len(batch)]
+                saved = int(np.maximum(served_iters - used, 0).sum())
+                if saved:
+                    eng.metrics.record_early_exit_saved(saved)
+            eng.metrics.record_quality(served_iters, n=len(batch))
             with eng.stages.stage("unpad"):
                 for j, r in enumerate(batch):
                     if is_stream:
@@ -362,6 +398,37 @@ class ServingEngine:
             # warmup/serve compile so warm buckets warm the right
             # executable.
             predictor.warm_iters = self.config.warm_iters
+        self._full_iters = int(predictor.iters)
+        self._base_warm_iters = int(predictor.warm_iters
+                                    or self._full_iters)
+        ladder = tuple(int(v) for v in self.config.iters_ladder)
+        if ladder:
+            bad = [v for v in ladder if not 1 <= v < self._full_iters]
+            if bad:
+                raise ValueError(
+                    f"iters_ladder levels must sit strictly below the "
+                    f"predictor's full iters={self._full_iters} (and be "
+                    f">= 1), got {ladder}")
+            if any(a <= b for a, b in zip(ladder, ladder[1:])):
+                raise ValueError("iters_ladder must be strictly "
+                                 f"descending, got {ladder}")
+        self._iters_ladder = ladder
+        # submit(iters=...) accepts exactly these (warmed) levels.
+        self._iters_levels = frozenset({self._full_iters, *ladder})
+        # Warm stream pairs ladder to min(base warm, level): a level
+        # above the warm count would *raise* warm quality under
+        # overload. Only effs that differ from the base need their own
+        # executable/bucket.
+        self._warm_effs = tuple(sorted(
+            {min(self._base_warm_iters, v) for v in ladder}
+            - {self._base_warm_iters}, reverse=True))
+        self.brownout: Optional[BrownoutController] = None
+        if ladder and self.config.brownout_high_water >= 1:
+            self.brownout = BrownoutController(
+                ladder,
+                high_water=self.config.brownout_high_water,
+                low_water=self.config.brownout_low_water,
+                dwell_s=self.config.brownout_dwell_ms / 1e3)
         self.metrics = ServingMetrics()
         self.stages = HostStageTimer()
         self.breaker = CircuitBreaker(
@@ -384,14 +451,26 @@ class ServingEngine:
         # separately from cold (different executables and iteration
         # counts), and both tags of a configured warm bucket keep
         # permanent dispatch streams.
-        self._dedicated_buckets = frozenset(
+        self._stateless_padded = frozenset(
             InputPadder((*hw, 3), mode=self.config.pad_mode,
                         factor=self.config.factor).padded_shape
-            for hw in self.config.buckets) | frozenset(
-            (*InputPadder((*hw, 3), mode=self.config.pad_mode,
-                          factor=self.config.factor).padded_shape, kind)
-            for hw in self.config.warm_buckets
-            for kind in ("warm", "cold"))
+            for hw in self.config.buckets)
+        self._warm_padded = frozenset(
+            InputPadder((*hw, 3), mode=self.config.pad_mode,
+                        factor=self.config.factor).padded_shape
+            for hw in self.config.warm_buckets)
+        # Every ladder level of a configured bucket (and every capped
+        # warm level of a warm bucket) is pre-compiled by warmup, so
+        # their streams are dedicated too — stepping the brownout
+        # ladder must never retire/recreate a stream mid-overload.
+        self._dedicated_buckets = (
+            self._stateless_padded
+            | frozenset((*p, kind) for p in self._warm_padded
+                        for kind in ("warm", "cold"))
+            | frozenset((*p, lvl) for p in self._stateless_padded
+                        for lvl in ladder)
+            | frozenset((*p, "warm", eff) for p in self._warm_padded
+                        for eff in self._warm_effs))
         self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
@@ -417,6 +496,13 @@ class ServingEngine:
         m.set_gauge_source(
             "health_state",
             lambda: health_mod.HEALTH_CODES[self.health_state()])
+        if self.brownout is not None:
+            ctl = self.brownout
+            m.set_gauge_source("brownout_level", lambda: ctl.level)
+            m.set_gauge_source("brownout_transitions",
+                               lambda: ctl.transitions)
+            m.set_gauge_source("brownout_time_s",
+                               ctl.time_in_brownout_s)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -469,6 +555,18 @@ class ServingEngine:
                     np.asarray(out[1])        # sync: compile + one run
                 stats[(ph, pw)] = {"compiles": float(w.compiles),
                                    "seconds": time.perf_counter() - t0}
+                for lvl in self._iters_ladder:
+                    # Every brownout ladder level gets its executable
+                    # here — stepping the ladder under overload swaps
+                    # batcher buckets, never compiles.
+                    t0 = time.perf_counter()
+                    with CompileWatch() as w:
+                        out = self.predictor.dispatch_batch(
+                            z1, z2, iters=lvl)
+                        np.asarray(out[1])
+                    stats[(ph, pw, lvl)] = {
+                        "compiles": float(w.compiles),
+                        "seconds": time.perf_counter() - t0}
             for raw_hw in (self.config.warm_buckets
                            if buckets is None else ()):
                 stats.update(self._warmup_session_bucket(raw_hw))
@@ -501,6 +599,14 @@ class ServingEngine:
                 np.zeros_like(z), fm.copy(), fm, flow_init=init,
                 warm=True)
             np.asarray(out[1])
+            for eff in self._warm_effs:
+                # Browned-out warm levels (min(warm_iters, ladder
+                # level), dedup'd) — warm pairs step the ladder at
+                # zero compiles too.
+                out = self.predictor.refine_dispatch(
+                    np.zeros_like(z), fm.copy(), fm, flow_init=init,
+                    warm=True, iters=eff)
+                np.asarray(out[1])
         return {(ph, pw, "session"): {
             "compiles": float(w.compiles),
             "seconds": time.perf_counter() - t0}}
@@ -540,9 +646,11 @@ class ServingEngine:
     def health_state(self) -> str:
         """The engine's readiness state, one of
         :mod:`raft_tpu.serving.health`'s ``STARTING / WARMING / READY /
-        DEGRADED / OPEN / CLOSED``. The single string a load balancer
-        routes on: ``ready`` and ``degraded`` take traffic, everything
-        else doesn't."""
+        DEGRADED / BROWNOUT / OPEN / CLOSED``. The single string a load
+        balancer routes on: ``ready``, ``degraded`` and ``brownout``
+        take traffic, everything else doesn't. Fault states win over
+        BROWNOUT: a browned-out engine that also trips its breaker
+        reports the fault."""
         if self._closed:
             return health_mod.CLOSED
         if self._warming:
@@ -556,6 +664,8 @@ class ServingEngine:
             degraded = bool(self._degraded_reasons)
         if b == CircuitBreaker.HALF_OPEN or degraded:
             return health_mod.DEGRADED
+        if self.brownout is not None and self.brownout.level > 0:
+            return health_mod.BROWNOUT
         return health_mod.READY
 
     def health(self) -> Dict[str, object]:
@@ -568,7 +678,9 @@ class ServingEngine:
             reasons = sorted(self._degraded_reasons)
         return {
             "state": state,
-            "ready": state in (health_mod.READY, health_mod.DEGRADED),
+            "ready": health_mod.is_routable(state),
+            "brownout": (self.brownout.stats()
+                         if self.brownout is not None else None),
             "breaker": self.breaker.state,
             "breaker_trips": self.breaker.trips,
             "consecutive_failures": self.breaker.consecutive_failures,
@@ -628,15 +740,33 @@ class ServingEngine:
     # -- client API -----------------------------------------------------
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
-               priority: str = PRIORITY_HIGH):
+               priority: str = PRIORITY_HIGH,
+               iters: Optional[int] = None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
         ``image1``/``image2``: (H, W, 3) float arrays in [0, 255], any
         resolution (padded here, in the caller's thread).
         ``priority``: ``"high"`` (default — batches first) or ``"low"``
         (background class: batched after HIGH, first shed under a full
-        backlog). Thread-safe.
+        backlog). ``iters``: explicit GRU iteration count — must be the
+        predictor's full count or a configured ``iters_ladder`` level
+        (anything else raises ``ValueError`` naming the warmed levels;
+        an unwarmed count would silently compile under load). ``None``
+        (default) serves full quality, except LOW requests on
+        configured buckets while the brownout controller holds a
+        degraded level. Thread-safe.
         """
+        if iters is not None:
+            iters = int(iters)
+            if iters not in self._iters_levels:
+                levels = sorted(self._iters_levels, reverse=True)
+                raise ValueError(
+                    f"iters={iters} is not a warmed quality level on "
+                    f"this engine; configured levels are {levels} "
+                    f"(full quality {self._full_iters}"
+                    + (f" plus ladder {list(self._iters_ladder)}"
+                       if self._iters_ladder else
+                       "; no iters_ladder configured") + ")")
         self._check_accepting()
         if image1.shape != image2.shape:
             raise ValueError(f"frame shapes differ: {image1.shape} vs "
@@ -645,17 +775,37 @@ class ServingEngine:
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self.config.factor)
             im1, im2 = padder.pad(image1, image2)
+        padded = padder.padded_shape
+        bucket_iters = None
+        degradable = False
+        if iters is not None and iters != self._full_iters:
+            # Explicit client choice: honored for either priority
+            # class, never re-bucketed by the controller.
+            bucket_iters = iters
+        elif (iters is None and priority == PRIORITY_LOW
+              and self.brownout is not None
+              and padded in self._stateless_padded):
+            # Controller-managed traffic: serve at the current ladder
+            # level, and mark the request so level changes re-bucket it
+            # while it still waits in the queue.
+            degradable = True
+            lvl = self.brownout.level
+            if lvl:
+                bucket_iters = self._iters_ladder[lvl - 1]
+        bucket = (padded if bucket_iters is None
+                  else (*padded, bucket_iters))
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
         with self._state_lock:
             self._submit_seq += 1
             seq = self._submit_seq
-        req = QueuedRequest(im1, im2, padder, bucket=padder.padded_shape,
+        req = QueuedRequest(im1, im2, padder, bucket=bucket,
                             t_submit=t_submit, deadline=deadline,
                             priority=priority,
                             poisoned=active_injector()
-                            .poisons_request(seq))
+                            .poisons_request(seq),
+                            degradable=degradable)
         return self._enqueue_request(req)
 
     def _check_accepting(self) -> None:
@@ -754,9 +904,27 @@ class ServingEngine:
         (``flow_init`` given) and cold pairs batch in separate
         ``(ph, pw, "warm"/"cold")`` buckets — distinct executables,
         distinct iteration counts — alongside, never inside, stateless
-        traffic."""
+        traffic. Under brownout, LOW *warm* pairs on configured warm
+        buckets step down the ladder too — capped at the base warm
+        count (``min(warm_iters, level)``), bucketed as ``(ph, pw,
+        "warm", eff)``. Cold/prime pairs keep the cold policy: they
+        seed the stream's state, and a degraded seed would poison
+        every warm frame after it."""
         self._check_accepting()
         warm = flow_init is not None
+        padded = padder.padded_shape
+        bucket = (*padded, "warm" if warm else "cold")
+        degradable = False
+        if (warm and priority == PRIORITY_LOW
+                and self.brownout is not None
+                and padded in self._warm_padded):
+            degradable = True
+            lvl = self.brownout.level
+            if lvl:
+                eff = min(self._base_warm_iters,
+                          self._iters_ladder[lvl - 1])
+                if eff != self._base_warm_iters:
+                    bucket = (*padded, "warm", eff)
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
@@ -764,11 +932,11 @@ class ServingEngine:
             self._submit_seq += 1
             seq = self._submit_seq
         req = QueuedRequest(
-            image1, image2, padder,
-            bucket=(*padder.padded_shape, "warm" if warm else "cold"),
+            image1, image2, padder, bucket=bucket,
             t_submit=t_submit, deadline=deadline, priority=priority,
             poisoned=active_injector().poisons_request(seq),
-            session=session, flow_init=flow_init, fmap1=fmap1)
+            session=session, flow_init=flow_init, fmap1=fmap1,
+            degradable=degradable)
         fut = self._enqueue_request(req)
         self.metrics.record_stream_submit(warm)
         self.metrics.record_encoder_cache(hit=True)
@@ -831,6 +999,11 @@ class ServingEngine:
                 batch = self.batcher.next_batch(timeout=0.1)
                 if batch is None:
                     break
+                # next_batch returns [] at least every 0.1 s even when
+                # idle, so the controller is sampled continuously —
+                # including while the backlog drains with no new
+                # arrivals (the step-back-up path).
+                self._brownout_tick()
                 if not batch:
                     continue
                 self._stream_for(batch[0].bucket).put(batch)
@@ -848,6 +1021,52 @@ class ServingEngine:
                 streams = list(self._streams.values())
             for stream in streams:
                 stream.close()
+
+    def _brownout_tick(self) -> None:
+        """Feed the controller one pressure sample (router thread);
+        apply a level change by re-bucketing queued degradable LOW
+        requests so already-waiting work degrades (or recovers) too,
+        with its original deadlines intact."""
+        ctl = self.brownout
+        if ctl is None:
+            return
+        with self._state_lock:
+            inflight = self._inflight_batches
+        old, new = ctl.observe(self.batcher.pending() + inflight)
+        if new != old:
+            self.batcher.rebucket_low(self._brownout_bucket_for)
+
+    def _brownout_bucket_for(self, req: QueuedRequest):
+        """Rebucket mapper: the bucket a queued controller-managed LOW
+        request belongs in at the CURRENT ladder level (``None`` =
+        leave it alone). Explicit ``submit(iters=...)`` requests are
+        never marked degradable, so a client's chosen level is honored
+        even while its request waits in a bucket the ladder also
+        uses."""
+        if not req.degradable:
+            return None
+        lvl = self.brownout.level
+        base = req.bucket[:2]
+        if req.session is not None:          # warm stream pair
+            eff = (self._base_warm_iters if lvl == 0
+                   else min(self._base_warm_iters,
+                            self._iters_ladder[lvl - 1]))
+            return ((*base, "warm") if eff == self._base_warm_iters
+                    else (*base, "warm", eff))
+        return (base if lvl == 0
+                else (*base, self._iters_ladder[lvl - 1]))
+
+    def _bucket_iters(self, bucket: Tuple) -> int:
+        """GRU iteration count the executable serving ``bucket`` runs —
+        the served-quality level the metrics histogram records."""
+        if len(bucket) == 4:                          # (ph, pw, "warm", eff)
+            return int(bucket[3])
+        if len(bucket) == 3:
+            if isinstance(bucket[2], int):            # (ph, pw, iters)
+                return int(bucket[2])
+            if bucket[2] == "warm":
+                return self._base_warm_iters
+        return self._full_iters                       # stateless / cold
 
     def _stack(self, batch: List[QueuedRequest]):
         n = len(batch)
@@ -878,6 +1097,11 @@ class ServingEngine:
         inj.maybe_fail_serving_dispatch()
         with self._swap_lock:
             predictor = self.predictor
+        bucket = batch[0].bucket
+        if len(bucket) == 3 and isinstance(bucket[2], int):
+            # Degraded-quality (or explicit-iters) bucket: its own
+            # pre-warmed executable at that iteration count.
+            return predictor.dispatch_batch(i1, i2, iters=bucket[2])
         return predictor.dispatch_batch(i1, i2)
 
     def _dispatch_stream_arrays(self, batch: List[QueuedRequest]):
@@ -915,8 +1139,12 @@ class ServingEngine:
         with self._swap_lock:
             predictor = self.predictor
         fmap2 = predictor.encode_dispatch(i2)
+        bucket = batch[0].bucket
+        # (ph, pw, "warm", eff): browned-out warm pairs refine at the
+        # capped ladder level instead of the base warm count.
+        iters = bucket[3] if len(bucket) == 4 else None
         flow_low, flow_up = predictor.refine_dispatch(
-            i1, fm1, fmap2, flow_init=finit, warm=warm)
+            i1, fm1, fmap2, flow_init=finit, warm=warm, iters=iters)
         return flow_low, flow_up, fmap2
 
     def _dispatch_one(self, batch: List[QueuedRequest],
@@ -1010,6 +1238,12 @@ class ServingEngine:
                 continue
             if is_stream:
                 r.session._complete(fmap2[:1].copy(), flow_low[0].copy())
+            served_iters = self._bucket_iters(r.bucket)
+            if not is_stream and len(out) > 2:
+                saved = max(served_iters - int(np.asarray(out[2])[0]), 0)
+                if saved:
+                    self.metrics.record_early_exit_saved(saved)
+            self.metrics.record_quality(served_iters)
             r.future.set_result(r.padder.unpad(flow_up[0]))
             self.metrics.record_done(time.monotonic() - r.t_submit)
             self.metrics.record_isolated_retry()

@@ -130,10 +130,15 @@ class BucketRouter:
     def _score(bucket: Bucket, replica_id: str) -> int:
         # Bucket keys render as "HxW" — the historical digest input, so
         # assignments stay stable across this refactor (golden tests
-        # pin them). Stream keys use a "stream:" prefix and can never
-        # collide with the "HxW" namespace.
-        return BucketRouter._score_key(
-            f"{bucket[0]}x{bucket[1]}", replica_id)
+        # pin them). Degraded-quality buckets ``(h, w, iters)`` render
+        # as "HxW@I" — the "@" keeps them disjoint from both the
+        # golden-pinned "HxW" namespace and the "stream:" prefix, and
+        # the digest stays bit-stable per (shape, level) so a ladder
+        # level always routes to the same replica.
+        key = f"{bucket[0]}x{bucket[1]}"
+        if len(bucket) > 2:
+            key = f"{key}@{bucket[2]}"
+        return BucketRouter._score_key(key, replica_id)
 
     @property
     def replica_ids(self) -> List[str]:
@@ -502,8 +507,10 @@ class ServingFleet:
         """Fleet probe payload: per-replica ``health()`` dicts plus the
         fleet rollup — ``ready`` while at least one replica is
         routable, ``state`` = ``ready`` (all replicas READY) /
-        ``degraded`` (serving, but at least one replica isn't READY) /
-        ``open`` (no routable replica) / ``closed``."""
+        ``brownout`` (every replica healthy, at least one serving
+        degraded quality under load) / ``degraded`` (serving, but at
+        least one replica is faulted) / ``open`` (no routable replica)
+        / ``closed``."""
         replicas = {rid: eng.health()
                     for rid, eng in self._engines.items()}
         states = [r["state"] for r in replicas.values()]
@@ -514,6 +521,13 @@ class ServingFleet:
             state = health_mod.OPEN
         elif all(s == health_mod.READY for s in states):
             state = health_mod.READY
+        elif all(s in (health_mod.READY, health_mod.BROWNOUT)
+                 for s in states):
+            # Every replica is healthy and at least one is shedding
+            # quality under load — the capacity policy working, not a
+            # fault. A replica that is browned out AND faulted reports
+            # the fault, so this arm never masks one.
+            state = health_mod.BROWNOUT
         else:
             state = health_mod.DEGRADED
         return {"state": state, "ready": routable > 0,
@@ -523,18 +537,25 @@ class ServingFleet:
     # -- client API ----------------------------------------------------
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
-               priority: str = PRIORITY_HIGH):
+               priority: str = PRIORITY_HIGH,
+               iters: Optional[int] = None):
         """Route one request to its bucket's healthiest owner; returns
         a future resolving to the unpadded ``(H, W, 2)`` flow,
         bit-identical to any single replica's answer (replicas are
-        bit-interchangeable). Transparent failover on both refusal and
-        post-acceptance failure; ``future.replica_id`` is stamped when
-        the future resolves. Thread-safe."""
+        bit-interchangeable). ``iters`` (a warmed quality level — the
+        full count or an ``iters_ladder`` rung) extends the routed
+        bucket to ``(h, w, iters)``, so each degraded level rendezvous-
+        pins to its own replica with a bit-stable digest; the serving
+        engine still validates the level. Transparent failover on both
+        refusal and post-acceptance failure; ``future.replica_id`` is
+        stamped when the future resolves. Thread-safe."""
         if self._closed:
             raise RuntimeError("fleet is closed")
         outer: concurrent.futures.Future = concurrent.futures.Future()
         outer.replica_id = None
         bucket = self.bucket_for(image1.shape)
+        if iters is not None:
+            bucket = (*bucket, int(iters))
         self._dispatch(outer, image1, image2, priority, bucket,
                        tried=set(), hops=0, last_exc=None)
         return outer
@@ -574,7 +595,11 @@ class ServingFleet:
                 continue
             engine = self._engines[rid]
             try:
-                inner = engine.submit(image1, image2, priority=priority)
+                # A 3-tuple routed bucket carries its quality level;
+                # the engine re-validates it against its warmed ladder.
+                inner = engine.submit(
+                    image1, image2, priority=priority,
+                    iters=bucket[2] if len(bucket) > 2 else None)
             except Exception as e:
                 # Refused at the door (breaker fast-fail, backlog full,
                 # closed): try the next owner.

@@ -17,6 +17,12 @@ a first-class answer instead of "it hasn't crashed yet":
     pinned the current model after a canary rollback (a newer
     committed checkpoint exists but failed validation). Traffic is
     safe; page a human.
+  - ``BROWNOUT`` — serving, healthy, but the overload controller has
+    stepped LOW traffic down the quality ladder
+    (:class:`~raft_tpu.serving.brownout.BrownoutController`): nothing
+    is broken, answers are deliberately cheaper while the backlog
+    drains. Distinct from ``DEGRADED`` on purpose — DEGRADED pages a
+    human about a fault, BROWNOUT is the capacity policy working.
   - ``OPEN``     — the circuit breaker tripped: dispatch is failing
     consistently, submits fail fast with :class:`EngineUnhealthy`.
     Route elsewhere.
@@ -47,11 +53,14 @@ STARTING = "starting"
 WARMING = "warming"
 READY = "ready"
 DEGRADED = "degraded"
+BROWNOUT = "brownout"
 OPEN = "open"
 CLOSED = "closed"
 
 # Numeric encoding for the scalar stream (TrainLogger/JSONL want
 # floats): ordered roughly by "how routable is this replica".
+# BROWNOUT got the next free code (6) rather than a re-numbering —
+# the existing codes are pinned by dashboards and golden tests.
 HEALTH_CODES: Dict[str, int] = {
     STARTING: 0,
     WARMING: 1,
@@ -59,13 +68,16 @@ HEALTH_CODES: Dict[str, int] = {
     DEGRADED: 3,
     OPEN: 4,
     CLOSED: 5,
+    BROWNOUT: 6,
 }
 
 # The states a load balancer may send traffic to. DEGRADED is
-# deliberately routable (serving safely, paging a human); everything
-# else is either not up yet, failing, or gone. The single source of
-# truth the fleet router keys on.
-ROUTABLE = frozenset({READY, DEGRADED})
+# deliberately routable (serving safely, paging a human), and so is
+# BROWNOUT (serving cheaper answers is the point — routing away would
+# defeat the pressure relief); everything else is either not up yet,
+# failing, or gone. The single source of truth the fleet router keys
+# on.
+ROUTABLE = frozenset({READY, DEGRADED, BROWNOUT})
 
 
 def is_routable(state: str) -> bool:

@@ -457,3 +457,121 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
         "metrics": engine.metrics.snapshot(),
         "per_replica": replica_out,
     }
+
+
+def run_overload(engine, frames, n_low: int, n_high: int,
+                 refs_by_iters: Dict[int, List[np.ndarray]],
+                 full_iters: int, low_concurrency: int = 16,
+                 high_concurrency: int = 2,
+                 timeout: float = 300.0) -> Dict[str, object]:
+    """Burst the engine past capacity and grade the brownout contract.
+
+    ``low_concurrency`` closed-loop clients hammer LOW-priority
+    requests (the burst the quality ladder absorbs) while
+    ``high_concurrency`` clients run a HIGH control lane. Every
+    response is classified against ``refs_by_iters`` — per-quality
+    reference flows aligned to ``frames`` (``{iters: [flow, ...]}``,
+    must include ``full_iters``) — so the result names, bit-exactly,
+    which ladder level served each request:
+
+    * ``high_degraded``: HIGH responses that did NOT bit-match the
+      full-quality reference (the contract says this stays 0 — HIGH
+      is never browned out).
+    * ``quality_counts``: ``{iters: count}`` over LOW responses — the
+      drill's evidence that degraded levels actually served traffic.
+    * ``mismatched``: responses matching NO configured level — a blend
+      or garbage, never acceptable.
+    * ``dropped_low`` / ``dropped_high``: futures that raised
+      (BacklogFull, timeouts, ...). Until the ladder is exhausted the
+      brownout contract keeps these at 0.
+
+    Per-class client-observed latency percentiles ride along (the p99
+    bound the drill asserts). ``ok`` = everything completed, nothing
+    mismatched, no HIGH response degraded."""
+    if full_iters not in refs_by_iters:
+        raise ValueError(f"refs_by_iters must include the full-quality "
+                         f"level {full_iters}, got "
+                         f"{sorted(refs_by_iters)}")
+    lock = threading.Lock()
+    counters = {
+        "low": {"next": 0, "dropped": 0, "lats": []},
+        "high": {"next": 0, "dropped": 0, "lats": []},
+    }
+    quality_counts: Dict[int, int] = {k: 0 for k in refs_by_iters}
+    high_degraded = [0]
+    mismatched = [0]
+
+    def _classify(flow, i) -> Optional[int]:
+        for iters, refs in refs_by_iters.items():
+            ref = refs[i % len(frames)]
+            if flow.shape == ref.shape and np.array_equal(flow, ref):
+                return iters
+        return None
+
+    def client(klass: str, n_requests: int, priority: str):
+        c = counters[klass]
+        while True:
+            with lock:
+                i = c["next"]
+                if i >= n_requests:
+                    return
+                c["next"] += 1
+            im1, im2 = frames[i % len(frames)]
+            t_req = time.perf_counter()
+            try:
+                flow = engine.submit(im1, im2,
+                                     priority=priority).result(timeout)
+            except Exception:
+                with lock:
+                    c["dropped"] += 1
+                continue
+            latency = time.perf_counter() - t_req
+            level = _classify(flow, i)
+            with lock:
+                c["lats"].append(latency)
+                if level is None:
+                    mismatched[0] += 1
+                elif klass == "high":
+                    if level != full_iters:
+                        high_degraded[0] += 1
+                else:
+                    quality_counts[level] += 1
+
+    threads = (
+        [threading.Thread(target=client, args=("low", n_low, "low"),
+                          name=f"overload-low-{t}")
+         for t in range(low_concurrency)]
+        + [threading.Thread(target=client, args=("high", n_high, "high"),
+                            name=f"overload-high-{t}")
+           for t in range(high_concurrency)])
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    dt = time.perf_counter() - t0
+
+    def _lat(klass):
+        lats = sorted(counters[klass]["lats"])
+        return {"p50": _percentile(lats, 50) * 1e3,
+                "p99": _percentile(lats, 99) * 1e3,
+                "mean": (sum(lats) / len(lats) * 1e3) if lats else 0.0}
+
+    completed = (len(counters["low"]["lats"])
+                 + len(counters["high"]["lats"]))
+    return {
+        "ok": (high_degraded[0] == 0 and mismatched[0] == 0
+               and completed == n_low + n_high),
+        "completed": completed,
+        "dropped_low": counters["low"]["dropped"],
+        "dropped_high": counters["high"]["dropped"],
+        "high_degraded": high_degraded[0],
+        "mismatched": mismatched[0],
+        "quality_counts": dict(sorted(quality_counts.items(),
+                                      reverse=True)),
+        "seconds": dt,
+        "throughput_rps": ((n_low + n_high) / dt) if dt > 0 else 0.0,
+        "latency_ms_low": _lat("low"),
+        "latency_ms_high": _lat("high"),
+        "metrics": engine.metrics.snapshot(),
+    }

@@ -147,6 +147,14 @@ class ServingMetrics:
         self.cold_stream_requests = 0
         self.encoder_hits = 0
         self.encoder_misses = 0
+        # served-quality accounting (graceful brownout): how many
+        # responses served at each GRU iteration count — the SLO story
+        # in one histogram (full-quality level vs the ladder's degraded
+        # levels) — and the total refine iterations the convergence
+        # early exit skipped (per-sample iters_requested - iters_used,
+        # summed over early-exit-enabled responses).
+        self.quality_hist: Counter = Counter()
+        self.early_exit_iters_saved = 0
         # name -> zero-arg callable; the engine wires live gauges
         # (queue depth, in-flight batches, health code, breaker trips)
         # so snapshot() reads the instantaneous value.
@@ -238,6 +246,19 @@ class ServingMetrics:
                 self.encoder_hits += 1
             else:
                 self.encoder_misses += 1
+
+    def record_quality(self, iters: int, n: int = 1) -> None:
+        """``n`` responses served at ``iters`` GRU iterations (recorded
+        at completion, so a request re-bucketed down the ladder while
+        queued counts at the level that actually served it)."""
+        with self._lock:
+            self.quality_hist[int(iters)] += n
+
+    def record_early_exit_saved(self, iters_saved: int) -> None:
+        """Refine iterations the convergence early exit masked out,
+        summed per-sample over a completed batch."""
+        with self._lock:
+            self.early_exit_iters_saved += int(iters_saved)
 
     def record_batch(self, size: int, padded_to: int,
                      compiles: int = 0) -> None:
@@ -336,7 +357,11 @@ class ServingMetrics:
                     / (self.encoder_hits + self.encoder_misses)
                     if (self.encoder_hits + self.encoder_misses)
                     else 0.0),
+                "serving_early_exit_iters_saved": float(
+                    self.early_exit_iters_saved),
             }
+            for iters, n in self.quality_hist.items():
+                out[f"serving_quality_iters_{iters}"] = float(n)
             gauges = dict(self._gauge_sources)
         for name, fn in gauges.items():
             try:
@@ -355,6 +380,12 @@ class ServingMetrics:
         with self._lock:
             return dict(self.batch_hist)
 
+    def quality_histogram(self) -> Dict[int, int]:
+        """``{iters_level: responses served at it}`` — the brownout
+        SLO readout (full-quality count vs the degraded ladder's)."""
+        with self._lock:
+            return dict(self.quality_hist)
+
     def write_to(self, train_logger, step: Optional[int] = None) -> None:
         """Stream the snapshot through the existing scalar sinks
         (``scalars.jsonl`` + TensorBoard)."""
@@ -364,6 +395,12 @@ class ServingMetrics:
         lat = self.latency_ms()
         hist = ", ".join(f"{k}:{v}" for k, v in
                          sorted(self.batch_histogram().items()))
+        qhist = ", ".join(f"{k}:{v}" for k, v in
+                          sorted(self.quality_histogram().items(),
+                                 reverse=True))
+        quality = (f" | quality hist {{{qhist}}}, early-exit saved "
+                   f"{self.early_exit_iters_saved} iters"
+                   if qhist or self.early_exit_iters_saved else "")
         return (f"requests {self.requests} "
                 f"(hi {self.requests_by_class['high']} / "
                 f"lo {self.requests_by_class['low']}, "
@@ -378,4 +415,4 @@ class ServingMetrics:
                 f"queue peak {self.queue_depth_peak} | swaps "
                 f"{self.swaps}, rollbacks {self.rollbacks}, isolated "
                 f"retries {self.isolated_retries}, breaker fastfails "
-                f"{self.breaker_fastfails}")
+                f"{self.breaker_fastfails}{quality}")

@@ -30,6 +30,12 @@
    affected streams drop state and cold-restart elsewhere with zero
    dropped responses and zero fresh compiles, their stats honestly
    showing the restart's extra encoder MISS.
+6. brownout (``--drill brownout``) — burst LOW traffic past capacity
+   against a quality-ladder engine: the brownout controller steps LOW
+   down the pre-warmed iters ladder (every degraded response
+   bit-matches exactly one level), 0 HIGH responses degraded, 0
+   dropped before ladder exhaustion, recovery to full quality with
+   hysteresis, and 0 fresh XLA compiles across the episode.
 
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
@@ -660,12 +666,120 @@ def drill_streaming(root):
           f"show their extra MISS")
 
 
+def drill_brownout(root):
+    """Burst LOW traffic past capacity against a quality-ladder engine:
+    the brownout controller steps LOW down the pre-warmed iters ladder
+    (every degraded response bit-matches exactly one level), HIGH never
+    degrades, nothing is dropped, the engine recovers to full quality
+    when the burst drains, and the whole episode compiles nothing."""
+    import numpy as np
+
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine, loadgen)
+    from raft_tpu.utils.padder import InputPadder
+
+    from raft_tpu.evaluate import load_predictor
+    full_iters, ladder = 4, (2, 1)
+    predictor = load_predictor("random", small=True, iters=full_iters)
+    shape = (36, 60)
+    frames = loadgen.make_frames([shape], per_shape=3, seed=53)
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=(shape,),
+        iters_ladder=ladder, brownout_high_water=5,
+        brownout_low_water=1, brownout_dwell_ms=150.0))
+    warm = engine.warmup()
+    engine.start(warmup=False)
+    ctl = engine.brownout
+    warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
+                          for k, v in warm.items())
+    print(f"  warmup: {{bucket: compiles}} = {{{warm_desc}}}")
+    assert len(warm) == 1 + len(ladder), \
+        f"warmup covered {len(warm)} executables, want full + ladder"
+
+    def _refs_at(iters):
+        """Per-level references through the SAME warmed executables the
+        engine serves from (bit-exact on any topology); full quality
+        takes the legacy no-iters path, exactly like HIGH traffic."""
+        refs = []
+        for im1, im2 in frames:
+            p = InputPadder(im1.shape, mode="sintel", factor=8)
+            a, b = p.pad(im1, im2)
+            s1 = np.repeat(a[None], 4, 0)
+            s2 = np.repeat(b[None], 4, 0)
+            out = (predictor.dispatch_batch(s1, s2)
+                   if iters == full_iters
+                   else predictor.dispatch_batch(s1, s2, iters=iters))
+            refs.append(p.unpad(np.asarray(out[1])[0]))
+        return refs
+
+    n_low, n_high = 90, 16
+    try:
+        with CompileWatch() as watch:
+            refs_by_iters = {lvl: _refs_at(lvl)
+                             for lvl in (full_iters, *ladder)}
+            # -- burst: 16 LOW clients (2x+ the sustainable closed-loop
+            # load for one bucket) + a 2-client HIGH control lane.
+            res = loadgen.run_overload(
+                engine, frames, n_low=n_low, n_high=n_high,
+                refs_by_iters=refs_by_iters, full_iters=full_iters,
+                low_concurrency=16, high_concurrency=2, timeout=120.0)
+            # -- recovery: the router keeps ticking the controller while
+            # idle; hysteresis steps it back to full quality.
+            deadline = time.monotonic() + 60.0
+            while ctl.level > 0:
+                if time.monotonic() >= deadline:
+                    raise AssertionError(
+                        f"brownout never recovered (level {ctl.level})")
+                time.sleep(0.02)
+            recovered = engine.submit(*frames[0],
+                                      priority="low").result(60)
+    finally:
+        engine.close()
+
+    stats = ctl.stats()
+    degraded_served = sum(n for lvl, n in res["quality_counts"].items()
+                          if lvl != full_iters)
+    print(f"  burst: {res['completed']}/{n_low + n_high} responses "
+          f"({res['throughput_rps']:.1f} req/s), LOW quality counts = "
+          f"{res['quality_counts']}, HIGH p99 = "
+          f"{res['latency_ms_high']['p99']:.0f} ms, LOW p99 = "
+          f"{res['latency_ms_low']['p99']:.0f} ms")
+    print(f"  controller: transitions={stats['transitions']}, "
+          f"time_in_brownout={stats['time_in_brownout_s']:.2f}s, "
+          f"recovered to level {stats['level']}")
+    print("  metrics:", engine.metrics.report())
+    assert res["completed"] == n_low + n_high, \
+        f"completed {res['completed']}/{n_low + n_high}"
+    assert res["dropped_low"] == 0 and res["dropped_high"] == 0, \
+        (f"dropped before ladder exhaustion: low={res['dropped_low']} "
+         f"high={res['dropped_high']}")
+    assert res["high_degraded"] == 0, \
+        f"{res['high_degraded']} HIGH responses were degraded"
+    assert res["mismatched"] == 0, \
+        f"{res['mismatched']} responses matched no quality level"
+    assert degraded_served > 0, \
+        f"ladder never engaged: quality counts {res['quality_counts']}"
+    assert stats["transitions"] >= 2, \
+        f"expected a down + up transition, got {stats['transitions']}"
+    assert stats["time_in_brownout_s"] > 0
+    # Served-quality histogram on the engine agrees with the client's
+    # bit-exact classification (HIGH lane + full-quality LOW at full).
+    hist = engine.metrics.quality_histogram()
+    assert set(hist) <= {full_iters, *ladder}, hist
+    assert np.array_equal(recovered, refs_by_iters[full_iters][0]), \
+        "post-recovery LOW response is not full quality"
+    assert watch.compiles == 0, \
+        f"{watch.compiles} fresh XLA compile(s) during brownout"
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
     drill_reload_under_load,
     drill_fleet,
     drill_streaming,
+    drill_brownout,
 ]
 
 

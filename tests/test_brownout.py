@@ -1,0 +1,495 @@
+"""Graceful-brownout suite: the watermark ladder controller
+(hysteresis, dwell, observability — fake clock, no jax), deadline-
+anchored LOW re-bucketing, the engine's (shape, iters) quality buckets
+(bit-exact vs the direct ``dispatch_batch(iters=...)`` executable, zero
+post-warmup compiles), the never-degrade-HIGH contract, warm-stream
+brownout (a degraded warm pair still hits the encoder cache), the
+convergence early exit (bit-identical parity when disabled; golden-pair
+EPE band when enabled), and the fleet BROWNOUT health rollup with
+``@iters`` rendezvous digests.
+
+All CPU-deterministic and `not slow`-eligible: random-weights RAFT-small
+at iters=4 over one tiny (36, 60) → (40, 64) bucket, so the whole file
+pays each executable's compile exactly once through the predictor's
+shared cache (engines and fleets here all share the module predictor's
+variables). Engine tests that need a non-zero ladder level *force* the
+controller (first ``observe`` is always allowed) under an effectively
+infinite dwell, so the router's own pressure sampling can never step
+the level back mid-assertion."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving.batcher import (PRIORITY_HIGH, PRIORITY_LOW,
+                                      QueuedRequest, ShapeBucketBatcher)
+from raft_tpu.serving.brownout import BrownoutController
+from raft_tpu.serving.metrics import ServingMetrics
+
+SHAPE = (36, 60)              # pads to the (40, 64) bucket
+FULL_ITERS = 4
+LADDER = (2,)
+# Forced-level engine configs: high_water far above anything the tiny
+# test traffic can queue (the controller never trips on its own) and a
+# dwell long enough that after the test's forced first transition the
+# router's ticks cannot move the level again.
+FORCED = dict(iters_ladder=LADDER, brownout_high_water=50,
+              brownout_low_water=0, brownout_dwell_ms=1e9)
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- controller: ladder mechanics, no jax --------------------------------
+
+class TestBrownoutController:
+    def _ctl(self, clock, ladder=(8, 6, 4), high=10, low=2, dwell=1.0):
+        return BrownoutController(ladder, high_water=high, low_water=low,
+                                  dwell_s=dwell, clock=clock)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="at least one"):
+            BrownoutController((), high_water=5)
+        with pytest.raises(ValueError, match=">= 1"):
+            BrownoutController((4, 0), high_water=5)
+        with pytest.raises(ValueError, match="descending"):
+            BrownoutController((4, 4), high_water=5)
+        with pytest.raises(ValueError, match="descending"):
+            BrownoutController((4, 6), high_water=5)
+        with pytest.raises(ValueError, match="high_water"):
+            BrownoutController((4,), high_water=0)
+        with pytest.raises(ValueError, match="hysteresis"):
+            BrownoutController((4,), high_water=5, low_water=5)
+        with pytest.raises(ValueError, match="dwell"):
+            BrownoutController((4,), high_water=5, dwell_s=-1.0)
+
+    def test_one_rung_per_observe_paced_by_dwell(self):
+        clock = _FakeClock(100.0)
+        ctl = self._ctl(clock)
+        # First change is always allowed; after that the dwell gates —
+        # sustained overload descends one rung per dwell, not per call.
+        assert ctl.observe(50) == (0, 1)
+        assert ctl.observe(50) == (1, 1)
+        clock.t += 1.0
+        assert ctl.observe(50) == (1, 2)
+        clock.t += 1.0
+        assert ctl.observe(50) == (2, 3)
+        assert ctl.level == 3 and ctl.exhausted
+        clock.t += 1.0
+        assert ctl.observe(50) == (3, 3)     # ladder exhausted: pinned
+
+    def test_hysteresis_band_holds_level(self):
+        clock = _FakeClock()
+        ctl = self._ctl(clock, high=10, low=2)
+        ctl.observe(10)
+        for _ in range(5):
+            clock.t += 1.0
+            # Pressure strictly inside (low_water, high_water): no step
+            # in either direction, however long it persists.
+            assert ctl.observe(5) == (1, 1)
+        clock.t += 1.0
+        assert ctl.observe(2) == (1, 0)      # at low_water: step up
+
+    def test_recovery_steps_up_one_rung_per_dwell(self):
+        clock = _FakeClock()
+        ctl = self._ctl(clock, ladder=(8, 6), dwell=1.0)
+        ctl.observe(50)
+        clock.t += 1.0
+        ctl.observe(50)
+        assert ctl.level == 2
+        clock.t += 0.5
+        assert ctl.observe(0) == (2, 2)      # dwell not elapsed
+        clock.t += 0.5
+        assert ctl.observe(0) == (2, 1)
+        clock.t += 1.0
+        assert ctl.observe(0) == (1, 0)
+        assert ctl.transitions == 4
+
+    def test_iters_for_tracks_level(self):
+        clock = _FakeClock()
+        ctl = self._ctl(clock, ladder=(8, 6, 4))
+        assert ctl.iters_for(12) == 12
+        ctl.observe(50)
+        assert ctl.iters_for(12) == 8
+        clock.t += 1.0
+        ctl.observe(50)
+        assert ctl.iters_for(12) == 6
+
+    def test_time_in_brownout_accumulates_across_episodes(self):
+        clock = _FakeClock()
+        ctl = self._ctl(clock, ladder=(8,), dwell=1.0)
+        assert ctl.time_in_brownout_s() == 0.0
+        ctl.observe(50)                      # enter at t=0
+        clock.t = 3.0
+        assert ctl.time_in_brownout_s() == pytest.approx(3.0)  # live
+        ctl.observe(0)                       # exit at t=3
+        clock.t = 10.0
+        assert ctl.time_in_brownout_s() == pytest.approx(3.0)  # frozen
+        ctl.observe(50)                      # second episode at t=10
+        clock.t = 12.0
+        assert ctl.time_in_brownout_s() == pytest.approx(5.0)
+
+    def test_stats_payload(self):
+        clock = _FakeClock()
+        ctl = self._ctl(clock, ladder=(8, 6), high=10, low=2)
+        ctl.observe(50)
+        st = ctl.stats()
+        assert st["level"] == 1 and st["ladder"] == [8, 6]
+        assert st["transitions"] == 1 and not st["exhausted"]
+        assert st["high_water"] == 10 and st["low_water"] == 2
+        assert st["time_in_brownout_s"] >= 0.0
+
+
+# -- batcher: deadline-anchored LOW re-bucketing -------------------------
+
+def _req(bucket=(40, 64), t=0.0, priority=PRIORITY_LOW, degradable=True,
+         deadline=None):
+    return QueuedRequest(None, None, None, bucket=bucket, t_submit=t,
+                         deadline=deadline, priority=priority,
+                         degradable=degradable)
+
+
+class TestRebucketLow:
+    def test_moves_only_degradable_low(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=100.0, clock=clock)
+        lo = _req(t=0.0)
+        pinned = _req(t=0.0, degradable=False)   # explicit iters= choice
+        hi = _req(t=0.0, priority=PRIORITY_HIGH, degradable=True)
+        for r in (lo, pinned, hi):
+            b.enqueue(r)
+        moved = b.rebucket_low(
+            lambda r: (40, 64, 2) if r.degradable else None)
+        # HIGH is never degraded even if marked degradable; the
+        # non-degradable LOW (a client's explicit level) never moves.
+        assert moved == 1
+        assert lo.bucket == (40, 64, 2)
+        assert pinned.bucket == (40, 64) and hi.bucket == (40, 64)
+
+    def test_deadline_anchoring_on_move(self):
+        clock = _FakeClock(10.0)
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=1.0, clock=clock)
+        req = _req(t=10.0, deadline=17.5)
+        b.enqueue(req)
+        clock.t = 10.9                       # 0.9s of wait accrued
+        assert b.rebucket_low(lambda r: (40, 64, 2)) == 1
+        # The move preserves both anchors: t_submit (batching max_wait)
+        # and the queue-timeout deadline.
+        assert req.t_submit == 10.0 and req.deadline == 17.5
+        assert b.next_batch(timeout=0) == []
+        clock.t = 11.0                       # 1.0s from ORIGINAL submit
+        batch = b.next_batch(timeout=0)
+        assert [r is req for r in batch] == [True]
+        assert batch[0].bucket == (40, 64, 2)
+
+    def test_identity_and_none_mappings_hold_still(self):
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=100.0)
+        reqs = [_req(t=0.0) for _ in range(3)]
+        for r in reqs:
+            b.enqueue(r)
+        assert b.rebucket_low(lambda r: None) == 0
+        assert b.rebucket_low(lambda r: r.bucket) == 0
+        assert all(r.bucket == (40, 64) for r in reqs)
+
+    def test_fifo_preserved_and_no_double_bounce(self):
+        clock = _FakeClock()
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=100.0, clock=clock)
+        older = _req(bucket=(40, 64), t=0.0)
+        newer = _req(bucket=(40, 64), t=1.0)
+        resident = _req(bucket=(40, 64, 2), t=2.0)
+        for r in (older, newer, resident):
+            b.enqueue(r)
+        seen = []
+        moved = b.rebucket_low(
+            lambda r: seen.append(r) or
+            ((40, 64, 2) if r.bucket == (40, 64) else None))
+        assert moved == 2
+        # Two-pass apply: requests moved into (40, 64, 2) are not
+        # re-presented to the mapper within the same call.
+        assert len(seen) == 3
+        clock.t = 1000.0
+        batch = b.next_batch(timeout=0)
+        assert [r for r in batch] == [resident, older, newer]
+
+    def test_step_back_up_restores_full_bucket(self):
+        b = ShapeBucketBatcher(max_batch=8, max_wait_s=100.0)
+        req = _req(bucket=(40, 64, 2), t=0.0)
+        b.enqueue(req)
+        assert b.rebucket_low(lambda r: (40, 64)) == 1
+        assert req.bucket == (40, 64) and b.pending() == 1
+
+
+# -- metrics: quality accounting -----------------------------------------
+
+class TestQualityMetrics:
+    def test_histogram_and_saved_counters(self):
+        m = ServingMetrics()
+        m.record_quality(4, n=3)
+        m.record_quality(2)
+        m.record_early_exit_saved(5)
+        m.record_early_exit_saved(2)
+        assert m.quality_histogram() == {4: 3, 2: 1}
+        snap = m.snapshot()
+        assert snap["serving_quality_iters_4"] == 3.0
+        assert snap["serving_quality_iters_2"] == 1.0
+        assert snap["serving_early_exit_iters_saved"] == 7.0
+
+
+# -- engine: quality buckets + forced brownout ---------------------------
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=FULL_ITERS)
+
+
+@pytest.fixture(scope="module")
+def frames_and_refs(predictor):
+    """One (36, 60) pair + bit-exact references at every quality level,
+    each through the SAME tail-padded (max_batch=4) executables the
+    engines below dispatch (full quality via ``predict_batch``, ladder
+    levels via ``dispatch_batch(iters=...)``)."""
+    from raft_tpu.serving import loadgen
+    from raft_tpu.utils.padder import InputPadder
+    frames = loadgen.make_frames([SHAPE], per_shape=1, seed=7)
+    refs = {FULL_ITERS: loadgen.batched_reference_flows(
+        predictor, frames, max_batch=4)[0]}
+    im1, im2 = frames[0]
+    padder = InputPadder(im1.shape, mode="sintel", factor=8)
+    p1, p2 = padder.pad(im1, im2)
+    i1 = np.repeat(p1[None], 4, axis=0)
+    i2 = np.repeat(p2[None], 4, axis=0)
+    for lvl in LADDER:
+        out = predictor.dispatch_batch(i1, i2, iters=lvl)
+        refs[lvl] = padder.unpad(np.asarray(out[1])[0])
+    return frames, refs
+
+
+def _engine(predictor, **kw):
+    from raft_tpu.serving import ServingConfig, ServingEngine
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 3.0)
+    kw.setdefault("buckets", (SHAPE,))
+    return ServingEngine(predictor, ServingConfig(**kw))
+
+
+class TestEngineQualityBuckets:
+    def test_explicit_iters_bit_exact_zero_compiles(self, predictor,
+                                                    frames_and_refs):
+        from raft_tpu.serving.metrics import CompileWatch
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, iters_ladder=LADDER)
+        eng.start()
+        try:
+            with CompileWatch() as watch:
+                full = eng.submit(*frames[0]).result(120)
+                deg = eng.submit(*frames[0], iters=2).result(120)
+                # An explicit level is a client *choice*: honored for
+                # LOW exactly as for HIGH.
+                deg_low = eng.submit(*frames[0], priority=PRIORITY_LOW,
+                                     iters=2).result(120)
+            hist = eng.metrics.quality_histogram()
+        finally:
+            eng.close()
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) serving warmed levels"
+        assert np.array_equal(full, refs[FULL_ITERS])
+        assert np.array_equal(deg, refs[2])
+        assert np.array_equal(deg_low, refs[2])
+        assert hist == {FULL_ITERS: 1, 2: 2}
+
+    def test_unwarmed_iters_rejected_naming_levels(self, predictor):
+        eng = _engine(predictor, iters_ladder=LADDER)   # not started:
+        im = np.zeros((*SHAPE, 3), np.float32)          # validated first
+        with pytest.raises(ValueError, match="warmed quality level") as e:
+            eng.submit(im, im, iters=3)
+        assert "2" in str(e.value) and str(FULL_ITERS) in str(e.value)
+        with pytest.raises(ValueError, match="no iters_ladder"):
+            _engine(predictor).submit(im, im, iters=2)
+
+    def test_ladder_validation(self, predictor):
+        with pytest.raises(ValueError):
+            _engine(predictor, iters_ladder=(FULL_ITERS,))  # not < full
+        with pytest.raises(ValueError):
+            _engine(predictor, iters_ladder=(2, 3))         # ascending
+
+    def test_forced_brownout_degrades_low_never_high(self, predictor,
+                                                     frames_and_refs):
+        frames, refs = frames_and_refs
+        eng = _engine(predictor, **FORCED)
+        eng.start()
+        try:
+            assert eng.health_state() == "ready"
+            assert np.array_equal(
+                eng.submit(*frames[0], priority=PRIORITY_LOW).result(120),
+                refs[FULL_ITERS])            # level 0: LOW at full quality
+            assert eng.brownout.observe(100) == (0, 1)
+            assert eng.health_state() == "brownout"
+            assert eng.health()["brownout"]["level"] == 1
+            low = eng.submit(*frames[0],
+                             priority=PRIORITY_LOW).result(120)
+            high = eng.submit(*frames[0]).result(120)
+            hist = eng.metrics.quality_histogram()
+        finally:
+            eng.close()
+        assert np.array_equal(low, refs[2])  # degraded to the rung
+        assert np.array_equal(high, refs[FULL_ITERS])  # HIGH untouched
+        assert hist == {FULL_ITERS: 2, 2: 1}
+
+
+class TestStreamBrownout:
+    def test_browned_out_warm_pair_hits_encoder_cache(self, predictor):
+        from raft_tpu.serving.loadgen import make_stream_frames
+        from raft_tpu.serving.metrics import CompileWatch
+        frames, _ = make_stream_frames(SHAPE, 4, seed=9)
+        eng = _engine(predictor, warm_buckets=(SHAPE,), warm_iters=3,
+                      **FORCED)
+        eng.start()
+        try:
+            with CompileWatch() as watch:
+                sess = eng.open_stream("brownout")
+                assert sess.submit(frames[0]) is None   # prime
+                cold = sess.submit(frames[1]).result(120)
+                warm = sess.submit(frames[2]).result(120)
+                assert eng.brownout.observe(100) == (0, 1)
+                deg = sess.submit(frames[3],
+                                  priority=PRIORITY_LOW).result(120)
+            st = sess.stats()
+            hist = eng.metrics.quality_histogram()
+        finally:
+            eng.close()
+        for flow in (cold, warm, deg):
+            assert flow.shape == (*SHAPE, 2) and np.isfinite(flow).all()
+        # The degraded pair is still a WARM pair on the cached fmap —
+        # brownout lowers its iteration count, not its streaming path.
+        assert st["warm_pairs"] == 2 and st["cold_pairs"] == 1
+        assert st["encoder_misses"] == 1 and st["encoder_hits"] == 3
+        # Cold pairs keep the cold policy (full iters) even browned
+        # out; the degraded warm pair served at min(warm_iters, rung).
+        assert hist == {FULL_ITERS: 1, 3: 1, 2: 1}
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) in browned-out stream"
+
+
+# -- convergence early exit ---------------------------------------------
+
+class TestEarlyExit:
+    def test_disabled_iters_path_bit_identical(self, predictor,
+                                               frames_and_refs):
+        """With ``early_exit`` unset the per-request-iters executable is
+        byte-identical to the legacy trace: same HLO, same answer —
+        bit-equal, not approximately."""
+        frames, refs = frames_and_refs
+        from raft_tpu.utils.padder import InputPadder
+        im1, im2 = frames[0]
+        padder = InputPadder(im1.shape, mode="sintel", factor=8)
+        p1, p2 = padder.pad(im1, im2)
+        i1 = np.repeat(p1[None], 4, axis=0)
+        i2 = np.repeat(p2[None], 4, axis=0)
+        out = predictor.dispatch_batch(i1, i2, iters=FULL_ITERS)
+        assert len(out) == 2                 # no iters-used third output
+        assert np.array_equal(padder.unpad(np.asarray(out[1])[0]),
+                              refs[FULL_ITERS])
+
+    def test_early_exit_validation(self, predictor):
+        from raft_tpu.evaluate import FlowPredictor
+        with pytest.raises(ValueError, match="tol"):
+            FlowPredictor(predictor.model, predictor.variables,
+                          iters=4, early_exit=(0.0, 1))
+        with pytest.raises(ValueError, match="patience"):
+            FlowPredictor(predictor.model, predictor.variables,
+                          iters=4, early_exit=(0.5, 0))
+
+    @pytest.mark.skipif(
+        not os.path.isfile(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "assets", "golden", "manifest.json")),
+        reason="golden assets not generated (scripts/make_golden.py)")
+    def test_early_exit_saves_iters_within_epe_band(self):
+        """On the golden small pair the delta-norm exit fires well
+        before the full 12 iterations and the converged flow stays
+        inside a stated mean-EPE band of the full-quality answer."""
+        from raft_tpu.evaluate import (ASSETS_DIR, _GoldenFixture,
+                                       load_predictor)
+        from raft_tpu.utils.padder import InputPadder
+        img1, img2, _, _ = _GoldenFixture(ASSETS_DIR, variant="small")[0]
+        pred = load_predictor(
+            os.path.join(ASSETS_DIR, "golden", "weights_small.npz"),
+            small=True, iters=12)
+        padder = InputPadder(img1.shape, mode="sintel", factor=8)
+        p1, p2 = padder.pad(img1, img2)
+        s1, s2 = p1[None], p2[None]
+        ref = np.asarray(pred.dispatch_batch(s1, s2, iters=12)[1])[0]
+        pred.early_exit = (0.2, 2)           # (tol, patience)
+        out = pred.dispatch_batch(s1, s2, iters=12)
+        flow = np.asarray(out[1])[0]
+        used = int(np.asarray(out[2])[0])
+        assert 1 <= used < 12                # iterations actually saved
+        drift = float(np.sqrt(((flow - ref) ** 2).sum(-1)).mean())
+        assert np.isfinite(flow).all()
+        # Band measured at 5.6px on the fixture weights; generous
+        # headroom, but far below the fixture's ~40px flow magnitudes.
+        assert drift < 8.0
+
+
+# -- fleet: @iters digests + BROWNOUT rollup -----------------------------
+
+def _fleet(predictor, n=2, **kw):
+    from raft_tpu.serving import ServingConfig
+    from raft_tpu.serving.fleet import make_fleet
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 3.0)
+    kw.setdefault("buckets", (SHAPE,))
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("breaker_cooldown_s", 120.0)
+    return make_fleet(predictor, n, ServingConfig(**kw))
+
+
+class TestFleetBrownout:
+    def test_iters_digest_routing_deterministic(self):
+        from raft_tpu.serving.fleet import BucketRouter
+        ids = ["r0", "r1", "r2"]
+        a, b = BucketRouter(ids), BucketRouter(list(reversed(ids)))
+        for bucket in ((40, 64), (40, 64, 2), (40, 64, 1)):
+            assert sorted(a.owners(bucket)) == ids
+            assert a.owners(bucket) == b.owners(bucket)
+
+    def test_fleet_routes_explicit_iters_bit_exact(self, predictor,
+                                                   frames_and_refs):
+        from raft_tpu.serving.metrics import CompileWatch
+        frames, refs = frames_and_refs
+        with _fleet(predictor, 2, iters_ladder=LADDER) as fleet:
+            # (40, 64, 2) rendezvous-pins independently of (40, 64) but
+            # every replica shares the warmed executable cache: no
+            # fresh compile wherever it lands.
+            with CompileWatch() as watch:
+                flow = fleet.submit(*frames[0], iters=2).result(120)
+            assert np.array_equal(flow, refs[2])
+            assert watch.compiles == 0
+
+    def test_health_rollup_brownout_vs_degraded(self, predictor,
+                                                frames_and_refs):
+        frames, refs = frames_and_refs
+        with _fleet(predictor, 2, **FORCED) as fleet:
+            assert fleet.health()["state"] == "ready"
+            forced = fleet.engines["r0"]
+            assert forced.brownout.observe(100) == (0, 1)
+            h = fleet.health()
+            # READY + BROWNOUT replicas roll up to BROWNOUT (quality is
+            # reduced somewhere, capacity is not) — and a browned-out
+            # fleet still serves.
+            assert h["state"] == "brownout"
+            assert h["routable_replicas"] == 2
+            assert np.array_equal(fleet.submit(*frames[0]).result(120),
+                                  refs[FULL_ITERS])
+            # A fault anywhere outranks brownout in the rollup.
+            fleet.engines["r1"].set_degraded("test")
+            assert fleet.health()["state"] == "degraded"
+            fleet.engines["r1"].clear_degraded("test")
+            assert fleet.health()["state"] == "brownout"
