@@ -362,7 +362,7 @@ def _wait_for_backend(watchdog: _Watchdog) -> bool:
     return dev.platform == "cpu" and cpu_explicit
 
 
-def main(gru: str = "ab"):
+def main(gru: str = "ab", motion: str = "ab"):
     watchdog = _Watchdog()
     cpu_smoke = _wait_for_backend(watchdog)
     if cpu_smoke:
@@ -429,9 +429,10 @@ def main(gru: str = "ab"):
         "value_all_pairs": round(pairs_per_sec, 3),
         "headline_engine": "all_pairs",
         "init_attempt_count": len(_INIT_ATTEMPTS),
-        # GRU-cell dispatch the headline ran under (RAFT_GRU_PALLAS,
-        # trace-time; 'auto' = fused Pallas kernel on TPU when eligible)
+        # Fused-kernel dispatches the headline ran under (trace-time;
+        # 'auto' = fused Pallas kernel on TPU when eligible)
         "gru": os.environ.get("RAFT_GRU_PALLAS") or "auto",
+        "motion": os.environ.get("RAFT_MOTION_PALLAS") or "auto",
         "resolution": f"{H}x{W}",
         "iters": ITERS,
         "reps": REPS,
@@ -503,33 +504,44 @@ def main(gru: str = "ab"):
         payload["batch1_error"] = f"{type(e).__name__}: {e}"
     _HEADLINE = dict(payload)
 
-    if gru == "ab":
-        # GRU A/B arm (round 6, knee-provenance discipline like the
+    def kernel_ab_arm(key: str, flag: str):
+        # Fused-kernel A/B arm (knee-provenance discipline like the
         # banded-vs-all-pairs arms): re-trace the headline engine with
-        # the fused Pallas GRU cell forced ON ('1') and OFF ('0') and
-        # record both readings. Trace-time env flag, so each arm builds
-        # a fresh jit; the surrounding env is restored afterwards so the
-        # remaining sections run the headline's own dispatch. On CPU the
-        # forced-pallas arm runs the kernel under the Pallas interpreter
-        # — a parity tool, not a fast path — so a pallas<xla reading on
-        # a cpu-labelled artifact is expected and honest.
-        gru_prev = os.environ.get("RAFT_GRU_PALLAS")
-        for gmode, env_val in (("pallas", "1"), ("xla", "0")):
-            os.environ["RAFT_GRU_PALLAS"] = env_val
-            try:
-                def fwdg(i1, i2, m=headline_model):
-                    flow_up = m.apply(variables, i1, i2,
-                                      test_mode=True)[1]
-                    return flow_up, jnp.sum(flow_up)
+        # the named Pallas kernel forced ON ('1') and OFF ('0') and
+        # record both readings as value_{key}_{pallas,xla}. Trace-time
+        # env flag, so each arm builds a fresh jit; forced_flag restores
+        # the surrounding env afterwards so the remaining sections run
+        # the headline's own dispatch. On CPU the forced-pallas arm runs
+        # the kernel under the Pallas interpreter — a parity tool, not a
+        # fast path — so a pallas<xla reading on a cpu-labelled artifact
+        # is expected and honest (kernel_ab_note says so in-band).
+        from raft_tpu.utils.envflags import forced_flag
+        for kmode, env_val in (("pallas", "1"), ("xla", "0")):
+            with forced_flag(flag, env_val):
+                try:
+                    def fwdk(i1, i2, m=headline_model):
+                        flow_up = m.apply(variables, i1, i2,
+                                          test_mode=True)[1]
+                        return flow_up, jnp.sum(flow_up)
 
-                payload[f"value_gru_{gmode}"] = round(
-                    throughput(payload["batch"], jax.jit(fwdg)), 3)
-            except Exception as e:   # the sibling arm must survive
-                payload[f"gru_{gmode}_error"] = f"{type(e).__name__}: {e}"
-        if gru_prev is None:
-            os.environ.pop("RAFT_GRU_PALLAS", None)
-        else:
-            os.environ["RAFT_GRU_PALLAS"] = gru_prev
+                    payload[f"value_{key}_{kmode}"] = round(
+                        throughput(payload["batch"], jax.jit(fwdk)), 3)
+                except Exception as e:   # the sibling arm must survive
+                    payload[f"{key}_{kmode}_error"] = (
+                        f"{type(e).__name__}: {e}")
+        if platform == "cpu":
+            payload["kernel_ab_note"] = (
+                "cpu capture: forced-pallas arms run under the Pallas "
+                "interpreter — interpret-mode parity evidence, not a "
+                "fast path; speed deltas are TPU measurements")
+
+    if gru == "ab":
+        kernel_ab_arm("gru", "RAFT_GRU_PALLAS")
+        _HEADLINE = dict(payload)
+
+    if motion == "ab":
+        # Round-7 motion-encoder arm, same contract as the GRU arm.
+        kernel_ab_arm("motion", "RAFT_MOTION_PALLAS")
         _HEADLINE = dict(payload)
 
     if platform == "cpu":
@@ -989,12 +1001,23 @@ if __name__ == "__main__":
                              "and adds a forced pallas-vs-xla A/B pass; "
                              "'pallas'/'xla' force one dispatch for the "
                              "whole run (recorded in the payload)")
+        ap.add_argument("--motion", choices=("ab", "pallas", "xla"),
+                        default="ab",
+                        help="motion-encoder arm (RAFT_MOTION_PALLAS), "
+                             "same semantics as --gru: 'ab' (default) "
+                             "adds a forced pallas-vs-xla A/B pass; "
+                             "'pallas'/'xla' force one dispatch for the "
+                             "whole run")
         args = ap.parse_args()
         if args.gru == "pallas":
             os.environ["RAFT_GRU_PALLAS"] = "1"
         elif args.gru == "xla":
             os.environ["RAFT_GRU_PALLAS"] = "0"
-        main(gru=args.gru)
+        if args.motion == "pallas":
+            os.environ["RAFT_MOTION_PALLAS"] = "1"
+        elif args.motion == "xla":
+            os.environ["RAFT_MOTION_PALLAS"] = "0"
+        main(gru=args.gru, motion=args.motion)
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — artifact must parse

@@ -56,15 +56,17 @@ class FlowPredictor:
         numerics agree to float accumulation order (golden-parity
         tested).
 
-    The SepConvGRU dispatch inside the scan body is a trace-time env
-    flag, not a constructor knob: ``RAFT_GRU_PALLAS`` (auto = fused
-    Pallas cell on TPU when eligible; see ``ops/gru_pallas.py``) is read
-    when each per-shape executable is traced, and the resolved mode is
-    recorded on the predictor as ``gru_impl`` at construction — both for
-    observability and so a misspelled value fails at predictor build
-    time, before the serving engine warms buckets against it. Flipping
-    the env var after warmup would retrace (a compile the serving
-    zero-compile contract forbids); set it before construction.
+    The scan body's fused-kernel dispatches are trace-time env flags,
+    not constructor knobs: ``RAFT_GRU_PALLAS`` (auto = fused Pallas
+    SepConvGRU cell on TPU when eligible; see ``ops/gru_pallas.py``) and
+    ``RAFT_MOTION_PALLAS`` (same contract for the fused BasicMotion-
+    Encoder chain; ``ops/motion_pallas.py``) are read when each
+    per-shape executable is traced, and the resolved modes are recorded
+    on the predictor as ``gru_impl``/``motion_impl`` at construction —
+    both for observability and so a misspelled value fails at predictor
+    build time, before the serving engine warms buckets against it.
+    Flipping an env var after warmup would retrace (a compile the
+    serving zero-compile contract forbids); set it before construction.
     """
 
     def __init__(self, model, variables, iters: int = 32,
@@ -127,12 +129,14 @@ class FlowPredictor:
                     f"early_exit patience must be >= 1, got {patience}")
             early_exit = (float(tol), int(patience))
         self.early_exit = early_exit
-        # Resolved RAFT_GRU_PALLAS mode ('auto'/'0'/'1') — validated here
-        # so bad values fail at build time, recorded for observability
-        # (bench/serving annotate payloads with it). The actual dispatch
-        # happens at trace time inside SepConvGRU.__call__.
-        from raft_tpu.ops.gru_pallas import resolve_mode
-        self.gru_impl = resolve_mode()
+        # Resolved RAFT_GRU_PALLAS / RAFT_MOTION_PALLAS modes
+        # ('auto'/'0'/'1') — validated here so bad values fail at build
+        # time, recorded for observability (bench/serving annotate
+        # payloads with them). The actual dispatches happen at trace
+        # time inside SepConvGRU/BasicUpdateBlock.__call__.
+        from raft_tpu.ops import gru_pallas, motion_pallas
+        self.gru_impl = gru_pallas.resolve_mode()
+        self.motion_impl = motion_pallas.resolve_mode()
         # Optional sequence(spatial)-parallel execution: with a mesh the
         # forward runs through parallel.spatial.spatial_jit — image rows
         # sharded over the mesh's spatial axis, each device holding 1/d

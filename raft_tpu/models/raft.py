@@ -11,11 +11,14 @@ delta, which is a training-dynamics property, not an optimization.
 TPU mapping: fnet/cnet and the all-pairs correlation pyramid are the
 scan-invariant prologue (MXU matmuls), the scan body is the ConvGRU update;
 everything is static-shaped, so XLA compiles one fused program. Inside the
-scan body the two per-iteration hot paths have Pallas kernels behind
+scan body the per-iteration hot paths have Pallas kernels behind
 trace-time env flags: the correlation lookup (``RAFT_CORR_BACKEND``,
 ``ops/corr_pallas.py``) and — for the non-small model — the SepConvGRU
 cell (``RAFT_GRU_PALLAS``, ``ops/gru_pallas.py``), which fuses both GRU
-steps into one launch so gate activations never round-trip HBM. Both
+steps into one launch so gate activations never round-trip HBM, and the
+BasicMotionEncoder chain (``RAFT_MOTION_PALLAS``,
+``ops/motion_pallas.py``), which fuses its five convs the same way and
+hands the GRU its x input un-concatenated. The
 flags are read when the scan body is traced, so a jitted executable bakes
 one dispatch for all iterations (the serving warmup contract depends on
 this — see ``serving/engine.py``); the hidden-state carry crosses the

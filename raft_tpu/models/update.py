@@ -15,7 +15,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.ops import gru_pallas
+from raft_tpu.ops import gru_pallas, motion_pallas
 
 # Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
 # (reference core/update.py:121, core/raft.py:74-85).
@@ -168,10 +168,17 @@ class SepConvGRU(nn.Module):
         # the module's compute dtype (the carry's dtype in practice);
         # params are read in place, so the torch-weight mapping and
         # training gradients are unaffected.
+        #
+        # ``x`` may also be a tuple of parts — the fused motion encoder
+        # hands over (inp, [motion‖flow]) — which the kernel consumes
+        # un-concatenated via per-part weight slices; the conv path
+        # concatenates here (the same op the caller used to run).
         if not self.is_initializing() and gru_pallas.should_fuse(
                 h, x, self.hidden_dim):
             return gru_pallas.sepconv_gru(
                 h, x, self._packed_weights(), dtype=self.dtype)
+        if isinstance(x, (tuple, list)):
+            x = jnp.concatenate(x, axis=-1)
         h = self._step(h, x, self.convz1, self.convr1, self.convq1)
         return self._step(h, x, self.convz2, self.convr2, self.convq2)
 
@@ -254,6 +261,15 @@ class BasicUpdateBlock(nn.Module):
         self.mask_conv2 = nn.Conv(UPSAMPLE_MASK_CHANNELS, (1, 1),
                                   dtype=self.dtype)
 
+    def _packed_motion_weights(self):
+        def pair(name):
+            p = self.encoder.variables["params"][name]
+            return (p["kernel"], p["bias"])
+
+        return motion_pallas.pack_weights(
+            pair("convc1"), pair("convc2"), pair("convf1"),
+            pair("convf2"), pair("conv"))
+
     def __call__(self, net, inp, corr, flow, compute_mask=True):
         """``compute_mask``: Python ``True`` computes the mask head
         statically (training, and the final test_mode iteration);
@@ -261,9 +277,26 @@ class BasicUpdateBlock(nn.Module):
         zero mask-head ops, no cond; the round-5 two-call scan
         structure); a traced scalar bool still runs it under ``nn.cond``
         (legacy path, kept for np.bool_ flags)."""
-        motion_features = self.encoder(flow, corr)
-        inp = jnp.concatenate([inp, motion_features], axis=-1)
-        net = self.gru(net, inp)
+        # Fused motion-encoder dispatch (RAFT_MOTION_PALLAS, trace-time):
+        # the encoder's five convs in one Pallas launch emitting
+        # [out‖flow] directly, handed to the GRU as an x *part* so
+        # concat([inp, motion_features]) is never materialized (the GRU
+        # kernel consumes the parts via per-part weight slices; its conv
+        # path concatenates internally). auto = TPU only when the shape
+        # is VMEM-admissible (the fallback is logged); '1' forces
+        # (interpret mode off-TPU, the CPU parity tests); '0' restores
+        # the conv path below bit-for-bit. SmallUpdateBlock's encoder
+        # has a different conv chain and always keeps the conv path.
+        if not self.is_initializing() and motion_pallas.should_fuse(
+                flow, corr):
+            motion_features = motion_pallas.motion_encoder(
+                flow, corr, self._packed_motion_weights(),
+                dtype=self.dtype)
+            gru_x = (inp, motion_features)
+        else:
+            motion_features = self.encoder(flow, corr)
+            gru_x = jnp.concatenate([inp, motion_features], axis=-1)
+        net = self.gru(net, gru_x)
 
         # 0.25 balances gradients into the mask head (core/update.py:133).
         def _mask(mdl, n):

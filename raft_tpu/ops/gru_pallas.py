@@ -28,6 +28,14 @@ Design
   ``models/update.py``) and each tap feeds one ``(rows, Cin) @ (Cin, 2C)``
   matmul. The ``h``/``x`` halves of the concatenated GRU input get
   separate weight slices, so the ``concat([h, x])`` is never materialized.
+  Since round 7 the x half generalizes to a *tuple of parts*
+  (``split_x_weights``): when the fused motion encoder
+  (``motion_pallas.py``) feeds this kernel, x arrives as
+  ``(inp, [motion‖flow])`` with per-part weight row slices — conceptually
+  the ``[inp | motion | flow]`` split — so ``concat([inp,
+  motion_features])`` is never materialized between the two kernels
+  either. A single-part x reproduces the round-6 kernel exactly (same
+  operands, same accumulation order).
 * **Fused VPU epilogue.** sigmoid/tanh/blend for both GRU steps run on
   the block while it is VMEM-resident; only the final hidden state is
   stored, in the consumer's dtype and axis order
@@ -152,6 +160,50 @@ def pack_weights(horiz, vert, hidden_dim: int):
     return step(horiz, 0) + step(vert, 1)
 
 
+def _x_parts(m):
+    """Normalize an x-weight entry (array or tuple of per-part slices)."""
+    return tuple(m) if isinstance(m, (tuple, list)) else (m,)
+
+
+def split_x_weights(mats, cxs):
+    """Re-slice the packed x-input weights for a multi-part x.
+
+    ``mats`` is the ``pack_weights`` 12-tuple whose x entries have
+    tap-major rows over the *full* ``Cx = sum(cxs)`` input; ``cxs`` are
+    the channel widths of the x parts the caller will pass as a tuple
+    (e.g. ``(128, 128)`` for ``(inp, [motion‖flow])``). Each x-weight
+    matrix is split into per-part matrices with the same tap-major row
+    layout — tap ``k`` of part ``p`` owns rows ``[k*cxs[p],
+    (k+1)*cxs[p])`` — so per-tap matmuls against the un-concatenated
+    parts sum to exactly the full-input matmul. Pure differentiable
+    slicing; a single-part split returns ``mats`` unchanged.
+    """
+    if len(cxs) == 1:
+        return mats
+    (wzr1h, wzr1x, wq1h, wq1x, bzr1, bq1,
+     wzr2h, wzr2x, wq2h, wq2x, bzr2, bq2) = mats
+    cx = sum(cxs)
+    offs = []
+    o = 0
+    for cp in cxs:
+        offs.append(o)
+        o += cp
+
+    def split(m):
+        if m.shape[0] != _TAPS * cx:
+            raise ValueError(
+                f"split_x_weights: weight has {m.shape[0]} rows, "
+                f"expected {_TAPS}*{cx} for x parts {cxs}")
+        return tuple(
+            jnp.concatenate(
+                [m[k * cx + off:k * cx + off + cp] for k in range(_TAPS)],
+                axis=0)
+            for off, cp in zip(offs, cxs))
+
+    return (wzr1h, split(wzr1x), wq1h, split(wq1x), bzr1, bq1,
+            wzr2h, split(wzr2x), wq2h, split(wq2x), bzr2, bq2)
+
+
 # ---------------------------------------------------------------------------
 # Kernel
 # ---------------------------------------------------------------------------
@@ -168,18 +220,30 @@ def _shift_rows(v, s: int):
     return jnp.concatenate([pad, v[:s]], axis=0)
 
 
-def _gru_kernel(hp_ref, hc_ref, hn_ref, xp_ref, xc_ref, xn_ref,
-                wzr1h_ref, wzr1x_ref, wq1h_ref, wq1x_ref, bzr1_ref,
-                bq1_ref, wzr2h_ref, wzr2x_ref, wq2h_ref, wq2x_ref,
-                bzr2_ref, bq2_ref, out_ref, *,
-                w: int, h_img: int, th: int):
+def _gru_kernel(*refs, w: int, h_img: int, th: int, nparts: int):
     """One fused SepConvGRU step for a TH-row tile (+4 halo rows/side).
 
-    ``*p/*c/*n`` are the SAME flattened ``(Hpad*W, C[in])`` arrays under
-    prev/cur/next block index maps (clamped at the grid edges); all six
+    ``refs`` is ``(hp, hc, hn, <3 refs per x part>, <weights>, out)``;
+    the prev/cur/next triples are the SAME flattened ``(Hpad*W, C[in])``
+    arrays under clamped block index maps (see ``_pallas_gru``); all six
     gate convs, both blends, and the intermediate hidden state live
     entirely in VMEM.
     """
+    out_ref = refs[-1]
+    hp_ref, hc_ref, hn_ref = refs[:3]
+    xrefs = refs[3:3 + 3 * nparts]
+    wr = refs[3 + 3 * nparts:-1]
+    p = nparts
+    # Weight layout (matches _flatten_mats): per step — wzr h, wzr x
+    # parts, wq h, wq x parts, bzr, bq.
+    wzr1h_ref, wzr1x_refs = wr[0], wr[1:1 + p]
+    wq1h_ref, wq1x_refs = wr[1 + p], wr[2 + p:2 + 2 * p]
+    bzr1_ref, bq1_ref = wr[2 + 2 * p], wr[3 + 2 * p]
+    o = 4 + 2 * p
+    wzr2h_ref, wzr2x_refs = wr[o], wr[o + 1:o + 1 + p]
+    wq2h_ref, wq2x_refs = wr[o + 1 + p], wr[o + 2 + p:o + 2 + 2 * p]
+    bzr2_ref, bq2_ref = wr[o + 2 + 2 * p], wr[o + 3 + 2 * p]
+
     c = out_ref.shape[-1]
     g = th * w                     # rows per tile (flattened)
     hw = _HALO * w                 # halo rows (flattened)
@@ -193,8 +257,10 @@ def _gru_kernel(hp_ref, hc_ref, hn_ref, xp_ref, xc_ref, xn_ref,
     # are garbage — the global-row masks below zero their contributions.
     ha = jnp.concatenate(
         [hp_ref[0][g - hw:], hc_ref[0], hn_ref[0][:hw]], axis=0)
-    xa = jnp.concatenate(
-        [xp_ref[0][g - hw:], xc_ref[0], xn_ref[0][:hw]], axis=0)
+    xas = tuple(
+        jnp.concatenate([xrefs[3 * i][0][g - hw:], xrefs[3 * i + 1][0],
+                         xrefs[3 * i + 2][0][:hw]], axis=0)
+        for i in range(p))
 
     # Flattened-index geometry: column (for horizontal tap validity) and
     # global image row (for vertical tap validity / padded-row exclusion).
@@ -210,12 +276,12 @@ def _gru_kernel(hp_ref, hc_ref, hn_ref, xp_ref, xc_ref, xn_ref,
         gr = grow + d
         return ((gr >= 0) & (gr < h_img)).astype(cdt)
 
-    def sepconv(vh, vx, wh_ref, wx_ref, b_ref, shift_mul, mask):
+    def sepconv(vh, vxs, wh_ref, wx_refs, b_ref, shift_mul, mask):
         """One merged separable conv: Σ_taps shifted-masked matmuls of the
-        h-part and x-part operands; f32 accumulation, compute-dtype
-        bias add (the flax Conv contract)."""
+        h-part and each x-part operand (h first, then parts in order —
+        the single-part accumulation order is the round-6 kernel's); f32
+        accumulation, compute-dtype bias add (the flax Conv contract)."""
         ch = vh.shape[1]
-        chx = vx.shape[1]
         nout = b_ref.shape[1]
         acc = jnp.zeros((rows, nout), jnp.float32)
         for k in range(_TAPS):
@@ -226,28 +292,30 @@ def _gru_kernel(hp_ref, hc_ref, hn_ref, xp_ref, xc_ref, xn_ref,
                 wh_ref[k * ch:(k + 1) * ch, :],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            acc += jax.lax.dot_general(
-                _shift_rows(vx, d * shift_mul) * mk,
-                wx_ref[k * chx:(k + 1) * chx, :],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            for vx, wx_ref in zip(vxs, wx_refs):
+                chx = vx.shape[1]
+                acc += jax.lax.dot_general(
+                    _shift_rows(vx, d * shift_mul) * mk,
+                    wx_ref[k * chx:(k + 1) * chx, :],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
         return acc.astype(cdt) + b_ref[...]
 
     # Horizontal step over the full assembly (the halo rows' h1 feed the
     # vertical step's taps; (TH+8)/TH recompute — see module docstring).
-    zr1 = jax.nn.sigmoid(sepconv(ha, xa, wzr1h_ref, wzr1x_ref,
+    zr1 = jax.nn.sigmoid(sepconv(ha, xas, wzr1h_ref, wzr1x_refs,
                                  bzr1_ref, 1, hmask))
     z1, r1 = zr1[:, :c], zr1[:, c:]
-    q1 = jnp.tanh(sepconv(r1 * ha, xa, wq1h_ref, wq1x_ref,
+    q1 = jnp.tanh(sepconv(r1 * ha, xas, wq1h_ref, wq1x_refs,
                           bq1_ref, 1, hmask))
     h1 = (1 - z1) * ha + z1 * q1
 
     # Vertical step; only the cur rows of the outputs are consumed, and
     # every tap they draw on lies inside the assembly span.
-    zr2 = jax.nn.sigmoid(sepconv(h1, xa, wzr2h_ref, wzr2x_ref,
+    zr2 = jax.nn.sigmoid(sepconv(h1, xas, wzr2h_ref, wzr2x_refs,
                                  bzr2_ref, w, vmask))
     z2, r2 = zr2[:, :c], zr2[:, c:]
-    q2 = jnp.tanh(sepconv(r2 * h1, xa, wq2h_ref, wq2x_ref,
+    q2 = jnp.tanh(sepconv(r2 * h1, xas, wq2h_ref, wq2x_refs,
                           bq2_ref, w, vmask))
     h2 = (1 - z2) * h1 + z2 * q2
 
@@ -260,16 +328,29 @@ def _full_spec(arr):
     return pl.BlockSpec(shape, lambda bi, ti: tuple(0 for _ in shape))
 
 
-def _pallas_gru(static, h2d, x2d, mats):
-    """h2d: (B, Hpad*W, C); x2d: (B, Hpad*W, Cx); mats: pack_weights
-    output, already in the compute dtype. Returns (B, Hpad*W, C) cdt."""
+def _flatten_mats(mats):
+    """Flatten the (possibly part-nested) 12-entry mats structure into
+    the kernel's flat operand order; plain arrays act as 1-tuples."""
+    flat = []
+    for m in mats:
+        flat.extend(m if isinstance(m, (tuple, list)) else (m,))
+    return flat
+
+
+def _pallas_gru(static, h2d, xs2d, mats):
+    """h2d: (B, Hpad*W, C); xs2d: tuple of (B, Hpad*W, cx_p) x parts;
+    mats: pack_weights output (x entries arrays for one part, per-part
+    tuples from split_x_weights otherwise), already in the compute
+    dtype. Returns (B, Hpad*W, C) cdt."""
     w, h_img, th, interpret = static
     b, n, c = h2d.shape
     g = th * w
     grid = (b, n // g)
     last = grid[1] - 1
+    nparts = len(xs2d)
 
-    kernel = functools.partial(_gru_kernel, w=w, h_img=h_img, th=th)
+    kernel = functools.partial(_gru_kernel, w=w, h_img=h_img, th=th,
+                               nparts=nparts)
 
     def spec_of(channels, idx_fn):
         return pl.BlockSpec((1, g, channels), idx_fn)
@@ -278,10 +359,14 @@ def _pallas_gru(static, h2d, x2d, mats):
     cur = lambda bi, ti: (bi, ti, 0)
     nxt = lambda bi, ti: (bi, jnp.minimum(ti + 1, last), 0)
 
-    cx = x2d.shape[-1]
-    in_specs = ([spec_of(c, prev), spec_of(c, cur), spec_of(c, nxt),
-                 spec_of(cx, prev), spec_of(cx, cur), spec_of(cx, nxt)]
-                + [_full_spec(m) for m in mats])
+    flat_mats = _flatten_mats(mats)
+    in_specs = [spec_of(c, prev), spec_of(c, cur), spec_of(c, nxt)]
+    operands = [h2d, h2d, h2d]
+    for x2d in xs2d:
+        cx = x2d.shape[-1]
+        in_specs += [spec_of(cx, prev), spec_of(cx, cur), spec_of(cx, nxt)]
+        operands += [x2d, x2d, x2d]
+    in_specs += [_full_spec(m) for m in flat_mats]
     out_specs, out_shape = klayout.query_tiled_out(b, n, c, g, h2d.dtype)
     return pl.pallas_call(
         kernel,
@@ -290,7 +375,7 @@ def _pallas_gru(static, h2d, x2d, mats):
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(h2d, h2d, h2d, x2d, x2d, x2d, *mats)
+    )(*operands, *flat_mats)
 
 
 # ---------------------------------------------------------------------------
@@ -310,10 +395,14 @@ def reference_gru(static, h2d, x2d, mats):
     """Pure-jnp twin of the kernel: the same tap decomposition, masks and
     cast points on the full flattened array (no tiling/halo). Serves as
     the custom-VJP backward (recompute-from-residuals) and as the
-    kernel-parity oracle in tests."""
-    w, h_img, _, _ = static
+    kernel-parity oracle in tests. ``x2d`` may be one array or a tuple
+    of parts (with mats' x entries split to match)."""
+    w, h_img = static[0], static[1]
     (wzr1h, wzr1x, wq1h, wq1x, bzr1, bq1,
      wzr2h, wzr2x, wq2h, wq2x, bzr2, bq2) = mats
+    xs = x2d if isinstance(x2d, (tuple, list)) else (x2d,)
+    wzr1x, wq1x, wzr2x, wq2x = (_x_parts(m)
+                                for m in (wzr1x, wq1x, wzr2x, wq2x))
     b, n, c = h2d.shape
     cdt = h2d.dtype
 
@@ -329,9 +418,8 @@ def reference_gru(static, h2d, x2d, mats):
         gr = row + d
         return ((gr >= 0) & (gr < h_img)).astype(cdt)
 
-    def sepconv(vh, vx, wh, wx, bias, shift_mul, mask):
+    def sepconv(vh, vxs, wh, wxs, bias, shift_mul, mask):
         ch = vh.shape[-1]
-        chx = vx.shape[-1]
         acc = jnp.zeros((b, n, bias.shape[1]), jnp.float32)
         for k in range(_TAPS):
             d = k - 2
@@ -341,21 +429,23 @@ def reference_gru(static, h2d, x2d, mats):
                 wh[k * ch:(k + 1) * ch, :],
                 (((2,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            acc += jax.lax.dot_general(
-                _bshift(vx, d * shift_mul) * mk,
-                wx[k * chx:(k + 1) * chx, :],
-                (((2,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            for vx, wx in zip(vxs, wxs):
+                chx = vx.shape[-1]
+                acc += jax.lax.dot_general(
+                    _bshift(vx, d * shift_mul) * mk,
+                    wx[k * chx:(k + 1) * chx, :],
+                    (((2,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
         return acc.astype(cdt) + bias
 
-    zr1 = jax.nn.sigmoid(sepconv(h2d, x2d, wzr1h, wzr1x, bzr1, 1, hmask))
+    zr1 = jax.nn.sigmoid(sepconv(h2d, xs, wzr1h, wzr1x, bzr1, 1, hmask))
     z1, r1 = zr1[..., :c], zr1[..., c:]
-    q1 = jnp.tanh(sepconv(r1 * h2d, x2d, wq1h, wq1x, bq1, 1, hmask))
+    q1 = jnp.tanh(sepconv(r1 * h2d, xs, wq1h, wq1x, bq1, 1, hmask))
     h1 = (1 - z1) * h2d + z1 * q1
 
-    zr2 = jax.nn.sigmoid(sepconv(h1, x2d, wzr2h, wzr2x, bzr2, w, vmask))
+    zr2 = jax.nn.sigmoid(sepconv(h1, xs, wzr2h, wzr2x, bzr2, w, vmask))
     z2, r2 = zr2[..., :c], zr2[..., c:]
-    q2 = jnp.tanh(sepconv(r2 * h1, x2d, wq2h, wq2x, bq2, w, vmask))
+    q2 = jnp.tanh(sepconv(r2 * h1, xs, wq2h, wq2x, bq2, w, vmask))
     return (1 - z2) * h1 + z2 * q2
 
 
@@ -450,7 +540,10 @@ def should_fuse(h, x, hidden_dim: int, mode: str | None = None) -> bool:
     kernel (interpret off-TPU), raising if the shape is inadmissible;
     'auto' → kernel only on a real TPU backend when eligible (CPU runs
     keep the flax path — interpret mode is a parity tool, not a fast
-    path — mirroring the RAFT_CORR_BACKEND=auto contract)."""
+    path — mirroring the RAFT_CORR_BACKEND=auto contract). When auto
+    rejects an otherwise-wanted TPU launch on the VMEM/alignment
+    envelope, the fallback is LOGGED (``vmem.log_fallback``), never
+    silent. ``x`` may be one array or a tuple of parts."""
     if mode is None:
         mode = resolve_mode()
     if mode == "0":
@@ -461,18 +554,27 @@ def should_fuse(h, x, hidden_dim: int, mode: str | None = None) -> bool:
                 f"RAFT_GRU_PALLAS=1 but the hidden state has shape "
                 f"{h.shape} (expected NHWC with {hidden_dim} channels)")
         return False
+    xs = x if isinstance(x, (tuple, list)) else (x,)
+    cx = sum(xx.shape[-1] for xx in xs)
     on_tpu = jax.default_backend() == "tpu"
     interpret = not on_tpu
     _, hh, ww, c = h.shape
-    ok = gru_eligible(hh, ww, c, x.shape[-1], h.dtype, interpret)
+    ok = gru_eligible(hh, ww, c, cx, h.dtype, interpret)
     if mode == "1":
         if not ok:
             raise ValueError(
                 f"RAFT_GRU_PALLAS=1 but shape (H={hh}, W={ww}, C={c}, "
-                f"Cx={x.shape[-1]}, dtype={h.dtype}) doesn't fit the "
+                f"Cx={cx}, dtype={h.dtype}) doesn't fit the "
                 f"kernel's VMEM/alignment envelope; use auto to fall "
                 f"back to the flax path")
         return True
+    if on_tpu and not ok:
+        vmem.log_fallback(
+            "RAFT_GRU_PALLAS",
+            f"(H={hh}, W={ww}, C={c}, Cx={cx}, "
+            f"dtype={jnp.dtype(h.dtype).name})",
+            gru_vmem_parts(hh, ww, c, cx, 4,
+                           jnp.dtype(h.dtype).itemsize))
     return on_tpu and ok
 
 
@@ -483,9 +585,14 @@ def sepconv_gru(h, x, mats, *, dtype=None, interpret: bool | None = None,
     Args:
       h: ``(B, H, W, C)`` hidden state (the scan carry — returned in the
         same layout and dtype, layout-contract invariant 4).
-      x: ``(B, H, W, Cx)`` conditioning features.
+      x: ``(B, H, W, Cx)`` conditioning features, or a tuple of parts
+        summing to Cx — e.g. ``(inp, [motion‖flow])`` from the fused
+        motion encoder. Parts are consumed un-concatenated, against
+        per-part weight slices (``split_x_weights``); a single array is
+        exactly the round-6 path.
       mats: ``pack_weights`` output (float32 flax params; cast to the
-        compute dtype here).
+        compute dtype here). Pass the un-split 12-tuple either way —
+        the per-part re-slicing happens here (loop-invariant, hoisted).
       dtype: compute dtype (the flax module's ``dtype``); default
         ``h.dtype``.
       interpret: force Pallas interpret mode (defaults to True off-TPU,
@@ -497,9 +604,12 @@ def sepconv_gru(h, x, mats, *, dtype=None, interpret: bool | None = None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, hh, ww, c = h.shape
-    cx = x.shape[-1]
+    xs = tuple(x) if isinstance(x, (tuple, list)) else (x,)
+    cxs = tuple(xx.shape[-1] for xx in xs)
+    cx = sum(cxs)
     cdt = jnp.dtype(dtype) if dtype is not None else h.dtype
     out_dt = h.dtype
+    mats = split_x_weights(mats, cxs)
 
     if th is None:
         if interpret:
@@ -518,13 +628,18 @@ def sepconv_gru(h, x, mats, *, dtype=None, interpret: bool | None = None,
     hpad = _round_up(hh, th)
     n = hpad * ww
     h2d = h.astype(cdt).reshape(b, hh * ww, c)
-    x2d = x.astype(cdt).reshape(b, hh * ww, cx)
+    xs2d = tuple(xx.astype(cdt).reshape(b, hh * ww, xx.shape[-1])
+                 for xx in xs)
     if hpad != hh:
         grow_n = (hpad - hh) * ww
         h2d = jnp.pad(h2d, ((0, 0), (0, grow_n), (0, 0)))
-        x2d = jnp.pad(x2d, ((0, 0), (0, grow_n), (0, 0)))
-    mats = tuple(m.astype(cdt) for m in mats)
+        xs2d = tuple(jnp.pad(x2d, ((0, 0), (0, grow_n), (0, 0)))
+                     for x2d in xs2d)
+    mats = tuple(
+        tuple(p.astype(cdt) for p in m) if isinstance(m, (tuple, list))
+        else m.astype(cdt)
+        for m in mats)
 
     static = (ww, hh, th, bool(interpret))
-    out = _gru(static, h2d, x2d, mats)
+    out = _gru(static, h2d, xs2d, mats)
     return out[:, :hh * ww].reshape(b, hh, ww, c).astype(out_dt)

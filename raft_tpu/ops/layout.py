@@ -41,9 +41,13 @@ The contract (invariants for kernel authors)
    the forward value; custom-VJP backward outputs are emitted float32 and
    cast to the primal dtype by the wrapper (the corr kernel's contract).
 
-``corr_pallas.py`` (RAFT_CORR_TOUT) and ``gru_pallas.py`` both build on
-these helpers; the VMEM-budget side of kernel admission lives in
-``raft_tpu.ops.vmem``.
+``corr_pallas.py`` (RAFT_CORR_TOUT), ``gru_pallas.py`` and
+``motion_pallas.py`` all build on these helpers; the VMEM-budget side of
+kernel admission lives in ``raft_tpu.ops.vmem``.  The motion kernel is
+the reason invariant 4 now matters *between* kernels too: it emits
+``[out‖flow]`` in the layout and dtype the fused GRU consumes as an
+x part, so no concat/relayout sits between the two custom calls inside
+the scan body.
 """
 
 from __future__ import annotations

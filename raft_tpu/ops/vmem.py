@@ -16,6 +16,12 @@ This module gives kernels two shared pieces:
   kernel's named buffer estimate, raise ``ValueError`` with the itemized
   breakdown and the requested-vs-16 MB numbers *before* ``pallas_call``
   hands the config to Mosaic, instead of after a multi-minute compile.
+* ``log_fallback(flag, shape, parts)`` — the ``auto`` counterpart: when
+  a kernel's dispatch *wants* the fused path on TPU but the admission
+  table rejects the shape (e.g. f32 at Sintel eval shapes), emit one
+  structured warning naming the flag, the shape, and the estimate-vs-
+  budget numbers — a silent fall-back to the slow path is a perf bug
+  that hides for months.
 
 Estimates are static (shape arithmetic only) and intentionally
 conservative — over-admitting reproduces the raw Mosaic OOM this module
@@ -26,7 +32,10 @@ preflight when ``interpret=True``.
 
 from __future__ import annotations
 
+import logging
 from typing import Mapping
+
+_LOG = logging.getLogger(__name__)
 
 #: Hard per-core scoped-VMEM limit Mosaic allocates against.
 LIMIT_BYTES = 16 * 2 ** 20
@@ -69,3 +78,18 @@ def preflight(parts: Mapping[str, int], where: str) -> None:
         f"Shrink the tile or shard the input instead of letting Mosaic "
         f"hit a raw scoped-VMEM OOM (BASELINE.md 'Query tile 512')."
     )
+
+
+def log_fallback(flag: str, shape: str, parts: Mapping[str, int]) -> None:
+    """One loud structured line when ``<flag>=auto`` rejects a TPU launch
+    and falls back to the XLA path — the estimate that failed admission,
+    at the kernel's smallest tile, against the budget and hard limit.
+    Called at trace time (once per compiled shape, not per step)."""
+    mb = 2 ** 20
+    _LOG.warning(
+        "%s=auto: falling back to the XLA path for shape %s — smallest-"
+        "tile VMEM estimate %.2f MB exceeds the %.0f MB admission budget "
+        "(hard per-core limit %.0f MB). Set %s=0 to silence, or use a "
+        "narrower dtype/shape to admit the fused kernel.",
+        flag, shape, total_bytes(parts) / mb, BUDGET_BYTES / mb,
+        LIMIT_BYTES / mb, flag)

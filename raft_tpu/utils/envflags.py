@@ -11,6 +11,11 @@ every flag fails loudly and identically:
 * ``env_enum``  — closed string sets (``RAFT_GRU_PALLAS`` in {'auto','0','1'}).
 * ``env_int_choice`` — closed integer sets with an optional sentinel for
   "unset/auto" (``RAFT_CORR_TILE`` in {0, 128, 256}).
+* ``forced_flag`` — scoped override/restore for A/B harnesses
+  (``bench.py --gru/--motion ab``, ``scripts/profile_probe.py``) that
+  force a trace-time flag for one arm and must put the environment back
+  exactly — including deleting a variable that was unset — however the
+  arm exits.
 
 All helpers raise ``ValueError`` naming the variable, the offending value and
 the accepted set, and all treat the empty string like an unset variable (shells
@@ -19,6 +24,7 @@ routinely export empties when composing env incantations).
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Sequence
 
@@ -97,3 +103,26 @@ def env_int_choice(
             f"{name} must be one of {tuple(choices)}, got {val}{suffix}"
         )
     return val
+
+
+@contextlib.contextmanager
+def forced_flag(name: str, value: str | None):
+    """Set (or, with ``value=None``, unset) an environment flag for the
+    duration of a ``with`` block and restore the previous state exactly
+    on exit — the save/override/restore dance every A/B harness used to
+    hand-roll around trace-time flags.  Restoration distinguishes
+    "was unset" from "was empty/some value", so nesting and exceptions
+    cannot leak one arm's forced value into the next.
+    """
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev

@@ -18,6 +18,11 @@ actionable without TensorBoard:
 * :func:`peak_tflops` — the MFU denominator: ``RAFT_PEAK_TFLOPS`` env
   override, else the TPU-v5e bf16 figure (197) on TPU backends, else
   unknown (CPU peak varies too much across hosts to guess).
+* :func:`group_rows` / :func:`op_group_summary` — collapse the per-op
+  rows into named op-pattern groups (e.g. every ``convc*``/``convf*``
+  op of the motion encoder vs its fused Pallas custom-call) with summed
+  time, FLOPs, achieved TFLOP/s and MFU per group — the "per-op MFU
+  columns, but for a subsystem" view the kernel A/B probes print.
 * :class:`HostStageTimer` — accumulated *host-side* wall time per named
   pipeline stage (pad / stack / dispatch / sync), for code whose cost
   the device tracer can't see. The serving engine threads one through
@@ -225,6 +230,68 @@ def _collect_ops(logdir: str):
     rows = sorted(((k, ps / 1e9, cnt[k]) for k, ps in tot.items()),
                   key=lambda x: -x[1])
     return rows, lines_used, {k: v for k, v in flops.items() if v}
+
+
+def group_rows(rows, flops, groups, steps: int = 1):
+    """Collapse per-op ``rows`` (``op_breakdown`` shape) into named
+    groups by substring match.
+
+    ``groups`` maps a group name to a tuple of op-name substrings; an op
+    belongs to the FIRST group (in dict order) with a matching pattern,
+    so put the most specific patterns first. Pure function of the row
+    data — unit-testable without a trace. Returns
+    ``{group: {time_ms, ops, count, flops, tflops_per_s, mfu_pct}}``
+    (``tflops_per_s``/``mfu_pct`` are ``None`` without flops stats /
+    a known peak), plus an ``"(other)"`` group for unmatched time so the
+    groups always sum to the whole program.
+    """
+    peak = peak_tflops() if flops else None
+    out = {name: {"time_ms": 0.0, "ops": 0, "count": 0, "flops": 0}
+           for name in groups}
+    out["(other)"] = {"time_ms": 0.0, "ops": 0, "count": 0, "flops": 0}
+
+    def bucket(op_name):
+        for gname, pats in groups.items():
+            if any(p in op_name for p in pats):
+                return gname
+        return "(other)"
+
+    for name, ms, c in rows:
+        g = out[bucket(name)]
+        g["time_ms"] += ms / max(steps, 1)
+        g["ops"] += 1
+        g["count"] += c
+        g["flops"] += flops.get(name, 0) // max(steps, 1)
+    for g in out.values():
+        if g["flops"] and g["time_ms"]:
+            tf = g["flops"] / (g["time_ms"] * 1e-3) / 1e12
+            g["tflops_per_s"] = tf
+            g["mfu_pct"] = 100.0 * tf / peak if peak else None
+        else:
+            g["tflops_per_s"] = None
+            g["mfu_pct"] = None
+    return out
+
+
+def op_group_summary(logdir: str, groups, steps: int = 1) -> dict:
+    """Parse the latest trace in ``logdir`` and print + return the
+    :func:`group_rows` table for ``groups`` — one line per group with
+    summed time/step, op & event counts, and (when the trace has flops
+    stats) achieved TFLOP/s and MFU."""
+    rows, _, flops = _collect_ops(logdir)
+    summary = group_rows(rows, flops, groups, steps=steps)
+    for name, g in sorted(summary.items(),
+                          key=lambda kv: -kv[1]["time_ms"]):
+        if not g["count"]:
+            continue
+        line = (f"{g['time_ms']:9.3f} ms/step  {g['ops']:4d} ops "
+                f"x{g['count']:6d}")
+        if g["tflops_per_s"] is not None:
+            line += f"  {g['tflops_per_s']:7.2f} TF/s"
+            if g["mfu_pct"] is not None:
+                line += f" {g['mfu_pct']:5.1f}% MFU"
+        print(f"{line}  {name}")
+    return summary
 
 
 def print_breakdown(logdir: str, steps: int = 1, top: int = 20) -> None:

@@ -8,6 +8,10 @@ Sections:
   breakdown of one dispatch.
 * ``gru``      — the round-6 fused SepConvGRU kernel A/B: the non-small
   headline forward with ``RAFT_GRU_PALLAS`` forced on then off.
+* ``motion``   — the round-7 fused motion-encoder kernel A/B
+  (``RAFT_MOTION_PALLAS`` forced on then off), with an op-group MFU
+  summary splitting the scan body into motion-encoder / GRU / custom-
+  call slices so the two kernels' shares are separable per arm.
 
 Every breakdown now carries per-op achieved TFLOP/s + MFU when the
 trace has ``flops`` stats (see ``raft_tpu/utils/profiling.py``), and a
@@ -27,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.utils import profiling
+from raft_tpu.utils.envflags import forced_flag
 
 
 def _program_flops(fn, *args):
@@ -44,7 +49,7 @@ def _program_flops(fn, *args):
         return None
 
 
-def _run(fn, *args):
+def _run(fn, *args, groups=None):
     for _ in range(2):
         jnp.sum(fn(*args)).block_until_ready()
     flops = _program_flops(fn, *args)
@@ -62,6 +67,9 @@ def _run(fn, *args):
             line += f" = {100.0 * tf / peak:.1f}% MFU of {peak:g} peak"
         print(line)
     profiling.print_breakdown(t.logdir, steps=1, top=14)
+    if groups:
+        print("-- op groups --")
+        profiling.op_group_summary(t.logdir, groups, steps=1)
 
 
 def msda():
@@ -125,19 +133,51 @@ def gru():
     variables = model.init({"params": rng, "dropout": rng}, img1, img1,
                            iters=1)
     img = jnp.broadcast_to(img1, (batch, H, W, 3))
-    prev = os.environ.get("RAFT_GRU_PALLAS")
-    try:
-        for label, flag in (("pallas", "1"), ("xla", "0")):
-            os.environ["RAFT_GRU_PALLAS"] = flag
+    for label, flag in (("pallas", "1"), ("xla", "0")):
+        with forced_flag("RAFT_GRU_PALLAS", flag):
             fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
                                                    test_mode=True)[1])
             print(f"=== gru {batch}x{H}x{W} iters=12 gru={label}")
             _run(fwd, img, img)
-    finally:
-        if prev is None:
-            os.environ.pop("RAFT_GRU_PALLAS", None)
-        else:
-            os.environ["RAFT_GRU_PALLAS"] = prev
+
+
+# Op-name substring patterns splitting the scan body into the two fused-
+# kernel subsystems (first match wins — custom calls before conv names,
+# since a Pallas op's HLO name carries the kernel function's name).
+_MOTION_GROUPS = {
+    "motion_pallas": ("_motion_kernel", "motion_pallas"),
+    "gru_pallas": ("_gru_kernel", "gru_pallas"),
+    "motion_convs": ("convc1", "convc2", "convf1", "convf2",
+                     "encoder/conv", "BasicMotionEncoder"),
+    "gru_convs": ("convz", "convr", "convq"),
+}
+
+
+def motion():
+    """Round-7 tentpole A/B: per-op breakdown + motion/GRU op-group MFU
+    summary of the non-small headline forward with the fused motion-
+    encoder kernel forced on, then off. Both arms force the fused GRU on
+    (its round-6 win is established), so the delta isolates the motion
+    chain. Flags are read at trace time — each arm builds a fresh jit."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 440, 1024
+    batch = int(os.environ.get("RAFT_PROBE_BATCH", "24"))
+    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+    img = jnp.broadcast_to(img1, (batch, H, W, 3))
+    for label, flag in (("pallas", "1"), ("xla", "0")):
+        with forced_flag("RAFT_MOTION_PALLAS", flag), \
+                forced_flag("RAFT_GRU_PALLAS", "1"):
+            fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
+                                                   test_mode=True)[1])
+            print(f"=== motion {batch}x{H}x{W} iters=12 motion={label}")
+            _run(fwd, img, img, groups=_MOTION_GROUPS)
 
 
 def sparse_b8():
@@ -174,4 +214,4 @@ if __name__ == "__main__":
     print("devices:", jax.devices(), flush=True)
     for n in names:
         {"msda": msda, "headline": headline, "gru": gru,
-         "sparse_b8": sparse_b8}[n]()
+         "motion": motion, "sparse_b8": sparse_b8}[n]()

@@ -36,6 +36,12 @@
    bit-matches exactly one level), 0 HIGH responses degraded, 0
    dropped before ladder exhaustion, recovery to full quality with
    hysteresis, and 0 fresh XLA compiles across the episode.
+7. pallas-kernels (``--drill pallas-kernels``) — the fused-kernel warm
+   path: a NON-small engine with ``RAFT_MOTION_PALLAS=1`` +
+   ``RAFT_GRU_PALLAS=1`` (both trace-time flags baked into the bucket
+   executables) warms up, serves a concurrent load bit-exactly, and
+   triggers ZERO post-warmup XLA compiles — proving the round-6/7
+   kernels ride the serving zero-compile contract.
 
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
@@ -773,6 +779,57 @@ def drill_brownout(root):
         f"{watch.compiles} fresh XLA compile(s) during brownout"
 
 
+def drill_pallas_kernels(root):
+    """RAFT_MOTION_PALLAS=1 + RAFT_GRU_PALLAS=1 engines warm up and
+    serve bit-exactly with zero post-warmup compiles (the round-7
+    acceptance probe). Non-small model — the small model's encoder/GRU
+    have no fused path — one bucket, small load: the subject is the
+    trace-time flags riding the warmup contract, not throughput."""
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine, loadgen)
+    from raft_tpu.utils.envflags import forced_flag
+
+    n_requests, concurrency = 12, 4
+    with forced_flag("RAFT_MOTION_PALLAS", "1"), \
+            forced_flag("RAFT_GRU_PALLAS", "1"):
+        predictor = load_predictor("random", iters=2)
+        assert predictor.motion_impl == "1", predictor.motion_impl
+        assert predictor.gru_impl == "1", predictor.gru_impl
+        frames = loadgen.make_frames([(36, 60), (33, 57)], per_shape=2,
+                                     seed=23)
+        refs, ref_kind = _references(predictor, frames, max_batch=2)
+
+        engine = ServingEngine(predictor, ServingConfig(
+            max_batch=2, max_wait_ms=3.0, buckets=((36, 60),)))
+        warm = engine.warmup()
+        engine.start(warmup=False)
+        try:
+            with CompileWatch() as watch:
+                res = loadgen.run_load(engine, frames,
+                                       n_requests=n_requests,
+                                       concurrency=concurrency,
+                                       references=refs)
+        finally:
+            engine.close()
+
+    print(f"  {res['completed']}/{n_requests} responses with both fused "
+          f"kernels forced; reference = {ref_kind}")
+    warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
+                          for k, v in warm.items())
+    print(f"  warmup: {{bucket: compiles}} = {{{warm_desc}}}")
+    assert res["completed"] == n_requests, \
+        f"completed {res['completed']}/{n_requests}"
+    assert not res["dropped"], f"dropped requests: {res['dropped']}"
+    assert not res["mismatched"], \
+        f"incorrect responses: {res['mismatched']}"
+    assert all(v["compiles"] >= 1 for v in warm.values()), warm
+    assert not watch.compiles, \
+        f"{watch.compiles} fresh XLA compile(s) after warmup — the " \
+        f"fused-kernel flags failed to bake into the bucket executables"
+    assert engine.metrics.compiles == 0, engine.metrics.compiles
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -780,6 +837,7 @@ DRILLS = [
     drill_fleet,
     drill_streaming,
     drill_brownout,
+    drill_pallas_kernels,
 ]
 
 

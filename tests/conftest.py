@@ -25,6 +25,18 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "pallas_interpret: CPU interpret-mode Pallas kernel parity "
+        "suites (corr, gru, msda, motion) — selectable as one group, "
+        "e.g. -m 'not pallas_interpret' for a conv-path-only run")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running drills excluded from the tier-1 command "
+        "(-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

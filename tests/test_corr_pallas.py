@@ -21,6 +21,10 @@ from raft_tpu.models.corr import (AlternateCorrBlock, CorrBlock,
                                   build_feature_pyramid, windowed_correlation)
 from raft_tpu.ops.corr_pallas import windowed_correlation_pallas
 
+# Interpret-mode kernel parity suite — one selectable group across the
+# corr/gru/msda/motion kernels (registered in conftest.py).
+pytestmark = pytest.mark.pallas_interpret
+
 
 def _rand(rng, *shape):
     return jnp.asarray(rng.standard_normal(shape), jnp.float32)

@@ -157,6 +157,24 @@ def test_golden_gru_pallas(monkeypatch):
     assert results["golden_parity_epe"] < 2e-3, results
 
 
+def test_golden_motion_pallas(monkeypatch):
+    """Round-7 fused BasicMotionEncoder kernel end-to-end (the
+    tentpole), stacked on the GRU kernel: with both flags forced, every
+    refinement iteration runs the five-conv motion chain in one Pallas
+    launch (interpret mode on CPU) and hands the GRU its x input as
+    un-concatenated parts — and must still reproduce the canonical-torch
+    goldens through the whole predictor chain."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    monkeypatch.setenv("RAFT_MOTION_PALLAS", "1")
+    monkeypatch.setenv("RAFT_GRU_PALLAS", "1")
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"), iters=12)
+    assert predictor.motion_impl == "1"
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 2e-3, results
+
+
 def test_spatial_shards_rejects_other_families():
     from raft_tpu.evaluate import load_predictor
 

@@ -23,6 +23,10 @@ import jax.numpy as jnp
 from raft_tpu.ops import gru_pallas, vmem
 from raft_tpu.utils import envflags
 
+# Interpret-mode kernel parity suite — one selectable group across the
+# corr/gru/msda/motion kernels (registered in conftest.py).
+pytestmark = pytest.mark.pallas_interpret
+
 B, H, W, C, CX = 2, 11, 7, 16, 24
 
 
@@ -229,6 +233,69 @@ class TestPackWeights:
         b = jnp.zeros((C,))
         with pytest.raises(ValueError, match="separable kernel"):
             gru_pallas.pack_weights(((k, b),) * 3, ((k, b),) * 3, C)
+
+
+class TestXParts:
+    """Round-7 multi-part x: the fused motion encoder hands the GRU its
+    x input as an un-concatenated tuple; ``split_x_weights`` re-slices
+    the packed weights so per-part matmuls sum to the full-input matmul.
+    Splitting the matmul reorders the f32 reduction, so multi-part is
+    tolerance-parity vs the whole-x kernel (≤1e-5 here), while a
+    single-part x is exactly the round-6 path."""
+
+    def test_single_part_returns_mats_unchanged(self, gru_setup):
+        *_, mats = gru_setup
+        assert gru_pallas.split_x_weights(mats, (CX,)) is mats
+
+    def test_split_rejects_mismatched_widths(self, gru_setup):
+        *_, mats = gru_setup
+        with pytest.raises(ValueError, match="split_x_weights"):
+            gru_pallas.split_x_weights(mats, (10, 10))
+
+    def test_two_part_matches_whole_and_flax(self, gru_setup):
+        model, vs, h, x, mats = gru_setup
+        want = model.apply(vs, h, x)
+        whole = gru_pallas.sepconv_gru(h, x, mats, interpret=True)
+        parts = gru_pallas.sepconv_gru(
+            h, (x[..., :10], x[..., 10:]), mats, interpret=True)
+        assert parts.shape == whole.shape
+        np.testing.assert_allclose(np.asarray(parts), np.asarray(whole),
+                                   atol=1e-5, rtol=0)
+        np.testing.assert_allclose(np.asarray(parts), np.asarray(want),
+                                   atol=1e-5, rtol=0)
+
+    def test_flax_conv_path_accepts_tuple_x_bitexact(self, gru_setup,
+                                                     monkeypatch):
+        """The conv fallback concatenates tuple parts itself — same op
+        as a pre-concatenated x, so bit-for-bit identical."""
+        model, vs, h, x, _ = gru_setup
+        monkeypatch.setenv("RAFT_GRU_PALLAS", "0")
+        a = model.apply(vs, h, x)
+        b = model.apply(vs, h, (x[..., :10], x[..., 10:]))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_grads_flow_through_parts(self, gru_setup):
+        """d(sum(out))/d(xa, xb) through the tuple path equals the
+        whole-x gradient sliced at the same boundary."""
+        _, _, h, x, mats = gru_setup
+
+        def loss_whole(xx):
+            return jnp.sum(gru_pallas.sepconv_gru(h, xx, mats,
+                                                  interpret=True))
+
+        def loss_parts(xa, xb):
+            return jnp.sum(gru_pallas.sepconv_gru(h, (xa, xb), mats,
+                                                  interpret=True))
+
+        g_whole = jax.grad(loss_whole)(x)
+        ga, gb = jax.grad(loss_parts, argnums=(0, 1))(
+            x[..., :10], x[..., 10:])
+        np.testing.assert_allclose(np.asarray(ga),
+                                   np.asarray(g_whole[..., :10]),
+                                   atol=1e-5, rtol=0)
+        np.testing.assert_allclose(np.asarray(gb),
+                                   np.asarray(g_whole[..., 10:]),
+                                   atol=1e-5, rtol=0)
 
 
 class TestEnvFlags:

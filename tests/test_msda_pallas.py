@@ -18,6 +18,10 @@ import pytest
 from raft_tpu.ops.msda import ms_deform_attn
 from raft_tpu.ops.msda_pallas import ms_deform_attn_pallas, pallas_eligible
 
+# Interpret-mode kernel parity suite — one selectable group across the
+# corr/gru/msda/motion kernels (registered in conftest.py).
+pytestmark = pytest.mark.pallas_interpret
+
 SHAPES = [(6, 9), (3, 5)]          # two levels
 B, M, D, P = 2, 4, 8, 3            # D*H sublane-aligned for both levels
 S = sum(h * w for h, w in SHAPES)
