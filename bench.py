@@ -504,6 +504,44 @@ def main(gru: str = "ab", motion: str = "ab"):
         payload["batch1_error"] = f"{type(e).__name__}: {e}"
     _HEADLINE = dict(payload)
 
+    def early_exit_arm():
+        # Iterate-to-convergence arm: re-trace the headline engine with
+        # the masked convergence exit threaded into the refine scan and
+        # measure the SAME operating point. iters_saved is the measured
+        # per-sample (ITERS - iters_used) — what the tolerance says the
+        # fixed-count loop overspends — while value_early_exit shows
+        # what the masking itself costs in throughput (the masked scan
+        # still runs full length with converged samples frozen, so this
+        # arm measures the accounting the serving quality ladder feeds
+        # on, not a wall-clock shortcut).
+        tol = float(os.environ.get("RAFT_BENCH_EE_TOL", "0.1"))
+        patience = int(os.environ.get("RAFT_BENCH_EE_PATIENCE", "2"))
+
+        def fwde(i1, i2, m=headline_model):
+            _, flow_up, used = m.apply(variables, i1, i2,
+                                       test_mode=True,
+                                       early_exit=(tol, patience))
+            return flow_up, jnp.sum(flow_up), used
+
+        jfwde = jax.jit(fwde)
+        payload["value_early_exit"] = round(
+            throughput(payload["batch"], jfwde), 3)
+        img = jnp.broadcast_to(img1, (payload["batch"], H, W, 3))
+        used = jax.device_get(jfwde(img, img)[2])
+        payload["early_exit"] = {"tol": tol, "patience": patience}
+        payload["iters_saved"] = {
+            "mean": round(float(ITERS - used.mean()), 3),
+            "min": int(ITERS - used.max()),
+            "max": int(ITERS - used.min()),
+            "iters": ITERS,
+        }
+
+    try:
+        early_exit_arm()
+    except Exception as e:   # secondary arm must never sink the artifact
+        payload["early_exit_error"] = f"{type(e).__name__}: {e}"
+    _HEADLINE = dict(payload)
+
     def kernel_ab_arm(key: str, flag: str):
         # Fused-kernel A/B arm (knee-provenance discipline like the
         # banded-vs-all-pairs arms): re-trace the headline engine with
@@ -819,6 +857,177 @@ def _serving_failure(msg: str) -> None:
            "error": msg})
 
 
+HIGHRES_METRIC = "highres_sharded_vs_unsharded_batch1_latency_speedup"
+
+
+def highres_main(shards: int = 0):
+    """``python bench.py serving --highres [--shards N]`` — multi-chip
+    high-resolution serving benchmark (spatial sharding).
+
+    The one workload single-chip batching can't help is a lone high-res
+    request: it is latency-bound and unbatchable, and all-pairs
+    correlation makes its cost quadratic in resolution. This mode
+    measures what the spatially-sharded serving path buys for exactly
+    that request: batch-1 latency of the sharded executable (rows split
+    over the mesh's spatial axis, shard_map'd banded lookup) against
+    the unsharded batch-1 executable at the SAME padded shape, plus a
+    mixed-traffic section proving the sharded bucket serves from its
+    own dispatch stream with zero post-warmup compiles while small-
+    batch traffic flows beside it.
+
+    On TPU the mesh spans the chips and the speedup is the headline;
+    on the CPU smoke host the "devices" are forced host-platform
+    threads sharing the same cores, so sharding adds collective
+    overhead instead of compute — the artifact says so in
+    ``criterion_note`` and carries ``smoke_operating_point`` rather
+    than faking a win. What the smoke host DOES prove: bit-level
+    response integrity, zero post-warmup compiles, and stream overlap.
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+    from raft_tpu.serving.metrics import CompileWatch
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    n_dev = len(devices)
+    if shards <= 0:
+        shards = n_dev if platform == "tpu" else min(4, n_dev)
+    if shards < 2 or n_dev < shards:
+        _highres_failure(
+            f"need >= 2 devices to shard (have {n_dev}, want {shards}); "
+            "on CPU run with XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8")
+        return
+    if platform == "tpu":
+        highres, small_shapes = (436, 1024), [(184, 320)]
+        small, iters, max_batch = False, ITERS, 8
+        n_requests, concurrency = 64, 8
+    else:
+        highres, small_shapes = (96, 128), [(36, 60), (33, 57)]
+        small, iters, max_batch = True, 2, 4
+        n_requests, concurrency = 24, 6
+
+    predictor = load_predictor("random", small=small, iters=iters)
+    cfg = ServingConfig(
+        max_batch=max_batch, max_wait_ms=3.0,
+        buckets=tuple(small_shapes), sharded_buckets=(highres,),
+        sharded_shards=shards,
+        sharded_area_threshold=highres[0] * highres[1],
+        persistent_cache=True)
+    engine = ServingEngine(predictor, cfg)
+    mesh = engine._sharded_mesh
+
+    t0 = time.perf_counter()
+    warm = engine.warmup()
+    warmup = {"seconds": round(time.perf_counter() - t0, 3),
+              "compiles": int(sum(v["compiles"] for v in warm.values())),
+              "buckets": sorted(str(k) for k in warm)}
+
+    # -- batch-1 latency: sharded vs unsharded at the same padded shape.
+    # Direct dispatch (no queue) isolates the executable, which is what
+    # the mesh changes; the queueing cost is identical for both.
+    rng = np.random.default_rng(0)
+    ph, pw = highres
+    a = rng.uniform(0, 255, (1, ph, pw, 3)).astype(np.float32)
+    b = rng.uniform(0, 255, (1, ph, pw, 3)).astype(np.float32)
+
+    def _lat(fn, reps: int = REPS) -> dict:
+        for _ in range(WARMUP):
+            np.asarray(fn()[1])
+        ts = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            np.asarray(fn()[1])
+            ts.append((time.perf_counter() - t) * 1000.0)
+        ts.sort()
+        return {"p50_ms": round(ts[len(ts) // 2], 2),
+                "min_ms": round(ts[0], 2),
+                "max_ms": round(ts[-1], 2)}
+
+    sharded_lat = _lat(
+        lambda: predictor.sharded_dispatch(a, b, mesh=mesh))
+    unsharded_lat = _lat(lambda: predictor.dispatch_batch(a, b))
+    speedup = (unsharded_lat["p50_ms"] / sharded_lat["p50_ms"]
+               if sharded_lat["p50_ms"] else None)
+
+    # -- mixed traffic: highres + small-batch through ONE engine, zero
+    # post-warmup compiles, per-bucket streams overlapping. References
+    # per path: the batched executable for small frames, the sharded
+    # executable for highres frames — each response must bit-match the
+    # executable that contractually serves its bucket.
+    small_frames = loadgen.make_frames(small_shapes, per_shape=2, seed=1)
+    hi_frames = loadgen.make_frames([highres], per_shape=2, seed=2)
+    frames = small_frames + hi_frames
+    refs = loadgen.batched_reference_flows(
+        frames=small_frames, predictor=predictor, max_batch=max_batch)
+    for im1, im2 in hi_frames:
+        out = predictor.sharded_dispatch(im1[None], im2[None], mesh=mesh)
+        refs.append(np.asarray(out[1][0]))
+    engine.start(warmup=False)
+    try:
+        with CompileWatch() as cw:
+            res = loadgen.run_load(engine, frames, n_requests=n_requests,
+                                   concurrency=concurrency,
+                                   references=refs)
+    finally:
+        engine.close()
+
+    payload = {
+        "metric": HIGHRES_METRIC,
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x",
+        "platform": platform,
+        "devices": n_dev,
+        "mesh": f"1x{shards}",
+        "model": "raft-small" if small else "raft-large",
+        "iters": iters,
+        "highres_shape": list(highres),
+        "small_shapes": [list(s) for s in small_shapes],
+        "sharded_batch1_latency": sharded_lat,
+        "unsharded_batch1_latency": unsharded_lat,
+        "warmup": warmup,
+        "mixed_traffic": {
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "completed": res["completed"],
+            "dropped": len(res["dropped"]),
+            "responses_bit_exact": res["ok"],
+            "post_warmup_compiles": cw.compiles,
+            "sharded_requests": int(
+                engine.metrics.snapshot().get(
+                    "serving_sharded_requests", 0)),
+            "batch_histogram": {str(k): v for k, v in
+                                sorted(res["batch_histogram"].items())},
+            "throughput_rps": round(res["throughput_rps"], 3),
+        },
+    }
+    if platform != "tpu":
+        payload["smoke_operating_point"] = True
+        payload["criterion_note"] = (
+            "forced host-platform devices are threads on shared CPU "
+            "cores: row-sharding adds halo/collective overhead without "
+            "adding compute, so sharded latency >= unsharded here by "
+            "construction. The CPU artifact proves correctness (bit-"
+            "exact responses), zero post-warmup compiles, and stream "
+            "overlap; the latency win is a multi-chip phenomenon")
+        payload["tpu_expectation_note"] = (
+            "on a TPU pod slice the mesh spans real chips: each holds "
+            "1/d of every activation and of the (HW)^2 correlation "
+            "volume, so batch-1 high-res latency scales down with the "
+            "mesh — the round-5 8-way spatial-parallel capture is the "
+            "trajectory reference; on-TPU serving capture is tracked "
+            "as ROADMAP debt")
+    _emit(payload)
+
+
+def _highres_failure(msg: str) -> None:
+    _emit({"metric": HIGHRES_METRIC, "value": None, "unit": "x",
+           "error": msg})
+
+
 STREAMING_METRIC = "streaming_warm_vs_stateless_pairs_per_sec_speedup"
 
 
@@ -980,6 +1189,33 @@ if __name__ == "__main__":
             _streaming_failure(f"{type(e).__name__}: {e}")
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        if "--highres" in sys.argv[2:]:
+            # Multi-chip path: on hosts without accelerators the mesh
+            # comes from forced host-platform devices. Must be in the
+            # environment before jax initializes its backend (first
+            # jax.devices() call inside highres_main) — a no-op for the
+            # CPU platform's count when already set, and irrelevant on
+            # TPU where the real chips are the mesh.
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            try:
+                ap = argparse.ArgumentParser(
+                    prog="bench.py serving --highres")
+                ap.add_argument("--highres", action="store_true")
+                ap.add_argument("--shards", type=int, default=0,
+                                help="spatial mesh width (default: all "
+                                     "devices on TPU, 4 on the CPU "
+                                     "smoke host)")
+                highres_main(
+                    shards=ap.parse_args(sys.argv[2:]).shards)
+            except SystemExit:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                _highres_failure(f"{type(e).__name__}: {e}")
+            sys.exit(0)
         try:
             ap = argparse.ArgumentParser(prog="bench.py serving")
             ap.add_argument("--replicas", type=int, default=1,

@@ -189,57 +189,155 @@ class FlowPredictor:
         # 3) is fresh host data each call and is left alone, so
         # donate+warm compose instead of silently disabling donation
         # (which blocked TPU-default configs from ever warm-starting).
-        # spatial_jit manages its own sharding/placement.
-        donate = bool(self.donate_images) and self.mesh is None
+        # Mesh dispatch never reaches here: ``__call__`` and
+        # ``dispatch_batch`` route meshed predictors through
+        # :meth:`sharded_dispatch` (the ("sharded", ...) cache family),
+        # so the plain-jit families below are unsharded by construction.
+        if self.mesh is not None:
+            raise AssertionError(
+                "_fn is the unsharded executable family; meshed "
+                "predictors dispatch via sharded_dispatch()")
+        donate = bool(self.donate_images)
         key = (shape, warm, self.iters, donate)
         if key not in self._cache:
-            if self.mesh is not None:
-                if warm:
-                    raise ValueError(
-                        "warm start (flow_init) is not supported with "
-                        "spatially-sharded eval — the init flow would "
-                        "need its own sharding spec")
-                from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
-                n_sp = self.mesh.shape[SPATIAL_AXIS]
-                n_dt = self.mesh.shape.get(DATA_AXIS, 1)
-                rows = shape[1]
-                if rows % n_sp:
-                    raise ValueError(
-                        f"spatially-sharded eval needs the padded image "
-                        f"height ({rows}) divisible by spatial_shards "
-                        f"({n_sp}); pick a divisor of the padded height "
-                        "(InputPadder pads to /8)")
-                from raft_tpu.parallel.spatial import spatial_jit
+            model = self._pick_engine(shape)
 
-                # Per-shape engine dispatch under spatial sharding
-                # (round 5, VERDICT r4 #2): the banded kernel composes
-                # with the row-sharded forward via shard_map
-                # (models.corr._sharded_fused_lookup), so high-res
-                # multi-chip eval no longer eats the materialized
-                # engine's 1.5-1.7x penalty where the kernel fits VMEM
-                # and rows divide evenly.
-                model = self._pick_engine(shape, n_sp=n_sp, n_dt=n_dt)
+            def run(variables, image1, image2, flow_init=None,
+                    model=model):
+                return model.apply(
+                    variables, image1, image2, iters=self.iters,
+                    flow_init=flow_init, test_mode=True)
 
-                def run(variables, image1, image2, model=model):
-                    return model.apply(
-                        variables, image1, image2, iters=self.iters,
-                        test_mode=True)
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1, 2) if donate else ())
+        return self._cache[key]
 
-                sharded = spatial_jit(run, self.mesh)
-                self._cache[key] = (
-                    lambda v, i1, i2, init=None: sharded(v, i1, i2))
-            else:
-                model = self._pick_engine(shape)
+    def _sharded_fn(self, shape, mesh, warm: bool) -> Callable:
+        """Spatially-sharded executable family (the multi-chip
+        high-resolution latency path): image rows over ``mesh``'s
+        spatial axis via :func:`raft_tpu.parallel.spatial.spatial_jit`.
 
-                def run(variables, image1, image2, flow_init=None,
+        Cache keys are ``(shape, ("sharded", (n_data, n_spatial,
+        device_ids), warm), donate)`` — the ``"sharded"`` tag tuple can
+        never collide with the stateless ``warm`` bool, the
+        ``("iters", ...)`` tuple, the ``"encode"`` tag, or the
+        ``("refine", ...)`` tag, so one predictor (and every
+        ``clone_with_variables`` clone) serves sharded AND unsharded
+        buckets through the one shared cache. Donation composes the
+        same way as the plain-jit families (image buffers only).
+
+        Per-shape engine dispatch (round 5, VERDICT r4 #2) carries
+        over: the banded kernel composes with the row-sharded forward
+        via shard_map (models.corr._sharded_fused_lookup), whose stores
+        go through the ops/layout.py boundary contract, so high-res
+        multi-chip eval keeps the kernel wherever it fits VMEM and rows
+        divide evenly. ``warm=True`` selects the warm-start executable:
+        the low-res flow_init gets its own row-sharding spec
+        (``spatial_jit(warm_init=True)``).
+
+        ``shape`` must have rows divisible by the spatial axis —
+        :meth:`sharded_dispatch` pre-pads indivisible heights.
+        """
+        from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+        from raft_tpu.parallel.spatial import spatial_jit
+
+        n_sp = mesh.shape[SPATIAL_AXIS]
+        n_dt = mesh.shape.get(DATA_AXIS, 1)
+        assert shape[1] % n_sp == 0, (shape, n_sp)
+        donate = bool(self.donate_images)
+        mesh_key = (n_dt, n_sp, tuple(d.id for d in mesh.devices.flat))
+        key = (shape, ("sharded", mesh_key, bool(warm)), donate)
+        if key not in self._cache:
+            model = self._pick_engine(shape, n_sp=n_sp, n_dt=n_dt)
+            if warm:
+                def run(variables, image1, image2, flow_init,
                         model=model):
                     return model.apply(
                         variables, image1, image2, iters=self.iters,
                         flow_init=flow_init, test_mode=True)
-
-                self._cache[key] = jax.jit(
-                    run, donate_argnums=(1, 2) if donate else ())
+            else:
+                def run(variables, image1, image2, model=model):
+                    return model.apply(
+                        variables, image1, image2, iters=self.iters,
+                        test_mode=True)
+            self._cache[key] = spatial_jit(
+                run, mesh, donate=donate, warm_init=warm)
         return self._cache[key]
+
+    def sharded_dispatch(self, images1, images2, flow_init=None,
+                         mesh=None):
+        """Non-blocking spatially-sharded batched forward: (B, H, W, 3)
+        stacks → ``(flow_low, flow_up)`` *device* arrays, image rows
+        sharded over the mesh's spatial axis — ONE request's (HW)²
+        correlation volume split across chips, the latency lever for
+        high-resolution pairs that cannot batch.
+
+        ``mesh`` defaults to the predictor's own ``self.mesh``. The
+        serving engine passes an explicit serving mesh instead, so a
+        single predictor serves the unsharded batched buckets and the
+        sharded high-res bucket side by side through the one executable
+        cache (disjoint ``("sharded", ...)`` keys; see
+        :meth:`_sharded_fn`).
+
+        Heights whose rows do not divide the spatial axis are
+        edge-padded (bottom rows, matching InputPadder's replicate
+        policy) up to the least multiple of ``spatial_shards * 8`` and
+        the flows lazily cropped back — the pad→forward→crop
+        composition replaces the old hard ValueError on indivisible
+        heights and keeps the /8 feature rows divisible too (the
+        sharded banded kernel's own requirement). Shapes that already
+        divide are passed through untouched (bit-identical to the
+        round-5 path).
+
+        ``flow_init`` (B, H/8, W/8, 2) warm-starts the refinement scan
+        through the warm sharded executable — the init flow carries its
+        own row-sharding spec, so ``--warm_start`` composes with
+        ``--spatial_shards``.
+        """
+        mesh = self.mesh if mesh is None else mesh
+        if mesh is None:
+            raise ValueError(
+                "sharded_dispatch needs a mesh — construct the "
+                "predictor with one (load_predictor(spatial_shards=N)) "
+                "or pass mesh= explicitly")
+        from raft_tpu.parallel.mesh import SPATIAL_AXIS
+        n_sp = mesh.shape[SPATIAL_AXIS]
+        images1 = np.asarray(images1)
+        images2 = np.asarray(images2)
+        rows = int(images1.shape[1])
+        unit = n_sp * 8
+        # Rows dividing the spatial axis pass through unpadded (the /8
+        # feature rows may still be uneven — GSPMD handles that for the
+        # stateless path and eligibility gating keeps the kernel off).
+        # The warm path additionally needs the /8 init-flow rows even,
+        # so it pads unless rows divide spatial_shards * 8.
+        indivisible = (rows % n_sp != 0 or
+                       (flow_init is not None and rows % unit != 0))
+        extra = (-rows) % unit if indivisible else 0
+        if extra:
+            pad = ((0, 0), (0, extra), (0, 0), (0, 0))
+            images1 = np.pad(images1, pad, mode="edge")
+            images2 = np.pad(images2, pad, mode="edge")
+            if flow_init is not None:
+                flow_init = np.pad(
+                    np.asarray(flow_init),
+                    ((0, 0), (0, extra // 8), (0, 0), (0, 0)),
+                    mode="edge")
+        img1 = jnp.asarray(images1)
+        img2 = jnp.asarray(images2)
+        fn = self._sharded_fn(img1.shape, mesh, flow_init is not None)
+        if flow_init is None:
+            flow_low, flow_up = fn(self.variables, img1, img2)
+        else:
+            flow_low, flow_up = fn(self.variables, img1, img2,
+                                   jnp.asarray(flow_init))
+        if extra:
+            # Lazy device crops: still async (the caller syncs), and the
+            # tiny slice executables compile once per shape — during
+            # serving warmup, which drives this same path.
+            flow_low = flow_low[:, :rows // 8]
+            flow_up = flow_up[:, :rows]
+        return flow_low, flow_up
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
                  flow_init: Optional[np.ndarray] = None):
@@ -248,6 +346,12 @@ class FlowPredictor:
         Returns ``(flow_low, flow_up)`` numpy arrays, shapes
         ``(H/8, W/8, 2)`` and ``(H, W, 2)``.
         """
+        if self.mesh is not None:
+            init = (None if flow_init is None
+                    else np.asarray(flow_init)[None])
+            flow_low, flow_up = self.sharded_dispatch(
+                np.asarray(image1)[None], np.asarray(image2)[None], init)
+            return np.asarray(flow_low[0]), np.asarray(flow_up[0])
         img1 = jnp.asarray(image1)[None]
         img2 = jnp.asarray(image2)[None]
         init = None if flow_init is None else jnp.asarray(flow_init)[None]
@@ -330,7 +434,13 @@ class FlowPredictor:
         executable — bit-identical to the pre-knob path. An explicit
         count routes through :meth:`_iters_fn`; with the predictor's
         ``early_exit`` set that path returns a third per-sample
-        iterations-used array."""
+        iterations-used array.
+
+        Meshed predictors route the default-iters path through
+        :meth:`sharded_dispatch` (rows over the spatial axis); explicit
+        ``iters`` still refuses there (:meth:`_iters_fn`)."""
+        if iters is None and self.mesh is not None:
+            return self.sharded_dispatch(images1, images2)
         img1 = jnp.asarray(images1)
         img2 = jnp.asarray(images2)
         if iters is None:
@@ -920,9 +1030,12 @@ def main(argv=None):
                         help="shard image rows over this many chips "
                              "(sequence-parallel eval for resolutions "
                              "whose correlation volume exceeds one "
-                             "chip's HBM; canonical family only; must "
-                             "divide the padded image height, and is "
-                             "incompatible with --warm_start)")
+                             "chip's HBM; canonical family only; "
+                             "indivisible padded heights are edge-"
+                             "padded to the least multiple of "
+                             "spatial_shards*8 and cropped back; "
+                             "composes with --warm_start — the init "
+                             "flow carries its own row-sharding spec)")
     parser.add_argument("--corr_impl", default=None,
                         choices=["fixed", "auto"],
                         help="correlation engine for canonical-RAFT eval:"
@@ -949,9 +1062,6 @@ def main(argv=None):
     if args.dataset == "golden" and args.small:
         parser.error("--dataset golden compares against RAFT-large "
                      "goldens; use --dataset golden_small for --small")
-    if args.warm_start and args.spatial_shards > 1:
-        parser.error("--warm_start is incompatible with --spatial_shards "
-                     "(the init flow would need its own sharding spec)")
     if args.model_family != "raft" and args.warm_start:
         parser.error("--warm_start requires the canonical RAFT family "
                      f"(the {args.model_family} family does not support "

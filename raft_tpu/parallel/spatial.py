@@ -68,7 +68,8 @@ def image_spec(shard_batch: bool = True) -> P:
 
 def spatial_jit(apply_fn: Callable, mesh: Mesh,
                 shard_batch: bool = True,
-                donate: bool = False) -> Callable:
+                donate: bool = False,
+                warm_init: bool = False) -> Callable:
     """Jit ``apply_fn(variables, image1, image2)`` with both images
     sharded over (data, spatial) and params replicated.
 
@@ -81,9 +82,34 @@ def spatial_jit(apply_fn: Callable, mesh: Mesh,
     ``jax.jit`` with ``in_shardings`` rejects kwargs, so bind options like
     ``test_mode`` into ``apply_fn`` first (``functools.partial`` /
     closure).
+
+    ``donate=True`` donates the two image buffers (argnums 1, 2) to the
+    executable — the serving steady state re-stacks fresh host arrays
+    every batch, so the device copies are dead after dispatch; composes
+    with sharding exactly like the plain-jit families.
+
+    ``warm_init=True`` selects the warm-start signature
+    ``apply_fn(variables, image1, image2, flow_init)``: the low-res init
+    flow (B, H/8, W/8, 2) gets its OWN row-sharding spec — the same
+    (batch, rows) layout as the images, legal because the caller pads
+    image rows to a multiple of ``spatial_shards * 8`` so the /8 feature
+    rows divide the spatial axis too. flow_init is never donated (same
+    policy as the unsharded warm family: it is the caller's propagated
+    state, not a dead buffer).
     """
     ispec = NamedSharding(mesh, image_spec(shard_batch))
     rep = NamedSharding(mesh, P())
+
+    if warm_init:
+        def traced_warm(variables, image1, image2, flow_init):
+            with spatial_kernel_mesh(mesh):
+                return apply_fn(variables, image1, image2, flow_init)
+
+        return jax.jit(
+            traced_warm,
+            in_shardings=(rep, ispec, ispec, ispec),
+            donate_argnums=(1, 2) if donate else (),
+        )
 
     def traced(variables, image1, image2):
         # context active during TRACING (the body runs inside jit), so

@@ -135,12 +135,19 @@ class ShapeBucketBatcher:
         raises :class:`BacklogFull` (load shedding beats unbounded
         memory growth and unbounded tail latency) — unless the arriving
         request is HIGH and a LOW request can be shed in its place.
+      max_batch_for: optional per-bucket batch-size override,
+        ``bucket key -> int`` (falsy return falls back to
+        ``max_batch``). The spatially-sharded serving bucket runs at
+        its own small batch (latency-bound single high-res requests;
+        batching them would multiply per-chip activation memory), while
+        every other bucket keeps the global ``max_batch``.
       clock: injectable monotonic clock (tests).
     """
 
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.005,
                  max_pending: int = 2048,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 max_batch_for: Optional[Callable[[Tuple], int]] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_s < 0:
@@ -148,6 +155,7 @@ class ShapeBucketBatcher:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self.max_pending = max_pending
+        self._max_batch_for = max_batch_for
         self._clock = clock
         # bucket key -> _Bucket. OrderedDict so iteration order is
         # stable (deterministic tests).
@@ -269,11 +277,21 @@ class ShapeBucketBatcher:
 
     # -- dispatcher side ------------------------------------------------
 
+    def _bucket_cap(self, key) -> int:
+        """Dispatch size for ``key``'s bucket (per-bucket override or
+        the global ``max_batch``)."""
+        if self._max_batch_for is not None:
+            cap = self._max_batch_for(key)
+            if cap:
+                return max(1, int(cap))
+        return self.max_batch
+
     def _pop_from(self, key) -> List[QueuedRequest]:
         bucket = self._buckets[key]
+        cap = self._bucket_cap(key)
         batch: List[QueuedRequest] = []
         for lane in (bucket.high, bucket.low):
-            while lane and len(batch) < self.max_batch:
+            while lane and len(batch) < cap:
                 batch.append(lane.popleft())
         if not len(bucket):
             del self._buckets[key]
@@ -282,7 +300,7 @@ class ShapeBucketBatcher:
 
     def _full_bucket(self) -> Optional[Tuple[int, int]]:
         for key, bucket in self._buckets.items():
-            if len(bucket) >= self.max_batch:
+            if len(bucket) >= self._bucket_cap(key):
                 return key
         return None
 

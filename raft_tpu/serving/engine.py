@@ -211,6 +211,34 @@ class ServingConfig:
         band).
       brownout_dwell_ms: minimum milliseconds between ladder steps in
         either direction (flap damping).
+      sharded_buckets: raw ``(H, W)`` shapes served through the
+        spatially-sharded dispatch path (``FlowPredictor
+        .sharded_dispatch``: one request's image rows — and its (HW)²
+        correlation volume — split over ``sharded_shards`` chips, the
+        multi-chip latency path for high-res pairs that cannot batch).
+        Padded with ``factor = sharded_shards * factor`` so the padded
+        rows always divide the spatial axis (and the /8 feature rows
+        divide it too — the sharded banded kernel's requirement).
+        Warmup pre-compiles each one's executable; their
+        ``(ph, pw, "mesh")`` buckets live on their own permanent
+        :class:`_BucketStream`, so big-shard and small-batch traffic
+        dispatch concurrently through the per-bucket streams.
+      sharded_shards: spatial shard count for the sharded path (the
+        serving mesh is ``(1, sharded_shards)`` over the first that
+        many visible devices). Required >= 2 whenever
+        ``sharded_buckets`` or ``sharded_area_threshold`` is set.
+      sharded_area_threshold: raw ``H * W`` pixel area at or above
+        which ANY submitted shape auto-routes to the sharded path
+        (oversized requests need the latency/memory help even when
+        their exact shape wasn't configured; such shapes pay a
+        first-contact compile like any unconfigured bucket). ``0``
+        (default) disables auto-routing — only ``sharded_buckets``
+        shapes go sharded.
+      sharded_max_batch: dispatch size of sharded buckets (default 1:
+        the path exists for latency-bound single requests, and
+        batching multiplies per-chip activation memory at exactly the
+        resolutions that needed sharding). Other buckets keep
+        ``max_batch``.
     """
 
     max_batch: int = 8
@@ -233,6 +261,10 @@ class ServingConfig:
     brownout_high_water: int = 0
     brownout_low_water: int = 0
     brownout_dwell_ms: float = 250.0
+    sharded_buckets: Tuple[Tuple[int, int], ...] = ()
+    sharded_shards: int = 0
+    sharded_area_threshold: int = 0
+    sharded_max_batch: int = 1
 
 
 class _BucketStream:
@@ -434,10 +466,59 @@ class ServingEngine:
         self.breaker = CircuitBreaker(
             threshold=self.config.breaker_threshold,
             cooldown_s=self.config.breaker_cooldown_s)
+        # Spatially-sharded serving path (the multi-chip latency path
+        # for high-res, unbatchable requests): a (1, sharded_shards)
+        # serving mesh held by the ENGINE, not the predictor — the one
+        # predictor keeps serving the unsharded batched buckets while
+        # sharded buckets dispatch through predictor.sharded_dispatch
+        # (disjoint ("sharded", ...) executable-cache keys).
+        self._sharded_mesh = None
+        self._sharded_shards = int(self.config.sharded_shards)
+        self._sharded_factor = self.config.factor
+        sharded_wanted = (self.config.sharded_buckets
+                          or self.config.sharded_area_threshold)
+        if sharded_wanted:
+            if self._sharded_shards < 2:
+                raise ValueError(
+                    "sharded_buckets/sharded_area_threshold need "
+                    f"sharded_shards >= 2, got "
+                    f"{self.config.sharded_shards} (the sharded path "
+                    "splits one request's rows across chips)")
+            n_dev = len(jax.devices())
+            if n_dev < self._sharded_shards:
+                raise ValueError(
+                    f"sharded_shards={self._sharded_shards} exceeds the "
+                    f"{n_dev} visible devices — this host cannot hold "
+                    "the serving mesh")
+            from raft_tpu.parallel import make_mesh
+            self._sharded_mesh = make_mesh(
+                n_data=1, n_spatial=self._sharded_shards,
+                devices=jax.devices()[:self._sharded_shards])
+            # Padding to sharded_shards * factor makes every sharded
+            # bucket's rows divide the spatial axis (least multiple >=
+            # H — InputPadder's pad math) AND keeps the /8 feature rows
+            # divisible, so sharded_dispatch never needs its internal
+            # extra-pad fallback on the serving path.
+            self._sharded_factor = (self._sharded_shards
+                                    * self.config.factor)
+        self._sharded_padded = frozenset(
+            InputPadder((*hw, 3), mode=self.config.pad_mode,
+                        factor=self._sharded_factor).padded_shape
+            for hw in self.config.sharded_buckets)
+        # Routing matches RAW shapes: a small configured bucket may pad
+        # to the same shape as a sharded bucket under the coarser
+        # sharded factor, and must keep its batched path regardless.
+        self._sharded_raw = frozenset(
+            (int(h), int(w)) for h, w in self.config.sharded_buckets)
+        self._batched_raw = (
+            frozenset((int(h), int(w)) for h, w in self.config.buckets)
+            | frozenset((int(h), int(w))
+                        for h, w in self.config.warm_buckets))
         self.batcher = ShapeBucketBatcher(
             max_batch=self.config.max_batch,
             max_wait_s=self.config.max_wait_ms / 1e3,
-            max_pending=self.config.max_pending)
+            max_pending=self.config.max_pending,
+            max_batch_for=self._bucket_max)
         self._inflight_batches = 0
         # bucket -> _BucketStream, created lazily by the router thread
         # (the only writer); _streams_lock guards reads from other
@@ -470,7 +551,12 @@ class ServingEngine:
             | frozenset((*p, lvl) for p in self._stateless_padded
                         for lvl in ladder)
             | frozenset((*p, "warm", eff) for p in self._warm_padded
-                        for eff in self._warm_effs))
+                        for eff in self._warm_effs)
+            # Sharded buckets keep their own permanent streams: the
+            # whole point is big-shard dispatch overlapping the
+            # small-batch streams, so they must never be LRU-retired
+            # under mixed traffic.
+            | frozenset((*p, "mesh") for p in self._sharded_padded))
         self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
@@ -493,6 +579,10 @@ class ServingEngine:
         m.set_gauge_source("inflight_batches",
                            lambda: self._inflight_batches)
         m.set_gauge_source("breaker_trips", lambda: self.breaker.trips)
+        m.set_gauge_source(
+            "sharded_shards",
+            lambda: (self._sharded_shards
+                     if self._sharded_mesh is not None else 0))
         m.set_gauge_source(
             "health_state",
             lambda: health_mod.HEALTH_CODES[self.health_state()])
@@ -570,6 +660,29 @@ class ServingEngine:
             for raw_hw in (self.config.warm_buckets
                            if buckets is None else ()):
                 stats.update(self._warmup_session_bucket(raw_hw))
+            for raw_hw in (self.config.sharded_buckets
+                           if buckets is None else ()):
+                # Sharded executables warm through the exact serve-path
+                # entry (sharded_dispatch with the engine's serving
+                # mesh) at the sharded batch size — after this, sharded
+                # traffic on configured shapes is zero-compile like any
+                # other bucket (including the lazy output crops, which
+                # this same path compiles when a shape needs them).
+                padder = InputPadder((*raw_hw, 3),
+                                     mode=self.config.pad_mode,
+                                     factor=self._sharded_factor)
+                ph, pw = padder.padded_shape
+                z1 = np.zeros((self.config.sharded_max_batch, ph, pw, 3),
+                              np.float32)
+                z2 = np.zeros_like(z1)
+                t0 = time.perf_counter()
+                with CompileWatch() as w:
+                    out = self.predictor.sharded_dispatch(
+                        z1, z2, mesh=self._sharded_mesh)
+                    np.asarray(out[1])
+                stats[(ph, pw, "mesh")] = {
+                    "compiles": float(w.compiles),
+                    "seconds": time.perf_counter() - t0}
         finally:
             self._warming = False
         return stats
@@ -739,6 +852,52 @@ class ServingEngine:
 
     # -- client API -----------------------------------------------------
 
+    # -- spatially-sharded (high-resolution) routing ---------------------
+
+    @property
+    def hosts_sharded(self) -> bool:
+        """Whether this engine holds a serving mesh — the fleet's
+        capacity gate: sharded buckets route only to replicas whose
+        device set can host the mesh."""
+        return self._sharded_mesh is not None
+
+    def _bucket_max(self, bucket) -> int:
+        """Per-bucket dispatch size (the batcher's ``max_batch_for``):
+        sharded buckets run at ``sharded_max_batch``, everything else
+        at the global ``max_batch``."""
+        if len(bucket) == 3 and bucket[2] == "mesh":
+            return self.config.sharded_max_batch
+        return self.config.max_batch
+
+    def sharded_route(self, raw_shape) -> Optional[Tuple]:
+        """The sharded-vs-batched routing decision for one raw request
+        shape: returns the ``(ph, pw, "mesh")`` bucket the request
+        would serve under (padded at ``sharded_shards * factor``), or
+        ``None`` for the ordinary batched path.
+
+        Raw shapes listed in ``sharded_buckets`` always route sharded.
+        Shapes explicitly configured as batched (``buckets`` /
+        ``warm_buckets``) always keep their batched path — even above
+        the area threshold, and even when the coarser sharded pad
+        factor would land them on a sharded bucket's padded shape.
+        Everything else routes sharded when its raw pixel area reaches
+        ``sharded_area_threshold``. Shared with the fleet so
+        engine-level and fleet-level bucket keys (and the
+        ``"HxW@mesh"`` rendezvous digests) agree."""
+        if self._sharded_mesh is None:
+            return None
+        h, w = int(raw_shape[0]), int(raw_shape[1])
+        sharded = (h, w) in self._sharded_raw
+        if not sharded:
+            thr = self.config.sharded_area_threshold
+            sharded = (bool(thr) and h * w >= thr
+                       and (h, w) not in self._batched_raw)
+        if not sharded:
+            return None
+        padded = InputPadder((h, w, 3), mode=self.config.pad_mode,
+                             factor=self._sharded_factor).padded_shape
+        return (*padded, "mesh")
+
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                priority: str = PRIORITY_HIGH,
                iters: Optional[int] = None):
@@ -771,6 +930,17 @@ class ServingEngine:
         if image1.shape != image2.shape:
             raise ValueError(f"frame shapes differ: {image1.shape} vs "
                              f"{image2.shape}")
+        sharded_bucket = self.sharded_route(image1.shape)
+        if sharded_bucket is not None:
+            if iters is not None and iters != self._full_iters:
+                raise ValueError(
+                    f"per-request iters={iters} is not supported on the "
+                    "spatially-sharded serving path (degraded-quality "
+                    "sharded buckets would need their own warmed "
+                    "executables) — sharded requests always serve full "
+                    "quality")
+            return self._submit_sharded(image1, image2, priority,
+                                        sharded_bucket)
         with self.stages.stage("pad"):
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self.config.factor)
@@ -806,6 +976,32 @@ class ServingEngine:
                             poisoned=active_injector()
                             .poisons_request(seq),
                             degradable=degradable)
+        return self._enqueue_request(req)
+
+    def _submit_sharded(self, image1, image2, priority,
+                        bucket) -> "Future":
+        """Enqueue one request onto its ``(ph, pw, "mesh")`` sharded
+        bucket: padded at the sharded factor (rows always divide the
+        spatial axis), never brownout-degradable (the sharded path
+        serves full quality only), dispatched through the bucket's own
+        permanent stream at ``sharded_max_batch``."""
+        with self.stages.stage("pad"):
+            padder = InputPadder(image1.shape, mode=self.config.pad_mode,
+                                 factor=self._sharded_factor)
+            im1, im2 = padder.pad(image1, image2)
+        t_submit = time.monotonic()
+        timeout = self.config.queue_timeout_ms
+        deadline = (t_submit + timeout / 1e3) if timeout else None
+        with self._state_lock:
+            self._submit_seq += 1
+            seq = self._submit_seq
+        req = QueuedRequest(im1, im2, padder, bucket=bucket,
+                            t_submit=t_submit, deadline=deadline,
+                            priority=priority,
+                            poisoned=active_injector()
+                            .poisons_request(seq),
+                            degradable=False)
+        self.metrics.record_sharded()
         return self._enqueue_request(req)
 
     def _check_accepting(self) -> None:
@@ -1070,14 +1266,16 @@ class ServingEngine:
 
     def _stack(self, batch: List[QueuedRequest]):
         n = len(batch)
+        cap = self._bucket_max(batch[0].bucket)
         with self.stages.stage("stack"):
             i1 = np.stack([r.image1 for r in batch])
             i2 = np.stack([r.image2 for r in batch])
-            if n < self.config.max_batch:
-                reps = self.config.max_batch - n
+            if n < cap:
+                reps = cap - n
                 # Tail-pad by repeating the last request — same rule as
-                # batched eval; one executable per bucket, never one per
-                # partial size.
+                # batched eval; one executable per bucket (at the
+                # bucket's own dispatch size — sharded buckets run at
+                # sharded_max_batch), never one per partial size.
                 i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
                 i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
         return i1, i2
@@ -1098,6 +1296,14 @@ class ServingEngine:
         with self._swap_lock:
             predictor = self.predictor
         bucket = batch[0].bucket
+        if len(bucket) == 3 and bucket[2] == "mesh":
+            # Spatially-sharded bucket: rows over the serving mesh's
+            # spatial axis through the predictor's ("sharded", ...)
+            # executable family — the same cache the batched buckets
+            # use, so one predictor (and its hot-reload clones) serves
+            # both paths.
+            return predictor.sharded_dispatch(
+                i1, i2, mesh=self._sharded_mesh)
         if len(bucket) == 3 and isinstance(bucket[2], int):
             # Degraded-quality (or explicit-iters) bucket: its own
             # pre-warmed executable at that iteration count.
@@ -1191,7 +1397,7 @@ class ServingEngine:
             self.breaker.record_failure()
             self._isolate_failed_batch(batch, e)
             return
-        self.metrics.record_batch(n, self.config.max_batch,
+        self.metrics.record_batch(n, self._bucket_max(batch[0].bucket),
                                   compiles=xla_compile_count() - c0)
         # Bounded per-bucket queue: blocks when pipeline_depth batches
         # of THIS bucket are already in flight — backpressure instead

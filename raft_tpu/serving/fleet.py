@@ -380,6 +380,30 @@ class ServingFleet:
                     "keys would diverge across replicas)")
         self._pad_mode = first.pad_mode
         self._factor = first.factor
+        # Spatially-sharded (high-resolution) routing: replicas that
+        # host a serving mesh decide and serve the sharded buckets.
+        # Their sharded knobs must agree — the ``(ph, pw, "mesh")``
+        # bucket keys (and the "HxW@mesh" rendezvous digests) are
+        # computed from the pad factor and shard count, so divergence
+        # would split one workload across incompatible keys. Replicas
+        # WITHOUT a mesh are fine (the capacity gate keeps sharded
+        # traffic off them); they just can't serve it.
+        self._sharded_rids = [rid for rid, eng in self._engines.items()
+                              if eng.hosts_sharded]
+        if self._sharded_rids:
+            ref = self._engines[self._sharded_rids[0]].config
+            for rid in self._sharded_rids:
+                cfg = self._engines[rid].config
+                if (cfg.sharded_shards, cfg.sharded_buckets,
+                        cfg.sharded_area_threshold,
+                        cfg.sharded_max_batch) != \
+                        (ref.sharded_shards, ref.sharded_buckets,
+                         ref.sharded_area_threshold,
+                         ref.sharded_max_batch):
+                    raise ValueError(
+                        "mesh-hosting fleet replicas must share the "
+                        "sharded_* config (sharded bucket keys and "
+                        "digests would diverge across replicas)")
         self.router = BucketRouter(list(self._engines))
         self.metrics = FleetMetrics(lambda: self._engines)
         self.warmup_stats: Dict[str, Dict[str, float]] = {}
@@ -478,6 +502,12 @@ class ServingFleet:
                     buckets.append(b)
         return self.router.assignment(buckets)
 
+    @staticmethod
+    def _is_sharded_bucket(bucket: Bucket) -> bool:
+        """True for ``(ph, pw, "mesh")`` buckets — the spatially-sharded
+        serving path's disjoint ``"HxW@mesh"`` digest namespace."""
+        return len(bucket) > 2 and bucket[2] == "mesh"
+
     def _routable(self, replica_id: str) -> bool:
         """Health-routable AND weight-synced. A replica left behind by
         a rolling reload (unroutable during the wave, a transient
@@ -495,8 +525,13 @@ class ServingFleet:
     def effective_owner(self, bucket: Bucket) -> Optional[str]:
         """The replica currently serving ``bucket``: the first owner in
         HRW preference order whose health and weight-sync gates pass.
-        ``None`` when no replica is routable (the fleet would shed)."""
+        ``None`` when no replica is routable (the fleet would shed).
+        Sharded ``(ph, pw, "mesh")`` buckets additionally require the
+        replica's device set to host the serving mesh."""
+        is_mesh = self._is_sharded_bucket(bucket)
         for rid in self.router.owners(bucket):
+            if is_mesh and not self._engines[rid].hosts_sharded:
+                continue
             if self._routable(rid):
                 return rid
         return None
@@ -556,6 +591,15 @@ class ServingFleet:
         bucket = self.bucket_for(image1.shape)
         if iters is not None:
             bucket = (*bucket, int(iters))
+        elif self._sharded_rids:
+            # The mesh-hosting replicas' shared routing rule decides
+            # whether this shape serves spatially sharded; a sharded
+            # request rendezvous-routes on its own (ph, pw, "mesh")
+            # bucket — the disjoint "HxW@mesh" digest namespace.
+            sharded = self._engines[self._sharded_rids[0]] \
+                .sharded_route(image1.shape)
+            if sharded is not None:
+                bucket = sharded
         self._dispatch(outer, image1, image2, priority, bucket,
                        tried=set(), hops=0, last_exc=None)
         return outer
@@ -588,18 +632,29 @@ class ServingFleet:
         one replica per re-entry, so the walk terminates."""
         owners = self.router.owners(bucket)
         primary = owners[0] if owners else None
+        is_mesh = self._is_sharded_bucket(bucket)
         for rid in owners:
             if rid in tried:
                 continue
             if not self._routable(rid):
                 continue
             engine = self._engines[rid]
+            if is_mesh and not engine.hosts_sharded:
+                # Capacity gate: a sharded bucket only routes to
+                # replicas whose device set hosts the serving mesh —
+                # a mesh-less replica would silently serve it through
+                # the single-chip batched path (compiling on first
+                # contact and losing the latency win).
+                continue
             try:
-                # A 3-tuple routed bucket carries its quality level;
-                # the engine re-validates it against its warmed ladder.
-                inner = engine.submit(
-                    image1, image2, priority=priority,
-                    iters=bucket[2] if len(bucket) > 2 else None)
+                # A routed bucket with an int third element carries its
+                # quality level (the engine re-validates it against its
+                # warmed ladder); the "mesh" tag is the sharded path's
+                # marker, never an iteration count.
+                iters = (bucket[2] if len(bucket) > 2
+                         and isinstance(bucket[2], int) else None)
+                inner = engine.submit(image1, image2, priority=priority,
+                                      iters=iters)
             except Exception as e:
                 # Refused at the door (breaker fast-fail, backlog full,
                 # closed): try the next owner.
@@ -614,6 +669,12 @@ class ServingFleet:
                     tried, hops))
             return
         self.metrics.record_shed()
+        if last_exc is None and is_mesh:
+            last_exc = EngineUnhealthy(
+                f"no routable replica can host the spatial mesh for "
+                f"sharded bucket {bucket} (mesh-capable: "
+                f"{', '.join(self._sharded_rids) or 'none'}; replicas: "
+                f"{', '.join(self._engines)})")
         outer.set_exception(last_exc or EngineUnhealthy(
             f"no routable replica for bucket {bucket} "
             f"(replicas: {', '.join(self._engines)})"))
@@ -919,7 +980,10 @@ def make_fleet(predictor, n_replicas: int,
     one compiled-executable cache (fleet-wide each bucket compiles
     once; failover traffic and rolling-reload standbys are cache
     hits). ``base`` supplies the shared knobs; its ``buckets`` is the
-    fleet-wide set, split here."""
+    fleet-wide set, split here. ``sharded_buckets`` is NOT split: every
+    replica gets the full sharded set (the spatial mesh is per-replica
+    hardware, so sharded buckets rendezvous-route across all
+    mesh-capable replicas rather than being owned by one)."""
     if n_replicas < 1:
         raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
     base = base or ServingConfig()

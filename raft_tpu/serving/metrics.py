@@ -147,6 +147,11 @@ class ServingMetrics:
         self.cold_stream_requests = 0
         self.encoder_hits = 0
         self.encoder_misses = 0
+        # spatially-sharded (high-resolution) requests: submits routed
+        # onto a (ph, pw, "mesh") bucket — rows split over the serving
+        # mesh instead of batched. The multi-chip latency path's
+        # traffic share in one counter.
+        self.sharded_requests = 0
         # served-quality accounting (graceful brownout): how many
         # responses served at each GRU iteration count — the SLO story
         # in one histogram (full-quality level vs the ladder's degraded
@@ -222,6 +227,13 @@ class ServingMetrics:
         the queue)."""
         with self._lock:
             self.breaker_fastfails += n
+
+    def record_sharded(self, n: int = 1) -> None:
+        """A submit routed onto the spatially-sharded serving path (on
+        top of ``record_submit``, which counts it in the request
+        totals)."""
+        with self._lock:
+            self.sharded_requests += n
 
     def record_stream_submit(self, warm: bool) -> None:
         """A stream-session pair accepted (on top of ``record_submit``,
@@ -347,6 +359,7 @@ class ServingMetrics:
                 "serving_isolated_retries": float(self.isolated_retries),
                 "serving_breaker_fastfails": float(
                     self.breaker_fastfails),
+                "serving_sharded_requests": float(self.sharded_requests),
                 "serving_warm_requests": float(self.warm_requests),
                 "serving_cold_stream_requests": float(
                     self.cold_stream_requests),

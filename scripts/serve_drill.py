@@ -42,6 +42,16 @@
    executables) warms up, serves a concurrent load bit-exactly, and
    triggers ZERO post-warmup XLA compiles — proving the round-6/7
    kernels ride the serving zero-compile contract.
+8. highres (``--drill highres``) — the spatially-sharded serving path
+   (forces ``--xla_force_host_platform_device_count=8`` before jax
+   initializes). Part A: one engine serves mixed highres+batch-1
+   traffic with the sharded bucket on its own dispatch stream — all
+   bit-exact, zero post-warmup compiles. Part B: a heterogeneous
+   3-replica fleet (two mesh-capable, one not) is killed under load —
+   sharded requests fail over to the surviving mesh replica with zero
+   drops; with both mesh replicas dead they shed CLEANLY with an error
+   naming the mesh (never wedging a stream) while the mesh-less
+   replica keeps serving small traffic.
 
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
@@ -830,6 +840,179 @@ def drill_pallas_kernels(root):
     assert engine.metrics.compiles == 0, engine.metrics.compiles
 
 
+def drill_highres(root):
+    """Spatially-sharded serving: mixed-traffic overlap on one engine
+    (zero post-warmup compiles), then kill-under-load on a
+    heterogeneous fleet — sharded requests fail over or shed cleanly,
+    never wedge a stream."""
+    import jax
+    import numpy as np
+
+    from raft_tpu.serving import (CompileWatch, EngineUnhealthy,
+                                  ServingConfig, ServingEngine,
+                                  ServingFleet, loadgen)
+
+    if jax.device_count() < 4:
+        raise AssertionError(
+            f"highres drill needs >= 4 devices, have {jax.device_count()}"
+            " — run via scripts/serve_drill.py (it forces the host-"
+            "device env before jax initializes)")
+
+    shards = 4
+    highres = (64, 96)
+    small_shapes = [(36, 60), (33, 57)]   # both pad to the (40,64) bucket
+    predictor = _make_predictor()
+
+    small_frames = loadgen.make_frames(small_shapes, per_shape=2, seed=71)
+    hi_frames = loadgen.make_frames([highres], per_shape=2, seed=72)
+    frames = small_frames + hi_frames
+    refs, ref_kind = _references(predictor, small_frames, max_batch=4)
+
+    base = dict(max_batch=4, max_wait_ms=3.0, buckets=tuple(small_shapes),
+                sharded_buckets=(highres,), sharded_shards=shards,
+                sharded_area_threshold=highres[0] * highres[1])
+
+    # -- Part A: one engine, mixed highres + batch-1 traffic ------------
+    engine = ServingEngine(predictor, ServingConfig(**base))
+    mesh = engine._sharded_mesh
+    # Sharded references come from the sharded executable itself: that
+    # IS the bucket's contractual server (the unsharded executable is a
+    # different float-accumulation order).
+    for im1, im2 in hi_frames:
+        out = predictor.sharded_dispatch(im1[None], im2[None], mesh=mesh)
+        refs.append(np.asarray(out[1][0]))
+    warm = engine.warmup()
+    engine.start(warmup=False)
+    try:
+        mesh_bucket = next(k for k in warm if len(k) > 2
+                           and k[2] == "mesh")
+        with CompileWatch() as watch:
+            res = loadgen.run_load(engine, frames, n_requests=48,
+                                   concurrency=8, references=refs)
+        streams = sorted(map(str, engine._streams))
+    finally:
+        engine.close()
+    sharded_n = int(engine.metrics.snapshot()["serving_sharded_requests"])
+    print(f"  mixed traffic: {res['completed']}/48 responses, "
+          f"{sharded_n} sharded, batch histogram "
+          f"{res['batch_histogram']}; reference = {ref_kind}")
+    print(f"  dispatch streams: {streams}")
+    assert res["completed"] == 48 and not res["dropped"], res["dropped"]
+    assert not res["mismatched"], \
+        f"bit-incorrect responses: {res['mismatched']}"
+    assert sharded_n == 16, f"sharded_requests {sharded_n}, want 16"
+    assert str(mesh_bucket) in streams, \
+        f"sharded bucket {mesh_bucket} has no dedicated stream"
+    assert len(streams) >= 2, \
+        "sharded and batched traffic must run on separate streams"
+    # Small traffic actually batched while sharded traffic ran batch-1:
+    # the overlap is real, not serialized through one stream.
+    assert any(k > 1 for k in res["batch_histogram"]), \
+        f"no multi-request batch formed: {res['batch_histogram']}"
+    assert watch.compiles == 0, \
+        f"{watch.compiles} fresh XLA compile(s) under mixed traffic"
+    print("  PART A: overlap + zero post-warmup compiles proved")
+
+    # -- Part B: heterogeneous fleet, kill-under-load -------------------
+    # r0/r1 host the mesh, r2 does not (the capacity-gate case: its
+    # device set is imagined too small — here simply unconfigured).
+    engines = []
+    for rid in ("r0", "r1"):
+        cfg = ServingConfig(replica_id=rid, breaker_threshold=2,
+                            breaker_cooldown_s=120.0, **base)
+        pred = (predictor if rid == "r0"
+                else predictor.clone_with_variables(predictor.variables))
+        engines.append(ServingEngine(pred, cfg))
+    cfg2 = ServingConfig(replica_id="r2", breaker_threshold=2,
+                         breaker_cooldown_s=120.0,
+                         max_batch=4, max_wait_ms=3.0,
+                         buckets=tuple(small_shapes))
+    engines.append(ServingEngine(
+        predictor.clone_with_variables(predictor.variables), cfg2))
+    fleet = ServingFleet(engines)
+    fleet.start()
+    try:
+        mesh_bucket = engines[0].sharded_route((*highres, 3))
+        owner = fleet.effective_owner(mesh_bucket)
+        assert owner in ("r0", "r1"), owner
+
+        n_requests = 90
+        out = {}
+
+        def load():
+            out.update(loadgen.run_load(
+                fleet, frames, n_requests=n_requests, concurrency=8,
+                references=refs, timeout=120.0))
+
+        def responses():
+            return sum(e.metrics.responses
+                       for e in fleet.engines.values())
+
+        loader = threading.Thread(target=load, name="highres-load")
+        loader.start()
+        _await_metric(responses, 20, 120, "responses before kill")
+        fleet.kill_replica(owner)
+        loader.join(300)
+        assert not loader.is_alive(), "load generator wedged"
+
+        survivor = fleet.effective_owner(mesh_bucket)
+        per = {rid: (s["completed"], s["dropped"])
+               for rid, s in out["per_replica"].items()}
+        print(f"  kill {owner} under load: {out['completed']}/"
+              f"{n_requests} responses, per-replica = {per}; sharded "
+              f"owner now {survivor}")
+        assert out["completed"] == n_requests, \
+            f"completed {out['completed']}/{n_requests}"
+        assert not out["dropped"], f"dropped: {out['dropped']}"
+        assert not out["mismatched"], \
+            f"bit-incorrect responses: {out['mismatched']}"
+        assert survivor in ("r0", "r1") and survivor != owner, survivor
+        snap = fleet.metrics.snapshot()
+        assert snap["fleet_failovers"] > 0, "no failover recorded"
+        # Sharded traffic never lands on the mesh-less replica.
+        f = fleet.submit(*hi_frames[0])
+        flow = f.result(60)
+        assert f.replica_id == survivor, \
+            f"sharded request served by {f.replica_id}, want {survivor}"
+        assert np.array_equal(flow, refs[len(small_frames)]), \
+            "post-failover sharded response not bit-exact"
+
+        # Both mesh replicas dead: sharded requests shed CLEANLY with
+        # an error naming the mesh; small traffic still flows on r2.
+        fleet.kill_replica(survivor)
+        # The kill is quiet — the health gate flips only once dispatches
+        # fail. Drive the threshold-2 breaker open with sharded traffic:
+        # every attempt surfaces an error promptly (never wedges).
+        for _ in range(4):
+            f = fleet.submit(*hi_frames[0])
+            err = None
+            try:
+                f.result(60)
+            except Exception as e:
+                err = e
+            assert err is not None, \
+                "dead mesh replica served a sharded request"
+            if fleet.effective_owner(mesh_bucket) is None:
+                break
+        assert fleet.effective_owner(mesh_bucket) is None, \
+            "dead mesh replica still routable after breaker threshold"
+        f = fleet.submit(*hi_frames[0])
+        try:
+            f.result(60)
+            raise AssertionError("sharded request served with no mesh-"
+                                 "capable replica alive")
+        except EngineUnhealthy as e:
+            assert "mesh" in str(e), e
+            print(f"  clean shed with both mesh replicas dead: {e}")
+        f = fleet.submit(*small_frames[0])
+        flow = f.result(60)
+        assert f.replica_id == "r2" and np.array_equal(flow, refs[0])
+        print("  PART B: failover + clean shed proved (r2 still serves "
+              "small traffic)")
+    finally:
+        fleet.close()
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -838,6 +1021,7 @@ DRILLS = [
     drill_streaming,
     drill_brownout,
     drill_pallas_kernels,
+    drill_highres,
 ]
 
 
@@ -856,6 +1040,17 @@ def main(argv=None) -> int:
     ap.add_argument("--list", action="store_true",
                     help="print available drills and exit")
     args = ap.parse_args(argv)
+    if args.drill in ("all", "highres"):
+        # The highres drill shards one request's rows over a 1x4 spatial
+        # mesh; on this CPU host the devices come from the forced host-
+        # platform count. Must be set before jax initializes its backend
+        # (first jax.devices() call inside a drill) — the other drills'
+        # bit-exactness checks adapt to the topology via _references.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if args.list:
         for fn in DRILLS:
             doc = (fn.__doc__ or "").strip().split("\n")[0]

@@ -1,0 +1,346 @@
+"""High-resolution serving via spatial sharding (the multi-chip
+batch-1 latency path).
+
+The suite runs on the conftest-forced 8-virtual-device CPU topology;
+every test that builds a mesh carries ``@pytest.mark.multidevice`` so
+a 1-device run skips cleanly instead of erroring (see conftest).
+
+Covers the serving-stack threading of ``parallel/spatial.py``:
+
+- sharded-vs-unsharded dispatch parity at the same shape (tolerance
+  pinned — different device partitioning reorders float accumulation,
+  so bit-equality is the wrong contract ACROSS executables; WITHIN the
+  sharded executable responses are bit-stable and serving asserts that)
+- the least-multiple edge-pad path for heights that don't divide the
+  spatial axis (the old hard ValueError), pinned against the manual
+  pad->forward->crop composition bit-exactly
+- warm-start (``flow_init``) through the sharded executable — the init
+  flow carries its own row-sharding spec
+- zero post-warmup compiles under mixed highres + batch-1 traffic, the
+  sharded bucket on its own dispatch stream
+- the fleet's disjoint ``"HxW@mesh"`` digest namespace, golden-pinned,
+  and the capacity gate: sharded buckets route only to mesh-hosting
+  replicas and shed with an error naming the mesh when none is left
+- the streaming-path refusal (deferred half of the warm-start
+  satellite): cached feature maps have no sharding specs yet
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    import jax
+
+    from raft_tpu.parallel import make_mesh
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    return make_mesh(n_data=1, n_spatial=4, devices=jax.devices()[:4])
+
+
+HI = (64, 96)          # rows divide 4 (and 8): the pass-through path
+SMALL = [(36, 60), (33, 57)]   # both pad to the (40, 64) bucket
+
+# Cross-executable parity tolerance: the sharded forward partitions the
+# same math over devices, so float accumulation order differs from the
+# single-device executable. Observed max-abs flow delta ~2e-5 on this
+# suite's operating point; 20x headroom, still far below any real flow.
+TOL = 5e-4
+
+
+class TestShardedDispatchParity:
+    @pytest.mark.multidevice
+    def test_sharded_vs_unsharded_parity(self, predictor, mesh4, rng):
+        i1 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        i2 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        low_u, up_u = map(np.asarray, predictor.dispatch_batch(i1, i2))
+        low_s, up_s = map(np.asarray, predictor.sharded_dispatch(
+            i1, i2, mesh=mesh4))
+        assert up_s.shape == up_u.shape == (1, *HI, 2)
+        assert low_s.shape == low_u.shape
+        assert np.max(np.abs(up_s - up_u)) < TOL
+        assert np.max(np.abs(low_s - low_u)) < TOL
+
+    @pytest.mark.multidevice
+    def test_extra_pad_path_parity(self, predictor, rng):
+        """Heights that don't divide the spatial axis take the internal
+        least-multiple edge-pad; it must equal the MANUAL pad->sharded->
+        crop composition bit-exactly (same executable either way) and
+        the unsharded answer within tolerance."""
+        import jax
+
+        from raft_tpu.parallel import make_mesh
+        if jax.device_count() < 3:
+            pytest.skip("needs 3 devices")
+        # n_spatial=3: every /8-padded height is even and divides the
+        # usual 2/4/8-way meshes, so a 3-way mesh is how this suite
+        # reaches the indivisible-rows branch at all. 64 % 3 != 0 ->
+        # least multiple of 3*8 is 72.
+        mesh3 = make_mesh(n_data=1, n_spatial=3,
+                          devices=jax.devices()[:3])
+        i1 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        i2 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        low_s, up_s = map(np.asarray, predictor.sharded_dispatch(
+            i1, i2, mesh=mesh3))
+        assert up_s.shape == (1, *HI, 2)
+        assert low_s.shape == (1, HI[0] // 8, HI[1] // 8, 2)
+
+        pad = ((0, 0), (0, 72 - HI[0]), (0, 0), (0, 0))
+        p1 = np.pad(i1, pad, mode="edge")
+        p2 = np.pad(i2, pad, mode="edge")
+        low_m, up_m = predictor.sharded_dispatch(p1, p2, mesh=mesh3)
+        assert np.array_equal(up_s, np.asarray(up_m)[:, :HI[0]])
+        assert np.array_equal(low_s, np.asarray(low_m)[:, :HI[0] // 8])
+
+        # Tolerance parity against the unsharded executable at the SAME
+        # padded input (edge rows enter the all-pairs correlation
+        # volume, so the padded and unpadded problems are legitimately
+        # different — the pad is part of the answer, not noise).
+        low_u, up_u = map(np.asarray, predictor.dispatch_batch(p1, p2))
+        assert np.max(np.abs(up_s - up_u[:, :HI[0]])) < TOL
+
+    @pytest.mark.multidevice
+    def test_warm_start_sharded_parity(self, predictor, mesh4, rng):
+        """flow_init rides its own row-sharding spec through the warm
+        sharded executable (--warm_start composes with
+        --spatial_shards)."""
+        i1 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        i2 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        init = rng.normal(size=(1, HI[0] // 8, HI[1] // 8, 2)).astype(
+            np.float32)
+        _, up_u = predictor(i1[0], i2[0], flow_init=init[0])
+        _, up_s = predictor.sharded_dispatch(i1, i2, flow_init=init,
+                                             mesh=mesh4)
+        up_s = np.asarray(up_s)[0]
+        assert up_s.shape == up_u.shape == (*HI, 2)
+        assert np.max(np.abs(up_s - up_u)) < TOL
+        # And the warm answer is genuinely warm: a large init must move
+        # the 2-iteration flow away from the cold answer.
+        _, up_cold = predictor.sharded_dispatch(i1, i2, mesh=mesh4)
+        assert not np.allclose(up_s, np.asarray(up_cold)[0], atol=1e-3)
+
+    @pytest.mark.multidevice
+    def test_streaming_refusal_pinned(self, predictor, mesh4):
+        """Deferred half of the warm-start satellite: the split
+        encode/refine streaming path still refuses meshed predictors —
+        the cached feature maps would need their own sharding specs
+        (ROADMAP notes the deferral)."""
+        meshed = predictor.clone_with_variables(predictor.variables)
+        meshed.mesh = mesh4
+        with pytest.raises(ValueError, match="streaming encode path is "
+                           "not supported with spatially-sharded eval"):
+            meshed.encode_dispatch(np.zeros((1, *HI, 3), np.float32))
+
+    @pytest.mark.multidevice
+    def test_per_request_iters_refused(self, predictor, mesh4):
+        meshed = predictor.clone_with_variables(predictor.variables)
+        meshed.mesh = mesh4
+        with pytest.raises(ValueError, match="per-request iters is not "
+                           "supported with spatially-sharded"):
+            meshed.dispatch_batch(np.zeros((1, *HI, 3), np.float32),
+                                  np.zeros((1, *HI, 3), np.float32),
+                                  iters=1)
+
+
+class TestShardedServingEngine:
+    def _engine(self, predictor, **kw):
+        from raft_tpu.serving import ServingConfig, ServingEngine
+        base = dict(max_batch=4, max_wait_ms=3.0, buckets=tuple(SMALL),
+                    sharded_buckets=(HI,), sharded_shards=4,
+                    sharded_area_threshold=HI[0] * HI[1])
+        base.update(kw)
+        return ServingEngine(predictor, ServingConfig(**base))
+
+    @pytest.mark.multidevice
+    def test_zero_post_warmup_compiles_mixed_traffic(self, predictor,
+                                                     rng):
+        """The acceptance probe: highres + batch-1 traffic through one
+        engine, every sharded response bit-matching the sharded
+        executable, zero fresh XLA compiles after warmup, and the
+        sharded bucket on its own dispatch stream."""
+        from raft_tpu.serving import CompileWatch, loadgen
+
+        eng = self._engine(predictor)
+        warm = eng.warmup()
+        mesh_bucket = (*HI, "mesh")
+        assert mesh_bucket in warm, sorted(warm)
+        hi = loadgen.make_frames([HI], per_shape=2, seed=5)
+        small = loadgen.make_frames(SMALL, per_shape=1, seed=6)
+        hi_refs = [np.asarray(predictor.sharded_dispatch(
+            a[None], b[None], mesh=eng._sharded_mesh)[1][0])
+            for a, b in hi]
+        eng.start(warmup=False)
+        try:
+            with CompileWatch() as watch:
+                futs = ([eng.submit(*p) for p in small * 3]
+                        + [eng.submit(*p) for p in hi * 2])
+                flows = [f.result(120) for f in futs]
+            assert mesh_bucket in eng._streams, \
+                sorted(map(str, eng._streams))
+        finally:
+            eng.close()
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) under mixed traffic"
+        for flow, (ref_a, _) in zip(flows[:6], small * 3):
+            assert flow.shape == (*ref_a.shape[:2], 2)
+        # Sharded responses are bit-stable against their executable.
+        for flow, ref in zip(flows[6:], hi_refs * 2):
+            assert np.array_equal(flow, ref)
+        snap = eng.metrics.snapshot()
+        assert snap["serving_sharded_requests"] == 4.0
+
+    @pytest.mark.multidevice
+    def test_sharded_route_raw_shape_semantics(self, predictor):
+        """Routing matches RAW shapes: explicit sharded buckets win,
+        explicit batched buckets are exempt from the area threshold,
+        anything else at/above the threshold goes sharded. (Padded-
+        shape matching would collide: (61, 96) pads to (64, 96) at the
+        sharded factor.)"""
+        eng = self._engine(predictor, buckets=((64, 96),),
+                           sharded_buckets=((128, 96),),
+                           sharded_area_threshold=64 * 96)
+        try:
+            assert eng.sharded_route((128, 96, 3)) == (128, 96, "mesh")
+            # explicit batched bucket: above threshold, still batched
+            assert eng.sharded_route((64, 96, 3)) is None
+            # unconfigured shape above threshold: auto-routes, padded
+            # at the sharded factor (4 * 8 = 32)
+            assert eng.sharded_route((65, 96, 3)) == (96, 96, "mesh")
+            # below threshold: regular dynamic bucket
+            assert eng.sharded_route((32, 48, 3)) is None
+        finally:
+            eng.close()
+
+    @pytest.mark.multidevice
+    def test_sharded_submit_refuses_degraded_iters(self, predictor,
+                                                   rng):
+        eng = self._engine(predictor, iters_ladder=(1,))
+        try:
+            eng.start(warmup=False)  # refusal fires at submit, pre-dispatch
+            a = rng.uniform(0, 255, (*HI, 3)).astype(np.float32)
+            with pytest.raises(ValueError,
+                               match="not supported on the spatially-"
+                                     "sharded serving path"):
+                eng.submit(a, a, iters=1)
+        finally:
+            eng.close()
+
+    @pytest.mark.multidevice
+    def test_config_validation(self, predictor):
+        import jax
+
+        from raft_tpu.serving import ServingConfig, ServingEngine
+        with pytest.raises(ValueError, match="sharded_shards"):
+            ServingEngine(predictor, ServingConfig(
+                sharded_buckets=(HI,), sharded_shards=1))
+        with pytest.raises(ValueError, match="devices"):
+            ServingEngine(predictor, ServingConfig(
+                sharded_buckets=(HI,),
+                sharded_shards=2 * jax.device_count()))
+
+
+class TestFleetMeshNamespace:
+    def test_mesh_digest_namespace_golden(self):
+        """The ``"HxW@mesh"`` rendezvous namespace is disjoint from the
+        plain and iters-extended bucket namespaces, golden-pinned so a
+        digest-scheme change (which would silently re-home every
+        sharded bucket across a live fleet) fails loudly."""
+        from raft_tpu.serving.fleet import BucketRouter
+
+        r = BucketRouter(["r0", "r1", "r2"])
+        assert r.owners((64, 96)) == ["r1", "r2", "r0"]
+        assert r.owners((64, 96, 4)) == ["r2", "r0", "r1"]
+        assert r.owners((64, 96, "mesh")) == ["r0", "r1", "r2"]
+        assert r.owners((96, 128, "mesh")) == ["r2", "r1", "r0"]
+        # Golden digests (blake2b-8 over "bucket-key|replica"): pinned
+        # values, not just pinned order.
+        assert r._score_key((64, 96, "mesh"), "r0") == \
+            9158200945068696524
+        assert r._score_key((96, 128, "mesh"), "r2") == \
+            16192066839992629443
+        scores = {
+            b: {rid: r._score_key(b, rid) for rid in ("r0", "r1", "r2")}
+            for b in ((64, 96), (64, 96, 4), (64, 96, "mesh"))}
+        seen = [v for per in scores.values() for v in per.values()]
+        assert len(set(seen)) == len(seen), \
+            "bucket namespaces collide in digest space"
+
+    @pytest.mark.multidevice
+    def test_shed_when_no_replica_hosts_mesh(self, predictor, rng):
+        """Capacity gate: with every mesh-hosting replica out, sharded
+        requests shed with an error NAMING the mesh — they are never
+        silently served by a mesh-less replica's batched path — while
+        that replica keeps serving small traffic."""
+        from raft_tpu.serving import (EngineUnhealthy, ServingConfig,
+                                      ServingEngine, ServingFleet)
+
+        base = dict(max_batch=2, max_wait_ms=3.0, buckets=tuple(SMALL))
+        e0 = ServingEngine(predictor, ServingConfig(
+            replica_id="r0", sharded_buckets=(HI,), sharded_shards=4,
+            sharded_area_threshold=HI[0] * HI[1], **base))
+        e1 = ServingEngine(
+            predictor.clone_with_variables(predictor.variables),
+            ServingConfig(replica_id="r1", **base))
+        fleet = ServingFleet([e0, e1])
+        assert fleet._sharded_rids == ["r0"]
+        fleet.start()
+        try:
+            hi1 = rng.uniform(0, 255, (*HI, 3)).astype(np.float32)
+            hi2 = rng.uniform(0, 255, (*HI, 3)).astype(np.float32)
+            f = fleet.submit(hi1, hi2)
+            assert f.result(120).shape == (*HI, 2)
+            assert f.replica_id == "r0"
+
+            e0.close()
+            assert fleet.effective_owner((*HI, "mesh")) is None
+            f = fleet.submit(hi1, hi2)
+            with pytest.raises(EngineUnhealthy,
+                               match="can host the spatial mesh"):
+                f.result(120)
+            # r1 (mesh-less) still serves batched traffic.
+            s1 = rng.uniform(0, 255, (*SMALL[0], 3)).astype(np.float32)
+            f = fleet.submit(s1, s1)
+            assert f.result(120).shape == (*SMALL[0], 2)
+            assert f.replica_id == "r1"
+        finally:
+            fleet.close()
+
+    @pytest.mark.multidevice
+    def test_mesh_replicas_must_share_sharded_config(self, predictor):
+        from raft_tpu.serving import (ServingConfig, ServingEngine,
+                                      ServingFleet)
+
+        base = dict(max_batch=2, buckets=tuple(SMALL),
+                    sharded_buckets=(HI,), sharded_shards=4)
+        e0 = ServingEngine(predictor, ServingConfig(
+            replica_id="r0", sharded_area_threshold=1000, **base))
+        e1 = ServingEngine(
+            predictor.clone_with_variables(predictor.variables),
+            ServingConfig(replica_id="r1", sharded_area_threshold=2000,
+                          **base))
+        with pytest.raises(ValueError,
+                           match="must share the sharded"):
+            ServingFleet([e0, e1])
+
+
+class TestMultideviceHarness:
+    @pytest.mark.multidevice
+    def test_multidevice_child_fixture(self, multidevice_child):
+        """The conftest child-process harness (satellite: round-5
+        parity-test pattern as a reusable fixture): the child owns its
+        backend and always sees the forced 8-device topology, whatever
+        the parent runs on."""
+        out = multidevice_child("""
+            import json
+            print("RESULT " + json.dumps(
+                {"devices": jax.device_count(),
+                 "platform": jax.devices()[0].platform}))
+        """)
+        assert out == {"devices": 8, "platform": "cpu"}
