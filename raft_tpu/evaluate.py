@@ -183,7 +183,7 @@ class FlowPredictor:
                                             data_shards=n_dt)
                 else allpairs)
 
-    def _fn(self, shape, warm: bool) -> Callable:
+    def _fn(self, shape, warm: bool, wire: str = "float32") -> Callable:
         # Donation applies to the plain-jit path, warm included: only
         # the image buffers (argnums 1, 2) are donated — flow_init (arg
         # 3) is fresh host data each call and is left alone, so
@@ -198,7 +198,11 @@ class FlowPredictor:
                 "_fn is the unsharded executable family; meshed "
                 "predictors dispatch via sharded_dispatch()")
         donate = bool(self.donate_images)
-        key = (shape, warm, self.iters, donate)
+        # ``wire`` is the image dtype the executable was traced for
+        # (uint8 requests normalize on device — models/normalize.py);
+        # keying on it keeps the zero-post-warmup-compile accounting
+        # honest when uint8 and float32 traffic share one bucket shape.
+        key = (shape, warm, self.iters, donate, wire)
         if key not in self._cache:
             model = self._pick_engine(shape)
 
@@ -212,7 +216,8 @@ class FlowPredictor:
                 run, donate_argnums=(1, 2) if donate else ())
         return self._cache[key]
 
-    def _sharded_fn(self, shape, mesh, warm: bool) -> Callable:
+    def _sharded_fn(self, shape, mesh, warm: bool,
+                    wire: str = "float32") -> Callable:
         """Spatially-sharded executable family (the multi-chip
         high-resolution latency path): image rows over ``mesh``'s
         spatial axis via :func:`raft_tpu.parallel.spatial.spatial_jit`.
@@ -246,7 +251,7 @@ class FlowPredictor:
         assert shape[1] % n_sp == 0, (shape, n_sp)
         donate = bool(self.donate_images)
         mesh_key = (n_dt, n_sp, tuple(d.id for d in mesh.devices.flat))
-        key = (shape, ("sharded", mesh_key, bool(warm)), donate)
+        key = (shape, ("sharded", mesh_key, bool(warm)), donate, wire)
         if key not in self._cache:
             model = self._pick_engine(shape, n_sp=n_sp, n_dt=n_dt)
             if warm:
@@ -325,7 +330,8 @@ class FlowPredictor:
                     mode="edge")
         img1 = jnp.asarray(images1)
         img2 = jnp.asarray(images2)
-        fn = self._sharded_fn(img1.shape, mesh, flow_init is not None)
+        fn = self._sharded_fn(img1.shape, mesh, flow_init is not None,
+                              str(img1.dtype))
         if flow_init is None:
             flow_low, flow_up = fn(self.variables, img1, img2)
         else:
@@ -341,7 +347,10 @@ class FlowPredictor:
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
                  flow_init: Optional[np.ndarray] = None):
-        """image1/2: (H, W, 3) float in [0, 255], already padded to /8.
+        """image1/2: (H, W, 3) in [0, 255] — float32 or uint8 (the
+        serving wire format; normalization happens inside the model,
+        so integral inputs produce bit-identical flow either way),
+        already padded to /8.
 
         Returns ``(flow_low, flow_up)`` numpy arrays, shapes
         ``(H/8, W/8, 2)`` and ``(H, W, 2)``.
@@ -355,7 +364,7 @@ class FlowPredictor:
         img1 = jnp.asarray(image1)[None]
         img2 = jnp.asarray(image2)[None]
         init = None if flow_init is None else jnp.asarray(flow_init)[None]
-        fn = self._fn(img1.shape, flow_init is not None)
+        fn = self._fn(img1.shape, flow_init is not None, str(img1.dtype))
         flow_low, flow_up = fn(self.variables, img1, img2, init)
         return np.asarray(flow_low[0]), np.asarray(flow_up[0])
 
@@ -384,7 +393,8 @@ class FlowPredictor:
         clone.variables = variables
         return clone
 
-    def _iters_fn(self, shape, iters: int) -> Callable:
+    def _iters_fn(self, shape, iters: int,
+                  wire: str = "float32") -> Callable:
         """Per-request-iters executable: same forward as :meth:`_fn`'s
         stateless cold path but with an explicit GRU iteration count —
         the serving brownout ladder's compile unit. The cache key's
@@ -405,7 +415,7 @@ class FlowPredictor:
                 "their own sharding specs")
         donate = bool(self.donate_images)
         ee = self.early_exit
-        key = (shape, ("iters", iters, ee), donate)
+        key = (shape, ("iters", iters, ee), donate, wire)
         if key not in self._cache:
             model = self._pick_engine(shape)
 
@@ -444,9 +454,9 @@ class FlowPredictor:
         img1 = jnp.asarray(images1)
         img2 = jnp.asarray(images2)
         if iters is None:
-            fn = self._fn(img1.shape, False)
+            fn = self._fn(img1.shape, False, str(img1.dtype))
         else:
-            fn = self._iters_fn(img1.shape, iters)
+            fn = self._iters_fn(img1.shape, iters, str(img1.dtype))
         return fn(self.variables, img1, img2, None)
 
     def predict_batch(self, images1: np.ndarray, images2: np.ndarray):
@@ -462,9 +472,10 @@ class FlowPredictor:
     # entry points: encode (fnet only) and refine (corr + cnet + scan,
     # fed precomputed fmaps) — one encoder pass per warm frame instead
     # of two, plus fewer GRU iterations when warm. Cache keys extend the
-    # stateless (shape, warm, iters, donate) convention so warm and cold
-    # frames hit distinct pre-warmed executables (the serving engine's
-    # zero-post-warmup-compile contract covers all three).
+    # stateless (shape, warm, iters, donate, wire) convention so warm and
+    # cold frames hit distinct pre-warmed executables (the serving
+    # engine's zero-post-warmup-compile contract covers all three, in
+    # both wire dtypes).
 
     def _require_session_path(self, what: str) -> None:
         from raft_tpu.models.raft import RAFT
@@ -487,7 +498,7 @@ class FlowPredictor:
         returned fmap is NOT donated anywhere — the engine syncs and
         slices it into per-session host caches."""
         img = jnp.asarray(images)
-        key = (img.shape, "encode")
+        key = (img.shape, "encode", str(img.dtype))
         if key not in self._cache:
             self._require_session_path("encode")
             from raft_tpu.models.raft import RAFT
@@ -539,7 +550,8 @@ class FlowPredictor:
             iters_used = (self.warm_iters if warm and self.warm_iters
                           else self.iters)
         donate = bool(self.donate_images) and self.mesh is None
-        key = (img1.shape, ("refine", bool(warm)), iters_used, donate)
+        key = (img1.shape, ("refine", bool(warm)), iters_used, donate,
+               str(img1.dtype))
         if key not in self._cache:
             self._require_session_path("refine")
             model = self._pick_engine(img1.shape)

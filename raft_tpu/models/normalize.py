@@ -1,0 +1,23 @@
+"""The single owner of the [0, 255] -> [-1, 1] input contract.
+
+Every model family normalizes images *inside* the jitted forward
+(reference ``core/raft.py:100-101``), which is what lets the serving
+wire format stay in the source dtype: a uint8 request crosses the host
+path and the H2D transfer at 1 byte/channel and only widens on device,
+here. Bit-exactness of the uint8 wire path rests on one float fact:
+``astype`` of an integral value in [0, 255] to float32 (or bfloat16 —
+255 needs 8 significand bits, bfloat16 has 8) is exact, so
+``2 * (x_u8.astype(f) / 255) - 1`` and the same expression on the
+float-valued ``x`` agree to the last ulp. Keep the arithmetic in this
+one helper verbatim — reordering it (e.g. ``x * (2/255) - 1``) changes
+rounding and breaks the pinned uint8-vs-float32 parity tests.
+"""
+
+from __future__ import annotations
+
+
+def normalize_image(image, dtype):
+    """[0, 255] NHWC image (any integer or float dtype) -> [-1, 1] in
+    ``dtype``. The exact reference arithmetic: divide by 255 first,
+    then scale and shift."""
+    return 2.0 * (image.astype(dtype) / 255.0) - 1.0

@@ -45,6 +45,7 @@ from raft_tpu.models.corr import AlternateCorrBlock, CorrBlock
 from raft_tpu.models.deformable import (MLP,
                                         DeformableTransformerDecoderLayer,
                                         DeformableTransformerEncoderLayer)
+from raft_tpu.models.normalize import normalize_image
 from raft_tpu.models.sparse_extractor import CNNDecoder, CNNEncoder
 from raft_tpu.ops.sampling import inverse_sigmoid
 
@@ -93,8 +94,8 @@ class SparseRAFT(nn.Module):
         B, I_H, I_W, _ = image1.shape
         L, N, Dm = cfg.num_feature_levels, cfg.num_keypoints, cfg.d_model
 
-        image1 = 2.0 * (image1.astype(dtype) / 255.0) - 1.0
-        image2 = 2.0 * (image2.astype(dtype) / 255.0) - 1.0
+        image1 = normalize_image(image1, dtype)
+        image2 = normalize_image(image2, dtype)
         both = jnp.concatenate([image1, image2], axis=0)
 
         encoder = CNNEncoder(cfg.base_channel, "instance", dtype=dtype,

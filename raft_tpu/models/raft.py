@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from raft_tpu.config import RAFTConfig
 from raft_tpu.models import corr
 from raft_tpu.models.extractor import BasicEncoder, SmallEncoder
+from raft_tpu.models.normalize import normalize_image
 from raft_tpu.models.update import BasicUpdateBlock, SmallUpdateBlock
 from raft_tpu.ops.sampling import convex_upsample, coords_grid, upflow8
 
@@ -225,7 +226,7 @@ class RAFT(nn.Module):
         """
         dtype = (jnp.bfloat16 if self.config.mixed_precision
                  else jnp.float32)
-        x = 2.0 * (image.astype(dtype) / 255.0) - 1.0
+        x = normalize_image(image, dtype)
         return self.fnet(x, train=False, deterministic=True)
 
     @nn.compact
@@ -271,10 +272,10 @@ class RAFT(nn.Module):
             raise ValueError("fmap1 and fmap2 must be given together")
 
         dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
-        image1 = 2.0 * (image1.astype(dtype) / 255.0) - 1.0
+        image1 = normalize_image(image1, dtype)
 
         if fmap1 is None:
-            image2 = 2.0 * (image2.astype(dtype) / 255.0) - 1.0
+            image2 = normalize_image(image2, dtype)
             # Twin-image trick: one fnet pass over both images
             # concatenated on the batch axis (reference
             # extractor_origin.py:168-171).

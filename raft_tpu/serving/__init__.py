@@ -13,7 +13,10 @@ forward itself:
 * :mod:`~raft_tpu.serving.engine` — warmup (per-bucket pre-compile +
   persistent XLA cache), pipelined async dispatch with donated input
   buffers, the ``submit() -> Future`` client API, circuit breaker +
-  batch error isolation + health states + atomic model swap.
+  batch error isolation + health states + atomic model swap; uint8
+  wire format (dtype-preserving host path through a zero-copy staging
+  arena, dual-dtype warmup, bit-identical outputs) and the opt-in
+  ``low_res`` 1/8-grid response.
 * :mod:`~raft_tpu.serving.health` — engine health states, the dispatch
   :class:`~raft_tpu.serving.health.CircuitBreaker`, and the
   :class:`~raft_tpu.serving.health.EngineUnhealthy` fail-fast error.
@@ -52,9 +55,11 @@ from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
                                       QueuedRequest, RequestTimedOut,
                                       ShapeBucketBatcher)
 from raft_tpu.serving.brownout import BrownoutController
-from raft_tpu.serving.engine import (ServingConfig, ServingEngine,
+from raft_tpu.serving.engine import (WIRE_F32, WIRE_U8, ServingConfig,
+                                     ServingEngine,
                                      enable_persistent_compile_cache,
-                                     make_engine)
+                                     make_engine, request_wire,
+                                     upsample_flow, wire_cast)
 from raft_tpu.serving.fleet import (BucketRouter, FleetMetrics,
                                     FleetReloadConfig, FleetReloader,
                                     FleetStreamSession, ServingFleet,
@@ -94,10 +99,15 @@ __all__ = [
     "ServingMetrics",
     "ShapeBucketBatcher",
     "StreamSession",
+    "WIRE_F32",
+    "WIRE_U8",
     "enable_persistent_compile_cache",
     "is_routable",
     "load_step_variables",
     "make_engine",
     "make_fleet",
+    "request_wire",
+    "upsample_flow",
+    "wire_cast",
     "xla_compile_count",
 ]

@@ -58,18 +58,25 @@ class QueuedRequest:
     a ``"warm"``/``"cold"`` tag so warm frames batch separately from
     cold (distinct executables, different iteration counts); degraded-
     quality (brownout) requests extend it with an integer iters level
-    instead — ``(ph, pw, iters)``. The batcher itself is generic over
-    hashable bucket keys."""
+    instead — ``(ph, pw, iters)`` — and every engine-built key carries
+    the request's wire-dtype tag (``"u8"``/``"f32"``) as its LAST
+    element, so uint8 and float32 traffic batch against their own
+    pre-warmed executables. The batcher itself is generic over hashable
+    bucket keys.
+
+    ``low_res``: the client opted into the 1/8-grid response (the
+    completion thread resolves the future to the padded low-res flow
+    instead of the unpadded full-res one — 64x fewer D2H bytes)."""
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
                  "deadline", "priority", "poisoned", "session",
-                 "flow_init", "fmap1", "degradable", "future")
+                 "flow_init", "fmap1", "degradable", "low_res", "future")
 
     def __init__(self, image1, image2, padder, bucket,
                  t_submit: float, deadline: Optional[float] = None,
                  priority: str = PRIORITY_HIGH, poisoned: bool = False,
                  session=None, flow_init=None, fmap1=None,
-                 degradable: bool = False):
+                 degradable: bool = False, low_res: bool = False):
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -88,6 +95,7 @@ class QueuedRequest:
         # brownout ladder may re-bucket while it waits (engine-set;
         # explicit client-chosen iters stay where they were queued).
         self.degradable = degradable
+        self.low_res = low_res
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:

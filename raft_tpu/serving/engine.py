@@ -40,6 +40,17 @@ mechanisms behind one ``submit() -> Future`` API:
   the kernel path once per bucket and steady-state requests stay at zero
   compiles (probe-asserted in ``tests/test_gru_pallas.py``). Flip those
   flags before engine construction, never between warmup and serving.
+* **Uint8 wire format + staging arena** — requests whose pixels are
+  integral [0, 255] (auto-detected once at submit; see ``wire_cast``)
+  stay uint8 through padding, batching and the H2D transfer — 4x fewer
+  host-path bytes — and normalize in-model to bit-identical flow; the
+  wire dtype tags the bucket key and the executable cache key, and
+  warmup compiles BOTH dtypes per bucket so mixed traffic never
+  compiles. Batches are staged into preallocated recycled host buffers
+  (:class:`_StagingArena` — one memcpy per request, no per-batch
+  pad-then-stack allocation), and ``submit(low_res=True)`` shrinks the
+  return path too: the 1/8-grid flow, 64x fewer D2H bytes, with
+  host-side :func:`upsample_flow` recovery.
 
 On top of those sits the **robustness layer** (Clipper-style: degrade
 gracefully, never let one failure take out its co-batched neighbors):
@@ -118,6 +129,166 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return cache_dir
+
+
+# -- wire format ---------------------------------------------------------
+#
+# RAFT normalizes [0, 255] images INSIDE the jitted forward
+# (models/normalize.py), so the host path has no reason to widen
+# integral pixels to float32: a uint8 request stays uint8 through
+# padding, the staging arena, and the H2D transfer — 4x fewer bytes on
+# every host copy — and only widens on device, where the normalization
+# makes the result bit-identical to the float32 path (astype of an
+# integral value in [0, 255] is exact). The wire dtype is detected ONCE
+# at submit, tagged onto the request's bucket key (so uint8 and float32
+# traffic batch separately, each against its own pre-warmed
+# executable), and carried in the FlowPredictor cache keys.
+
+WIRE_U8 = "u8"
+WIRE_F32 = "f32"
+_WIRE_TAGS = (WIRE_U8, WIRE_F32)
+
+
+def wire_cast(image: np.ndarray):
+    """Detect one image's wire format: ``("u8", arr)`` for uint8 input
+    or any float/int array whose values are integral and in [0, 255]
+    (cast to uint8 — exact, see models/normalize.py), else
+    ``("f32", arr)`` with the array in float32. The single O(N) host
+    check of the request path, paid in the submitting client's thread
+    like padding."""
+    a = np.asarray(image)
+    if a.dtype == np.uint8:
+        return WIRE_U8, a
+    f = a.astype(np.float32, copy=False)
+    with np.errstate(invalid="ignore"):    # NaN -> uint8 is rejected
+        u = f.astype(np.uint8)             # below, not warned about
+    # Round-trip equality rejects non-integral values, out-of-range
+    # values (uint8 wraps them) and NaN in one vectorized pass.
+    if np.array_equal(u.astype(np.float32), f):
+        return WIRE_U8, u
+    return WIRE_F32, f
+
+
+def request_wire(image1: np.ndarray, image2: np.ndarray):
+    """Wire format of one request PAIR: uint8 only when both frames
+    qualify; a mixed pair falls back to float32 for both (exact — the
+    uint8 side widens losslessly), so the pair always enters one
+    executable with one dtype."""
+    t1, a1 = wire_cast(image1)
+    t2, a2 = wire_cast(image2)
+    if t1 == t2:
+        return t1, a1, a2
+    return (WIRE_F32, a1.astype(np.float32, copy=False),
+            a2.astype(np.float32, copy=False))
+
+
+def _wire_of(bucket: Tuple) -> str:
+    """The wire tag of a batcher bucket key (always its LAST element on
+    engine-built buckets; tolerate untagged keys for tooling that
+    constructs buckets by hand)."""
+    return bucket[-1] if bucket and bucket[-1] in _WIRE_TAGS else WIRE_F32
+
+
+def _base_of(bucket: Tuple) -> Tuple:
+    """A bucket key with its wire tag stripped — what every
+    length/value-based bucket parser matches against. The tag strings
+    can never collide with the other tail elements ("warm"/"cold"/
+    "mesh"/ints), so stripping is unambiguous."""
+    return (bucket[:-1] if bucket and bucket[-1] in _WIRE_TAGS
+            else bucket)
+
+
+def upsample_flow(flow_low: np.ndarray, padder: Optional[InputPadder] = None,
+                  factor: int = 8) -> np.ndarray:
+    """Host-side full-resolution recovery for a ``low_res=True``
+    response: align-corners bilinear upsample of the 1/8-grid flow with
+    the vectors scaled by ``factor`` — the model's ``upflow8``
+    arithmetic in pure numpy, so no executable is compiled (the
+    zero-post-warmup-compile contract is why this lives host-side).
+    ``padder`` (stamped on low_res futures as ``future.padder``) crops
+    the result back to the raw resolution.
+
+    NOT bit-identical to the full-resolution response: the model's
+    in-graph convex upsampling uses a learned per-pixel mask the 1/8
+    flow alone doesn't carry. ``low_res`` trades that fidelity for 64x
+    fewer D2H + response bytes; callers who need the exact full-res
+    flow submit without it."""
+    f = np.asarray(flow_low, np.float32)
+    squeeze = f.ndim == 3
+    if squeeze:
+        f = f[None]
+    b, h, w, c = f.shape
+    H, W = h * factor, w * factor
+    ys = (np.linspace(0.0, h - 1.0, H, dtype=np.float32) if h > 1
+          else np.zeros(H, np.float32))
+    xs = (np.linspace(0.0, w - 1.0, W, dtype=np.float32) if w > 1
+          else np.zeros(W, np.float32))
+    y0 = np.floor(ys).astype(np.intp)
+    x0 = np.floor(xs).astype(np.intp)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    # float32 - intp promotes to float64; keep the weights (and so the
+    # response) in float32.
+    wy = (ys - y0).astype(np.float32)[None, :, None, None]
+    wx = (xs - x0).astype(np.float32)[None, None, :, None]
+    rows = f[:, y0] * (1.0 - wy) + f[:, y1] * wy          # (b, H, w, c)
+    out = rows[:, :, x0] * (1.0 - wx) + rows[:, :, x1] * wx
+    out = np.float32(factor) * out
+    if squeeze:
+        out = out[0]
+    if padder is not None:
+        out = padder.unpad(out)
+    return np.ascontiguousarray(out)
+
+
+class _StagingArena:
+    """Per-(shape, dtype) pool of preallocated host staging buffers —
+    the zero-copy replacement for per-batch pad-then-stack allocation.
+
+    The dispatch thread ``acquire``s one buffer per stacked input,
+    writes each request's frame ONCE directly into its batch slot (a
+    single memcpy per request; no intermediate padded array, no
+    ``np.stack`` allocation per batch), and the buffer rides the
+    in-flight tuple until the completion thread has synced the batch's
+    outputs — only then is it ``release``d back to the pool, so
+    recycling can never overwrite bytes an executable might still read
+    (donation-compatible: donation consumes the *device* copy, never
+    the host buffer). Every slot — tail-pad included — is rewritten on
+    each acquire-fill cycle, so stale bytes from the previous batch
+    can't leak. Buffers from failed batches are dropped, not pooled
+    (the rare path keeps no aliasing questions open).
+    """
+
+    # Per-key cap: pipeline_depth batches in flight + one being staged
+    # covers steady state; beyond that, fall back to allocation rather
+    # than hold unbounded idle buffers.
+    _MAX_PER_KEY = 4
+
+    def __init__(self):
+        self._pools: Dict[Tuple, List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, shape: Tuple, dtype) -> np.ndarray:
+        key = (tuple(int(s) for s in shape), np.dtype(dtype).str)
+        with self._lock:
+            pool = self._pools.get(key)
+            if pool:
+                return pool.pop()
+        return np.empty(key[0], dtype)
+
+    def release(self, *buffers) -> None:
+        for b in buffers:
+            if b is None:
+                continue
+            key = (b.shape, b.dtype.str)
+            with self._lock:
+                pool = self._pools.setdefault(key, [])
+                if len(pool) < self._MAX_PER_KEY:
+                    pool.append(b)
+
+    def pooled_buffers(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._pools.values())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -348,20 +519,35 @@ class _BucketStream:
             item = self.inflight.get()
             if item is None:
                 break
-            batch, out = item
+            batch, out, staged = item
             is_stream = bool(batch) and batch[0].session is not None
+            # The return-path half of the wire-format work: sync (D2H)
+            # only the outputs some batch member actually needs.
+            # flow_up is skipped when the whole batch opted into
+            # low_res responses — 64x fewer D2H bytes per all-low
+            # batch; flow_low is skipped unless a member wants it
+            # (streams always need it for the warm-start handoff).
+            want_full = is_stream or any(not r.low_res for r in batch)
+            want_low = is_stream or any(r.low_res for r in batch)
             try:
                 with eng.stages.stage("sync"):
-                    flow_up = np.asarray(out[1])   # blocks until done
+                    flow_up = np.asarray(out[1]) if want_full else None
+                    flow_low = np.asarray(out[0]) if want_low else None
                     if is_stream:
-                        flow_low = np.asarray(out[0])
                         fmap2 = np.asarray(out[2])
+                    if flow_up is not None:
+                        eng.stages.add_bytes("sync", flow_up.nbytes)
+                    if flow_low is not None:
+                        eng.stages.add_bytes("sync", flow_low.nbytes)
             except Exception as e:
                 with eng._state_lock:
                     eng._inflight_batches -= 1
                 eng.breaker.record_failure()
                 eng._isolate_failed_batch(batch, e)
                 continue
+            # Outputs are host-side: the executable is done with its
+            # inputs, so the staging buffers can be recycled.
+            eng.arena.release(*staged)
             with eng._state_lock:
                 eng._inflight_batches -= 1
             eng.breaker.record_success()
@@ -376,6 +562,7 @@ class _BucketStream:
                 if saved:
                     eng.metrics.record_early_exit_saved(saved)
             eng.metrics.record_quality(served_iters, n=len(batch))
+            returned = 0
             with eng.stages.stage("unpad"):
                 for j, r in enumerate(batch):
                     if is_stream:
@@ -386,8 +573,14 @@ class _BucketStream:
                         # the future, so it always sees restored state.
                         r.session._complete(fmap2[j:j + 1].copy(),
                                             flow_low[j].copy())
-                    r.future.set_result(r.padder.unpad(flow_up[j]))
+                    if r.low_res:
+                        result = flow_low[j].copy()
+                    else:
+                        result = r.padder.unpad(flow_up[j])
+                    returned += result.nbytes
+                    r.future.set_result(result)
                     eng.metrics.record_done(now - r.t_submit)
+            eng.metrics.record_returned_bytes(returned)
 
 
 class ServingEngine:
@@ -463,6 +656,9 @@ class ServingEngine:
                 dwell_s=self.config.brownout_dwell_ms / 1e3)
         self.metrics = ServingMetrics()
         self.stages = HostStageTimer()
+        # Preallocated host staging buffers, recycled batch-to-batch by
+        # the completion threads (see _StagingArena).
+        self.arena = _StagingArena()
         self.breaker = CircuitBreaker(
             threshold=self.config.breaker_threshold,
             cooldown_s=self.config.breaker_cooldown_s)
@@ -544,19 +740,26 @@ class ServingEngine:
         # warm level of a warm bucket) is pre-compiled by warmup, so
         # their streams are dedicated too — stepping the brownout
         # ladder must never retire/recreate a stream mid-overload.
+        # Each entry exists once per wire dtype (the tag is the LAST
+        # bucket-key element): warmup compiles both, so uint8 and
+        # float32 traffic on a configured bucket are equally permanent.
         self._dedicated_buckets = (
-            self._stateless_padded
-            | frozenset((*p, kind) for p in self._warm_padded
-                        for kind in ("warm", "cold"))
-            | frozenset((*p, lvl) for p in self._stateless_padded
-                        for lvl in ladder)
-            | frozenset((*p, "warm", eff) for p in self._warm_padded
-                        for eff in self._warm_effs)
+            frozenset((*p, wt) for p in self._stateless_padded
+                      for wt in _WIRE_TAGS)
+            | frozenset((*p, kind, wt) for p in self._warm_padded
+                        for kind in ("warm", "cold")
+                        for wt in _WIRE_TAGS)
+            | frozenset((*p, lvl, wt) for p in self._stateless_padded
+                        for lvl in ladder for wt in _WIRE_TAGS)
+            | frozenset((*p, "warm", eff, wt) for p in self._warm_padded
+                        for eff in self._warm_effs
+                        for wt in _WIRE_TAGS)
             # Sharded buckets keep their own permanent streams: the
             # whole point is big-shard dispatch overlapping the
             # small-batch streams, so they must never be LRU-retired
             # under mixed traffic.
-            | frozenset((*p, "mesh") for p in self._sharded_padded))
+            | frozenset((*p, "mesh", wt) for p in self._sharded_padded
+                        for wt in _WIRE_TAGS))
         self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
@@ -635,14 +838,23 @@ class ServingEngine:
                 ph, pw = padder.padded_shape
                 # Two distinct host arrays: with donation on, aliasing
                 # one device buffer into both donated args would be
-                # rejected.
+                # rejected. Each bucket warms BOTH wire dtypes (uint8
+                # requests batch against their own executable — see
+                # wire_cast), recorded under the one existing stats
+                # key, so mixed uint8/float32 traffic stays at zero
+                # post-warmup compiles.
                 z1 = np.zeros((self.config.max_batch, ph, pw, 3),
                               np.float32)
                 z2 = np.zeros_like(z1)
+                u1 = np.zeros((self.config.max_batch, ph, pw, 3),
+                              np.uint8)
+                u2 = np.zeros_like(u1)
                 t0 = time.perf_counter()
                 with CompileWatch() as w:
                     out = self.predictor.dispatch_batch(z1, z2)
                     np.asarray(out[1])        # sync: compile + one run
+                    out = self.predictor.dispatch_batch(u1, u2)
+                    np.asarray(out[1])
                 stats[(ph, pw)] = {"compiles": float(w.compiles),
                                    "seconds": time.perf_counter() - t0}
                 for lvl in self._iters_ladder:
@@ -653,6 +865,9 @@ class ServingEngine:
                     with CompileWatch() as w:
                         out = self.predictor.dispatch_batch(
                             z1, z2, iters=lvl)
+                        np.asarray(out[1])
+                        out = self.predictor.dispatch_batch(
+                            u1, u2, iters=lvl)
                         np.asarray(out[1])
                     stats[(ph, pw, lvl)] = {
                         "compiles": float(w.compiles),
@@ -675,11 +890,22 @@ class ServingEngine:
                 z1 = np.zeros((self.config.sharded_max_batch, ph, pw, 3),
                               np.float32)
                 z2 = np.zeros_like(z1)
+                u1 = np.zeros_like(z1, dtype=np.uint8)
+                u2 = np.zeros_like(u1)
                 t0 = time.perf_counter()
                 with CompileWatch() as w:
+                    # Sync BOTH outputs: a low_res response on an
+                    # extra-padded sharded shape materializes the lazy
+                    # flow_low crop, which compiles its own tiny slice
+                    # executable — warm it here, not under load.
                     out = self.predictor.sharded_dispatch(
                         z1, z2, mesh=self._sharded_mesh)
                     np.asarray(out[1])
+                    np.asarray(out[0])
+                    out = self.predictor.sharded_dispatch(
+                        u1, u2, mesh=self._sharded_mesh)
+                    np.asarray(out[1])
+                    np.asarray(out[0])
                 stats[(ph, pw, "mesh")] = {
                     "compiles": float(w.compiles),
                     "seconds": time.perf_counter() - t0}
@@ -697,29 +923,33 @@ class ServingEngine:
         mb = self.config.max_batch
         t0 = time.perf_counter()
         with CompileWatch() as w:
-            z = np.zeros((mb, ph, pw, 3), np.float32)
-            fm = np.asarray(self.predictor.encode_dispatch(z))
-            # Distinct host copies per donated arg (fmap1 is donated,
-            # fmap2 never — it's the cache handoff the completion
-            # thread syncs).
-            out = self.predictor.refine_dispatch(
-                np.zeros_like(z), fm.copy(), fm)
-            np.asarray(out[1])
             # flow_init lives at the model's stride-8 feature
             # resolution (independent of the pad factor)
             init = np.zeros((mb, ph // 8, pw // 8, 2), np.float32)
-            out = self.predictor.refine_dispatch(
-                np.zeros_like(z), fm.copy(), fm, flow_init=init,
-                warm=True)
-            np.asarray(out[1])
-            for eff in self._warm_effs:
-                # Browned-out warm levels (min(warm_iters, ladder
-                # level), dedup'd) — warm pairs step the ladder at
-                # zero compiles too.
+            # Both wire dtypes: a stream whose frames arrive uint8 runs
+            # the uint8 encode/refine executables end to end (fmaps are
+            # float32 model outputs either way).
+            for dt in (np.float32, np.uint8):
+                z = np.zeros((mb, ph, pw, 3), dt)
+                fm = np.asarray(self.predictor.encode_dispatch(z))
+                # Distinct host copies per donated arg (fmap1 is
+                # donated, fmap2 never — it's the cache handoff the
+                # completion thread syncs).
+                out = self.predictor.refine_dispatch(
+                    np.zeros_like(z), fm.copy(), fm)
+                np.asarray(out[1])
                 out = self.predictor.refine_dispatch(
                     np.zeros_like(z), fm.copy(), fm, flow_init=init,
-                    warm=True, iters=eff)
+                    warm=True)
                 np.asarray(out[1])
+                for eff in self._warm_effs:
+                    # Browned-out warm levels (min(warm_iters, ladder
+                    # level), dedup'd) — warm pairs step the ladder at
+                    # zero compiles too.
+                    out = self.predictor.refine_dispatch(
+                        np.zeros_like(z), fm.copy(), fm, flow_init=init,
+                        warm=True, iters=eff)
+                    np.asarray(out[1])
         return {(ph, pw, "session"): {
             "compiles": float(w.compiles),
             "seconds": time.perf_counter() - t0}}
@@ -864,7 +1094,9 @@ class ServingEngine:
     def _bucket_max(self, bucket) -> int:
         """Per-bucket dispatch size (the batcher's ``max_batch_for``):
         sharded buckets run at ``sharded_max_batch``, everything else
-        at the global ``max_batch``."""
+        at the global ``max_batch``. (Wire-dtype tags don't change the
+        dispatch size — strip before matching.)"""
+        bucket = _base_of(bucket)
         if len(bucket) == 3 and bucket[2] == "mesh":
             return self.config.sharded_max_batch
         return self.config.max_batch
@@ -900,11 +1132,17 @@ class ServingEngine:
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                priority: str = PRIORITY_HIGH,
-               iters: Optional[int] = None):
+               iters: Optional[int] = None,
+               low_res: bool = False):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
-        ``image1``/``image2``: (H, W, 3) float arrays in [0, 255], any
-        resolution (padded here, in the caller's thread).
+        ``image1``/``image2``: (H, W, 3) arrays in [0, 255], any
+        resolution (padded here, in the caller's thread). uint8 input —
+        or float/int input whose values are integral and in range,
+        auto-detected here once — serves over the uint8 wire format:
+        staged, stacked and H2D-transferred at 1 byte/channel (4x fewer
+        host-path bytes) with bit-identical flow (normalization happens
+        in-model; see ``wire_cast``).
         ``priority``: ``"high"`` (default — batches first) or ``"low"``
         (background class: batched after HIGH, first shed under a full
         backlog). ``iters``: explicit GRU iteration count — must be the
@@ -913,7 +1151,13 @@ class ServingEngine:
         an unwarmed count would silently compile under load). ``None``
         (default) serves full quality, except LOW requests on
         configured buckets while the brownout controller holds a
-        degraded level. Thread-safe.
+        degraded level. ``low_res=True`` resolves the future to the
+        1/8-scale flow on the PADDED grid instead — ``(ph/8, pw/8, 2)``
+        float32, 64x fewer D2H/response bytes; the request's padder is
+        stamped on the future (``future.padder``) so callers can
+        recover full resolution host-side via :func:`upsample_flow`
+        (documented as NOT bit-equal to the in-graph convex
+        upsampling). Thread-safe.
         """
         if iters is not None:
             iters = int(iters)
@@ -940,8 +1184,9 @@ class ServingEngine:
                     "executables) — sharded requests always serve full "
                     "quality")
             return self._submit_sharded(image1, image2, priority,
-                                        sharded_bucket)
+                                        sharded_bucket, low_res=low_res)
         with self.stages.stage("pad"):
+            wire, image1, image2 = request_wire(image1, image2)
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self.config.factor)
             im1, im2 = padder.pad(image1, image2)
@@ -962,8 +1207,8 @@ class ServingEngine:
             lvl = self.brownout.level
             if lvl:
                 bucket_iters = self._iters_ladder[lvl - 1]
-        bucket = (padded if bucket_iters is None
-                  else (*padded, bucket_iters))
+        bucket = ((*padded, wire) if bucket_iters is None
+                  else (*padded, bucket_iters, wire))
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
@@ -975,17 +1220,25 @@ class ServingEngine:
                             priority=priority,
                             poisoned=active_injector()
                             .poisons_request(seq),
-                            degradable=degradable)
+                            degradable=degradable,
+                            low_res=low_res)
+        if low_res:
+            # Pad geometry for host-side upsample_flow recovery.
+            req.future.padder = padder
         return self._enqueue_request(req)
 
     def _submit_sharded(self, image1, image2, priority,
-                        bucket) -> "Future":
-        """Enqueue one request onto its ``(ph, pw, "mesh")`` sharded
-        bucket: padded at the sharded factor (rows always divide the
-        spatial axis), never brownout-degradable (the sharded path
-        serves full quality only), dispatched through the bucket's own
-        permanent stream at ``sharded_max_batch``."""
+                        bucket, low_res: bool = False) -> "Future":
+        """Enqueue one request onto its ``(ph, pw, "mesh", wire)``
+        sharded bucket: padded at the sharded factor (rows always
+        divide the spatial axis), never brownout-degradable (the
+        sharded path serves full quality only), dispatched through the
+        bucket's own permanent stream at ``sharded_max_batch``.
+        ``bucket`` arrives wire-untagged from :meth:`sharded_route`
+        (the fleet shares that routing and stays dtype-agnostic); the
+        tag is appended here."""
         with self.stages.stage("pad"):
+            wire, image1, image2 = request_wire(image1, image2)
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self._sharded_factor)
             im1, im2 = padder.pad(image1, image2)
@@ -995,12 +1248,15 @@ class ServingEngine:
         with self._state_lock:
             self._submit_seq += 1
             seq = self._submit_seq
-        req = QueuedRequest(im1, im2, padder, bucket=bucket,
+        req = QueuedRequest(im1, im2, padder, bucket=(*bucket, wire),
                             t_submit=t_submit, deadline=deadline,
                             priority=priority,
                             poisoned=active_injector()
                             .poisons_request(seq),
-                            degradable=False)
+                            degradable=False,
+                            low_res=low_res)
+        if low_res:
+            req.future.padder = padder
         self.metrics.record_sharded()
         return self._enqueue_request(req)
 
@@ -1109,7 +1365,19 @@ class ServingEngine:
         self._check_accepting()
         warm = flow_init is not None
         padded = padder.padded_shape
-        bucket = (*padded, "warm" if warm else "cold")
+        # The pair's wire dtype: frames were wire-cast per frame by
+        # StreamSession.submit (the O(N) check runs once per frame,
+        # not once per pair), so only the dtype pairing is decided
+        # here — uint8 when BOTH padded frames are uint8; a mixed
+        # u8/f32 consecutive pair widens to float32 exactly, so the
+        # executable always sees one dtype.
+        if image1.dtype == np.uint8 and image2.dtype == np.uint8:
+            wire = WIRE_U8
+        else:
+            wire = WIRE_F32
+            image1 = np.asarray(image1, np.float32)
+            image2 = np.asarray(image2, np.float32)
+        bucket = (*padded, "warm" if warm else "cold", wire)
         degradable = False
         if (warm and priority == PRIORITY_LOW
                 and self.brownout is not None
@@ -1120,7 +1388,7 @@ class ServingEngine:
                 eff = min(self._base_warm_iters,
                           self._iters_ladder[lvl - 1])
                 if eff != self._base_warm_iters:
-                    bucket = (*padded, "warm", eff)
+                    bucket = (*padded, "warm", eff, wire)
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
@@ -1243,18 +1511,22 @@ class ServingEngine:
             return None
         lvl = self.brownout.level
         base = req.bucket[:2]
+        wire = _wire_of(req.bucket)   # quality steps keep the wire dtype
         if req.session is not None:          # warm stream pair
             eff = (self._base_warm_iters if lvl == 0
                    else min(self._base_warm_iters,
                             self._iters_ladder[lvl - 1]))
-            return ((*base, "warm") if eff == self._base_warm_iters
-                    else (*base, "warm", eff))
-        return (base if lvl == 0
-                else (*base, self._iters_ladder[lvl - 1]))
+            return ((*base, "warm", wire)
+                    if eff == self._base_warm_iters
+                    else (*base, "warm", eff, wire))
+        return ((*base, wire) if lvl == 0
+                else (*base, self._iters_ladder[lvl - 1], wire))
 
     def _bucket_iters(self, bucket: Tuple) -> int:
         """GRU iteration count the executable serving ``bucket`` runs —
-        the served-quality level the metrics histogram records."""
+        the served-quality level the metrics histogram records. The
+        wire tag is quality-neutral: strip it before matching."""
+        bucket = _base_of(bucket)
         if len(bucket) == 4:                          # (ph, pw, "warm", eff)
             return int(bucket[3])
         if len(bucket) == 3:
@@ -1267,17 +1539,28 @@ class ServingEngine:
     def _stack(self, batch: List[QueuedRequest]):
         n = len(batch)
         cap = self._bucket_max(batch[0].bucket)
-        with self.stages.stage("stack"):
-            i1 = np.stack([r.image1 for r in batch])
-            i2 = np.stack([r.image2 for r in batch])
+        r0 = batch[0]
+        shape = (cap, *r0.image1.shape)
+        # Staging arena: preallocated per-(shape, dtype) host buffers —
+        # each request's frames are written ONCE directly into their
+        # batch slot (single memcpy; the old np.stack + np.concatenate
+        # pad-then-stack allocated and copied every batch). Recycled by
+        # the completion thread after the batch's outputs sync. In the
+        # uint8 wire format the buffer itself is 4x smaller.
+        i1 = self.arena.acquire(shape, r0.image1.dtype)
+        i2 = self.arena.acquire(shape, r0.image1.dtype)
+        with self.stages.stage("stack", nbytes=i1.nbytes + i2.nbytes):
+            for j, r in enumerate(batch):
+                i1[j] = r.image1
+                i2[j] = r.image2
             if n < cap:
-                reps = cap - n
                 # Tail-pad by repeating the last request — same rule as
                 # batched eval; one executable per bucket (at the
                 # bucket's own dispatch size — sharded buckets run at
                 # sharded_max_batch), never one per partial size.
-                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
-                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
+                i1[n:] = i1[n - 1]
+                i2[n:] = i2[n - 1]
+        self.metrics.record_staged_bytes(i1.nbytes + i2.nbytes)
         return i1, i2
 
     def _dispatch_arrays(self, batch: List[QueuedRequest], i1, i2):
@@ -1295,7 +1578,7 @@ class ServingEngine:
         inj.maybe_fail_serving_dispatch()
         with self._swap_lock:
             predictor = self.predictor
-        bucket = batch[0].bucket
+        bucket = _base_of(batch[0].bucket)
         if len(bucket) == 3 and bucket[2] == "mesh":
             # Spatially-sharded bucket: rows over the serving mesh's
             # spatial axis through the predictor's ("sharded", ...)
@@ -1314,29 +1597,40 @@ class ServingEngine:
         """Stack and dispatch one stream (session) batch: ONE encoder
         pass over the new frames, cached fmap1s re-fed from the
         sessions' host caches, then the warm or cold refine executable.
-        Returns device ``(flow_low, flow_up, fmap2)`` — fmap2 rides
+        Returns ``((flow_low, flow_up, fmap2), staged)`` — fmap2 rides
         along so the completion thread can hand each slice back to its
-        session as the next pair's fmap1. Same fault-injection and
-        swap-lock contract as ``_dispatch_arrays``; numpy-only host
-        prep (eager ``jnp`` stacking would compile tiny executables and
-        break the zero-compile contract)."""
+        session as the next pair's fmap1, and ``staged`` is the tuple
+        of arena buffers to release once the outputs sync. Same
+        fault-injection and swap-lock contract as ``_dispatch_arrays``;
+        numpy-only host prep (eager ``jnp`` stacking would compile tiny
+        executables and break the zero-compile contract)."""
         n = len(batch)
         mb = self.config.max_batch
         warm = batch[0].flow_init is not None
-        with self.stages.stage("stack"):
-            i1 = np.stack([r.image1 for r in batch])
-            i2 = np.stack([r.image2 for r in batch])
-            fm1 = np.concatenate([r.fmap1 for r in batch])
-            finit = (np.stack([r.flow_init for r in batch])
-                     if warm else None)
-            if n < mb:
-                reps = mb - n
-                i1 = np.concatenate([i1, np.repeat(i1[-1:], reps, 0)])
-                i2 = np.concatenate([i2, np.repeat(i2[-1:], reps, 0)])
-                fm1 = np.concatenate([fm1, np.repeat(fm1[-1:], reps, 0)])
+        r0 = batch[0]
+        i1 = self.arena.acquire((mb, *r0.image1.shape), r0.image1.dtype)
+        i2 = self.arena.acquire((mb, *r0.image1.shape), r0.image1.dtype)
+        fm1 = self.arena.acquire((mb, *r0.fmap1.shape[1:]),
+                                 r0.fmap1.dtype)
+        finit = (self.arena.acquire((mb, *r0.flow_init.shape),
+                                    r0.flow_init.dtype)
+                 if warm else None)
+        staged = (i1, i2, fm1, finit)
+        nbytes = sum(b.nbytes for b in staged if b is not None)
+        with self.stages.stage("stack", nbytes=nbytes):
+            for j, r in enumerate(batch):
+                i1[j] = r.image1
+                i2[j] = r.image2
+                fm1[j] = r.fmap1[0]
                 if warm:
-                    finit = np.concatenate(
-                        [finit, np.repeat(finit[-1:], reps, 0)])
+                    finit[j] = r.flow_init
+            if n < mb:
+                i1[n:] = i1[n - 1]
+                i2[n:] = i2[n - 1]
+                fm1[n:] = fm1[n - 1]
+                if warm:
+                    finit[n:] = finit[n - 1]
+        self.metrics.record_staged_bytes(nbytes)
         inj = active_injector()
         if any(r.poisoned for r in batch):
             raise RuntimeError(
@@ -1345,13 +1639,13 @@ class ServingEngine:
         with self._swap_lock:
             predictor = self.predictor
         fmap2 = predictor.encode_dispatch(i2)
-        bucket = batch[0].bucket
+        bucket = _base_of(batch[0].bucket)
         # (ph, pw, "warm", eff): browned-out warm pairs refine at the
         # capped ladder level instead of the base warm count.
         iters = bucket[3] if len(bucket) == 4 else None
         flow_low, flow_up = predictor.refine_dispatch(
             i1, fm1, fmap2, flow_init=finit, warm=warm, iters=iters)
-        return flow_low, flow_up, fmap2
+        return (flow_low, flow_up, fmap2), staged
 
     def _dispatch_one(self, batch: List[QueuedRequest],
                       inflight: queue.Queue) -> None:
@@ -1389,10 +1683,11 @@ class ServingEngine:
                 # computes while this thread loops back to stack the
                 # next batch.
                 if batch[0].session is not None:
-                    out = self._dispatch_stream_arrays(batch)
+                    out, staged = self._dispatch_stream_arrays(batch)
                 else:
                     i1, i2 = self._stack(batch)
                     out = self._dispatch_arrays(batch, i1, i2)
+                    staged = (i1, i2)
         except Exception as e:
             self.breaker.record_failure()
             self._isolate_failed_batch(batch, e)
@@ -1402,9 +1697,11 @@ class ServingEngine:
         # Bounded per-bucket queue: blocks when pipeline_depth batches
         # of THIS bucket are already in flight — backpressure instead
         # of unbounded device queueing, without stalling other buckets.
+        # The staging buffers ride along; the completion thread
+        # releases them only after the outputs sync.
         with self._state_lock:
             self._inflight_batches += 1
-        inflight.put((batch, out))
+        inflight.put((batch, out, staged))
 
     def _isolate_failed_batch(self, batch: List[QueuedRequest],
                               cause: BaseException) -> None:
@@ -1424,7 +1721,7 @@ class ServingEngine:
             is_stream = r.session is not None
             try:
                 if is_stream:
-                    out = self._dispatch_stream_arrays([r])
+                    out, staged = self._dispatch_stream_arrays([r])
                     with self.stages.stage("sync"):
                         flow_up = np.asarray(out[1])
                         flow_low = np.asarray(out[0])
@@ -1432,16 +1729,21 @@ class ServingEngine:
                 else:
                     i1, i2 = self._stack([r])
                     out = self._dispatch_arrays([r], i1, i2)
+                    staged = (i1, i2)
                     with self.stages.stage("sync"):
                         flow_up = np.asarray(out[1])
+                        flow_low = (np.asarray(out[0]) if r.low_res
+                                    else None)
             except Exception as e:
                 # A failed stream pair drops its session state: the
                 # fmap/flow handoff was consumed at submit, so the next
                 # submit on that session re-primes and restarts cold.
+                # (Its staging buffers are dropped, not pooled.)
                 r.future.set_exception(e)
                 self.metrics.record_error(1)
                 self.breaker.record_failure()
                 continue
+            self.arena.release(*staged)
             if is_stream:
                 r.session._complete(fmap2[:1].copy(), flow_low[0].copy())
             served_iters = self._bucket_iters(r.bucket)
@@ -1450,7 +1752,10 @@ class ServingEngine:
                 if saved:
                     self.metrics.record_early_exit_saved(saved)
             self.metrics.record_quality(served_iters)
-            r.future.set_result(r.padder.unpad(flow_up[0]))
+            result = (flow_low[0].copy() if r.low_res
+                      else r.padder.unpad(flow_up[0]))
+            self.metrics.record_returned_bytes(result.nbytes)
+            r.future.set_result(result)
             self.metrics.record_done(time.monotonic() - r.t_submit)
             self.metrics.record_isolated_retry()
             self.breaker.record_success()

@@ -573,7 +573,8 @@ class ServingFleet:
 
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                priority: str = PRIORITY_HIGH,
-               iters: Optional[int] = None):
+               iters: Optional[int] = None,
+               low_res: bool = False):
         """Route one request to its bucket's healthiest owner; returns
         a future resolving to the unpadded ``(H, W, 2)`` flow,
         bit-identical to any single replica's answer (replicas are
@@ -581,9 +582,13 @@ class ServingFleet:
         full count or an ``iters_ladder`` rung) extends the routed
         bucket to ``(h, w, iters)``, so each degraded level rendezvous-
         pins to its own replica with a bit-stable digest; the serving
-        engine still validates the level. Transparent failover on both
-        refusal and post-acceptance failure; ``future.replica_id`` is
-        stamped when the future resolves. Thread-safe."""
+        engine still validates the level. ``low_res`` passes through to
+        the serving engine: the future resolves to the padded 1/8-grid
+        flow instead of the unpadded full-res one (routing is
+        unaffected — the wire/response format is per-request, not
+        per-bucket). Transparent failover on both refusal and
+        post-acceptance failure; ``future.replica_id`` is stamped when
+        the future resolves. Thread-safe."""
         if self._closed:
             raise RuntimeError("fleet is closed")
         outer: concurrent.futures.Future = concurrent.futures.Future()
@@ -601,7 +606,8 @@ class ServingFleet:
             if sharded is not None:
                 bucket = sharded
         self._dispatch(outer, image1, image2, priority, bucket,
-                       tried=set(), hops=0, last_exc=None)
+                       tried=set(), hops=0, last_exc=None,
+                       low_res=low_res)
         return outer
 
     def predict(self, image1: np.ndarray, image2: np.ndarray,
@@ -624,7 +630,8 @@ class ServingFleet:
         return FleetStreamSession(self, stream_id)
 
     def _dispatch(self, outer, image1, image2, priority, bucket: Bucket,
-                  tried: set, hops: int, last_exc) -> None:
+                  tried: set, hops: int, last_exc,
+                  low_res: bool = False) -> None:
         """Walk the bucket's owner-preference chain and hand the
         request to the first routable replica not yet tried. Called
         once at submit and re-entered (from a replica's completion
@@ -654,7 +661,7 @@ class ServingFleet:
                 iters = (bucket[2] if len(bucket) > 2
                          and isinstance(bucket[2], int) else None)
                 inner = engine.submit(image1, image2, priority=priority,
-                                      iters=iters)
+                                      iters=iters, low_res=low_res)
             except Exception as e:
                 # Refused at the door (breaker fast-fail, backlog full,
                 # closed): try the next owner.
@@ -666,7 +673,7 @@ class ServingFleet:
             inner.add_done_callback(
                 lambda f, rid=rid: self._on_reply(
                     outer, f, rid, image1, image2, priority, bucket,
-                    tried, hops))
+                    tried, hops, low_res))
             return
         self.metrics.record_shed()
         if last_exc is None and is_mesh:
@@ -680,7 +687,8 @@ class ServingFleet:
             f"(replicas: {', '.join(self._engines)})"))
 
     def _on_reply(self, outer, inner, rid: str, image1, image2,
-                  priority, bucket: Bucket, tried: set, hops: int) -> None:
+                  priority, bucket: Bucket, tried: set, hops: int,
+                  low_res: bool = False) -> None:
         exc = inner.exception()
         if exc is None:
             outer.replica_id = getattr(inner, "replica_id", rid)
@@ -697,7 +705,8 @@ class ServingFleet:
         self.metrics.record_retry(rid)
         try:
             self._dispatch(outer, image1, image2, priority, bucket,
-                           tried, hops + 1, last_exc=exc)
+                           tried, hops + 1, last_exc=exc,
+                           low_res=low_res)
         except Exception as e:   # never lose a future to a retry bug
             if not outer.done():
                 outer.replica_id = rid

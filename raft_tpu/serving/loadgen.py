@@ -46,17 +46,29 @@ from raft_tpu.utils.padder import InputPadder
 
 
 def make_frames(shapes: Sequence[Tuple[int, int]], per_shape: int = 2,
-                seed: int = 0) -> List[Tuple[np.ndarray, np.ndarray]]:
-    """Synthetic [0, 255] float32 frame pairs, ``per_shape`` distinct
-    pairs per raw (H, W) shape — enough variety that per-sample
-    correctness failures can't hide behind identical inputs."""
+                seed: int = 0, dtype=np.uint8
+                ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Synthetic [0, 255] frame pairs, ``per_shape`` distinct pairs per
+    raw (H, W) shape — enough variety that per-sample correctness
+    failures can't hide behind identical inputs. ``dtype=np.uint8``
+    (default) is what real decoded video traffic looks like and what
+    exercises the engine's uint8 wire path; pass ``np.float32`` for
+    NON-integral float pairs (the classic float wire). The two dtypes
+    draw different values — for same-values-both-dtypes comparisons
+    cast a uint8 pair with ``astype(np.float32)`` instead (integral
+    floats auto-detect back onto the uint8 wire, bit-identically)."""
     rng = np.random.default_rng(seed)
     frames = []
     for h, w in shapes:
         for _ in range(per_shape):
-            frames.append((
-                rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
-                rng.uniform(0, 255, (h, w, 3)).astype(np.float32)))
+            if np.dtype(dtype) == np.uint8:
+                frames.append((
+                    rng.integers(0, 256, (h, w, 3), dtype=np.uint8),
+                    rng.integers(0, 256, (h, w, 3), dtype=np.uint8)))
+            else:
+                frames.append((
+                    rng.uniform(0, 255, (h, w, 3)).astype(dtype),
+                    rng.uniform(0, 255, (h, w, 3)).astype(dtype)))
     return frames
 
 

@@ -160,6 +160,13 @@ class ServingMetrics:
         # summed over early-exit-enabled responses).
         self.quality_hist: Counter = Counter()
         self.early_exit_iters_saved = 0
+        # wire-format byte accounting: staged_bytes is what the host
+        # actually memcpy'd into the staging arena per dispatched batch
+        # (uint8 wire → 4x less than float32), returned_bytes is what
+        # the completion thread handed back to clients (low_res → 64x
+        # less). staged_bytes / requests is the bench.py --wire headline.
+        self.staged_bytes = 0
+        self.returned_bytes = 0
         # name -> zero-arg callable; the engine wires live gauges
         # (queue depth, in-flight batches, health code, breaker trips)
         # so snapshot() reads the instantaneous value.
@@ -272,6 +279,21 @@ class ServingMetrics:
         with self._lock:
             self.early_exit_iters_saved += int(iters_saved)
 
+    def record_staged_bytes(self, n: int) -> None:
+        """Bytes the host copied into the staging arena for one
+        dispatched batch (both input planes, tail-padding included —
+        the real memcpy traffic, so the uint8 wire's 4x shows up
+        here, not in a back-of-envelope)."""
+        with self._lock:
+            self.staged_bytes += int(n)
+
+    def record_returned_bytes(self, n: int) -> None:
+        """Bytes handed back to clients through resolved futures
+        (post-unpad full-res flow, or the 1/8-grid ``low_res``
+        response)."""
+        with self._lock:
+            self.returned_bytes += int(n)
+
     def record_batch(self, size: int, padded_to: int,
                      compiles: int = 0) -> None:
         with self._lock:
@@ -372,6 +394,8 @@ class ServingMetrics:
                     else 0.0),
                 "serving_early_exit_iters_saved": float(
                     self.early_exit_iters_saved),
+                "serving_staged_bytes": float(self.staged_bytes),
+                "serving_returned_bytes": float(self.returned_bytes),
             }
             for iters, n in self.quality_hist.items():
                 out[f"serving_quality_iters_{iters}"] = float(n)
@@ -428,4 +452,6 @@ class ServingMetrics:
                 f"queue peak {self.queue_depth_peak} | swaps "
                 f"{self.swaps}, rollbacks {self.rollbacks}, isolated "
                 f"retries {self.isolated_retries}, breaker fastfails "
-                f"{self.breaker_fastfails}{quality}")
+                f"{self.breaker_fastfails} | staged "
+                f"{self.staged_bytes / 1e6:.2f} MB, returned "
+                f"{self.returned_bytes / 1e6:.2f} MB{quality}")

@@ -102,6 +102,13 @@ class StreamSession:
         with self._lock:
             self._pending = None
             frame = np.ascontiguousarray(frame)
+            # Wire-dtype detection happens once per frame at ingest (the
+            # same O(N) integral check stateless submits pay): uint8 (or
+            # integral float) frames stay uint8 through the padder, the
+            # session state, and the staging arena — the engine only
+            # does a cheap dtype pairing at _submit_stream time.
+            from raft_tpu.serving.engine import wire_cast
+            frame = wire_cast(frame)[1]
             if self.padder is None:
                 from raft_tpu.utils.padder import InputPadder
                 self.frame_shape = frame.shape

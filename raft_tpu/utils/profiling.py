@@ -57,11 +57,18 @@ class HostStageTimer:
     """Thread-safe accumulator of host-side wall time per named stage.
 
     ``with timer.stage("pad"): ...`` around each host-pipeline section;
-    :meth:`summary` returns ``{stage: {total_ms, count, mean_ms}}`` and
-    :meth:`report` a one-line table. Stages may be entered concurrently
-    from several threads (client threads pad while the dispatcher
-    stacks) — times are summed, so on overlapping threads the totals
-    measure *work*, not wall clock.
+    :meth:`summary` returns ``{stage: {total_ms, count, mean_ms,
+    total_bytes}}`` and :meth:`report` a one-line table. Stages may be
+    entered concurrently from several threads (client threads pad while
+    the dispatcher stacks) — times are summed, so on overlapping
+    threads the totals measure *work*, not wall clock.
+
+    Stages that move memory can also account bytes: pass ``nbytes`` to
+    :meth:`stage` when the amount is known up front (e.g. the staging
+    arena memcpy), or call :meth:`add_bytes` when it is only known
+    mid-stage (e.g. per-output device→host syncs). Byte totals turn the
+    stage table into a bandwidth story — "stack" time divided by
+    "stack" bytes is the host memcpy rate the wire format is cutting.
     """
 
     def __init__(self):
@@ -70,9 +77,10 @@ class HostStageTimer:
         self._lock = threading.Lock()
         self._total_s: Dict[str, float] = collections.defaultdict(float)
         self._count: Dict[str, int] = collections.defaultdict(int)
+        self._bytes: Dict[str, int] = collections.defaultdict(int)
 
     @contextlib.contextmanager
-    def stage(self, name: str):
+    def stage(self, name: str, nbytes: int = 0):
         t0 = time.perf_counter()
         try:
             yield
@@ -81,13 +89,22 @@ class HostStageTimer:
             with self._lock:
                 self._total_s[name] += dt
                 self._count[name] += 1
+                if nbytes:
+                    self._bytes[name] += int(nbytes)
+
+    def add_bytes(self, name: str, n: int) -> None:
+        """Attribute ``n`` bytes to ``name`` outside a ``stage()``
+        block (or when the amount is only known mid-stage)."""
+        with self._lock:
+            self._bytes[name] += int(n)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {
                 name: {"total_ms": tot * 1e3,
                        "count": float(self._count[name]),
-                       "mean_ms": tot * 1e3 / max(self._count[name], 1)}
+                       "mean_ms": tot * 1e3 / max(self._count[name], 1),
+                       "total_bytes": float(self._bytes[name])}
                 for name, tot in self._total_s.items()}
 
     def report(self) -> str:
@@ -95,7 +112,10 @@ class HostStageTimer:
                       key=lambda kv: -kv[1]["total_ms"])
         return " | ".join(
             f"{name}: {v['total_ms']:.1f}ms/{int(v['count'])} "
-            f"({v['mean_ms']:.2f}ms avg)" for name, v in rows) or "(empty)"
+            f"({v['mean_ms']:.2f}ms avg"
+            + (f", {v['total_bytes'] / 1e6:.2f}MB" if v["total_bytes"]
+               else "")
+            + ")" for name, v in rows) or "(empty)"
 
 
 class _Trace:

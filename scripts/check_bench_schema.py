@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Validate the committed BENCH_*.json artifacts' honesty contract.
+
+bench.py's discipline is that context travels WITH the artifact: one
+JSON object per capture, values never faked, and any number measured at
+a smoke operating point says so in the payload instead of impersonating
+an on-chip capture. This checker enforces the shape that every artifact
+committed so far actually has, so a future round can't silently commit
+a payload that drops the honesty keys:
+
+* **Wrapper records** (``BENCH_r01..r05`` style, written by the round
+  driver): ``{"cmd", "rc", "parsed", ...}``. ``parsed`` is either the
+  bench payload (validated like any payload) or ``null`` — allowed only
+  with a nonzero ``rc``, i.e. an honest record of a failed/timed-out
+  run, never a silently empty success.
+* **Payloads** (direct ``_emit`` output, or a wrapper's ``parsed``):
+  - error records carry ``metric`` + non-empty ``error`` and a null
+    ``value`` — a failure is recorded, not dressed up as a number;
+  - measurements carry ``metric``/``unit`` strings, a numeric
+    ``value``, and a ``platform`` string;
+  - measurements taken OFF-TPU (the smoke hosts) must carry at least
+    one smoke-honesty key — ``smoke_operating_point`` or
+    ``criterion_note`` — naming what the number does and does not
+    claim. TPU captures need no disclaimer; they ARE the claim.
+
+Run directly (``python scripts/check_bench_schema.py``, nonzero exit on
+any violation) or through the fast test ``tests/test_bench_schema.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import numbers
+import os
+import sys
+from typing import List
+
+SMOKE_HONESTY_KEYS = ("smoke_operating_point", "criterion_note")
+
+
+def check_payload(name: str, payload: dict) -> List[str]:
+    """Validate one bench payload dict; returns a list of violations
+    (empty = clean)."""
+    problems = []
+    if not isinstance(payload.get("metric"), str) or not payload["metric"]:
+        problems.append("missing/empty 'metric'")
+    if payload.get("error") is not None:
+        # Honest failure record: named error, no fabricated value.
+        if not isinstance(payload["error"], str) or not payload["error"]:
+            problems.append("'error' must be a non-empty string")
+        if payload.get("value") is not None:
+            problems.append("error record must not carry a 'value'")
+        return [f"{name}: {p}" for p in problems]
+    if not isinstance(payload.get("value"), numbers.Number):
+        problems.append(f"'value' must be a number, got "
+                        f"{payload.get('value')!r}")
+    if not isinstance(payload.get("unit"), str) or not payload["unit"]:
+        problems.append("missing/empty 'unit'")
+    platform = payload.get("platform")
+    if not isinstance(platform, str) or not platform:
+        problems.append("missing/empty 'platform'")
+    elif platform != "tpu" and not any(
+            isinstance(payload.get(k), (str, dict, bool))
+            and payload.get(k) for k in SMOKE_HONESTY_KEYS):
+        problems.append(
+            f"off-TPU measurement (platform={platform!r}) carries none "
+            f"of the smoke-honesty keys {SMOKE_HONESTY_KEYS}")
+    return [f"{name}: {p}" for p in problems]
+
+
+def check_file(path: str) -> List[str]:
+    """Validate one BENCH_*.json file (wrapper or direct payload)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable JSON ({e})"]
+    if not isinstance(doc, dict):
+        return [f"{name}: top level must be a JSON object"]
+    if "parsed" in doc and "rc" in doc:          # round-driver wrapper
+        if doc["parsed"] is None:
+            if doc.get("rc") in (0, "0"):
+                return [f"{name}: wrapper with rc=0 but parsed=null "
+                        "(a successful run must parse to a payload)"]
+            return []                            # honest failed run
+        if not isinstance(doc["parsed"], dict):
+            return [f"{name}: 'parsed' must be an object or null"]
+        return check_payload(f"{name}[parsed]", doc["parsed"])
+    return check_payload(name, doc)
+
+
+def main(root: str = ".", argv=None) -> int:
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    if not paths:
+        print(f"no BENCH_*.json under {os.path.abspath(root)}")
+        return 1
+    problems = []
+    for path in paths:
+        found = check_file(path)
+        problems.extend(found)
+        status = "FAIL" if found else "ok"
+        print(f"{status:4s} {os.path.basename(path)}")
+    for p in problems:
+        print(f"  VIOLATION: {p}")
+    print(f"{len(paths) - len(set(p.split(':')[0] for p in problems))}"
+          f"/{len(paths)} artifacts clean")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(main(repo))

@@ -52,6 +52,16 @@
    drops; with both mesh replicas dead they shed CLEANLY with an error
    naming the mesh (never wedging a stream) while the mesh-less
    replica keeps serving small traffic.
+9. wire (``--drill wire``) — the uint8 wire format under fire: proves
+   up front that uint8 and integral-float32 references are
+   bit-identical, then kills a replica of a 3-replica fleet under 50
+   concurrent clients submitting MIXED-dtype traffic (uint8, integral
+   float32, non-integral float32 — the first two share the u8 wire,
+   the last rides f32). Gate: zero dropped, zero bit-incorrect, zero
+   post-warmup compiles (dual-dtype warmup covers both wires on every
+   replica, spares included), plus a ``low_res=True`` response that
+   bit-matches the reference 1/8-grid flow and host-upsamples back to
+   the full frame shape.
 
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
@@ -1013,6 +1023,102 @@ def drill_highres(root):
         fleet.close()
 
 
+def drill_wire(root):
+    """Mixed uint8/float32 wire traffic against a 3-replica fleet with
+    a mid-load replica kill: zero dropped, zero bit-incorrect, zero
+    post-warmup compiles; uint8 and integral-float32 bit-identical;
+    low_res responses bit-match the reference 1/8 grid."""
+    import numpy as np
+
+    from raft_tpu.serving import (CompileWatch, ServingConfig, loadgen,
+                                  make_fleet, upsample_flow)
+    from raft_tpu.utils.padder import InputPadder
+
+    predictor = _make_predictor()
+    # Three traffic classes over the same shapes: uint8 (the u8 wire),
+    # the SAME values as float32 (integral -> auto-detected back onto
+    # the u8 wire), and fresh non-integral float32 (the f32 wire).
+    frames_u8 = loadgen.make_frames(SHAPES, per_shape=2, seed=71)
+    frames_f32i = [(a.astype(np.float32), b.astype(np.float32))
+                   for a, b in frames_u8]
+    frames_f32n = loadgen.make_frames(SHAPES, per_shape=1, seed=72,
+                                      dtype=np.float32)
+    refs_u8, ref_kind = _references(predictor, frames_u8, max_batch=4)
+    refs_f32i, _ = _references(predictor, frames_f32i, max_batch=4)
+    refs_f32n, _ = _references(predictor, frames_f32n, max_batch=4)
+    # The wire contract's foundation, proved before any serving runs:
+    # integral inputs produce bit-identical flow on either wire dtype.
+    for k, (ru, rf) in enumerate(zip(refs_u8, refs_f32i)):
+        assert np.array_equal(ru, rf), \
+            f"pair {k}: uint8 vs integral-float32 references differ"
+    print(f"  {len(refs_u8)} uint8 vs integral-float32 reference pairs "
+          f"bit-identical; reference = {ref_kind}")
+
+    mixed = frames_u8 + frames_f32i + frames_f32n
+    # Integral float32 pairs must serve the u8-wire answer — which the
+    # reference check above just proved equals their own.
+    refs = refs_u8 + refs_f32i + refs_f32n
+
+    n_replicas, concurrency, n_requests = 3, 50, 150
+    fleet = make_fleet(predictor, n_replicas, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=BUCKETS,
+        breaker_threshold=2, breaker_cooldown_s=120.0))
+    fleet.start(warm_spares=True)
+    victim = next(rid for rid, bs in fleet.assignments().items() if bs)
+    try:
+        out = {}
+
+        def load():
+            out.update(loadgen.run_load(
+                fleet, mixed, n_requests=n_requests,
+                concurrency=concurrency, references=refs, timeout=120.0))
+
+        def fleet_responses():
+            return sum(e.metrics.responses
+                       for e in fleet.engines.values())
+
+        with CompileWatch() as watch:
+            loader = threading.Thread(target=load, name="wire-load")
+            loader.start()
+            _await_metric(fleet_responses, 30, 120,
+                          "responses before kill")
+            fleet.kill_replica(victim)
+            loader.join(300)
+            assert not loader.is_alive(), "load generator wedged"
+        print(f"  kill {victim} under mixed-dtype load: "
+              f"{out['completed']}/{n_requests} responses at "
+              f"concurrency {concurrency}")
+        assert out["completed"] == n_requests, \
+            f"completed {out['completed']}/{n_requests}"
+        assert not out["dropped"], f"dropped: {out['dropped']}"
+        assert not out["mismatched"], \
+            f"bit-incorrect responses: {out['mismatched']}"
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh compile(s) under mixed wire traffic"
+        staged = sum(e.metrics.snapshot()["serving_staged_bytes"]
+                     for e in fleet.engines.values())
+        print(f"  0 dropped, 0 mismatched, 0 compiles; fleet staged "
+              f"{staged / 1e6:.2f} MB for {n_requests} mixed requests")
+        assert staged > 0, "staged-bytes accounting recorded nothing"
+
+        # low_res: the 1/8-grid response bit-matches the reference
+        # low-res flow and host-upsamples back to the frame shape.
+        im1, im2 = frames_u8[0]
+        padder = InputPadder(im1.shape, mode="sintel", factor=8)
+        p1, p2 = padder.pad(im1, im2)
+        ref_low, _ = predictor.predict_batch(
+            np.repeat(p1[None], 4, axis=0), np.repeat(p2[None], 4, axis=0))
+        lo = fleet.submit(im1, im2, low_res=True).result(60)
+        assert np.array_equal(lo, ref_low[0]), \
+            "low_res response does not bit-match the reference low flow"
+        up = upsample_flow(lo, padder=padder)
+        assert up.shape == (*im1.shape[:2], 2), up.shape
+        print(f"  low_res: {lo.shape} bit-exact, host-upsampled to "
+              f"{up.shape}")
+    finally:
+        fleet.close()
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1022,6 +1128,7 @@ DRILLS = [
     drill_brownout,
     drill_pallas_kernels,
     drill_highres,
+    drill_wire,
 ]
 
 

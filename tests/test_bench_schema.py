@@ -1,0 +1,42 @@
+"""Fast gate over the committed BENCH_*.json artifacts: every payload
+keeps the honesty contract (platform recorded; off-TPU measurements
+carry a smoke_operating_point/criterion_note; failures are recorded as
+errors, never dressed up as numbers). Pure JSON reading — no jax."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from check_bench_schema import check_file, check_payload, main  # noqa: E402
+
+
+def test_committed_artifacts_honor_schema(capsys):
+    assert main(REPO) == 0, capsys.readouterr().out
+
+
+def test_checker_rejects_missing_honesty_keys():
+    bad = {"metric": "m", "value": 1.0, "unit": "x", "platform": "cpu"}
+    assert check_payload("bad", bad)
+    ok = dict(bad, criterion_note="smoke point, not an on-chip claim")
+    assert not check_payload("ok", ok)
+    ok2 = dict(bad, platform="tpu")
+    assert not check_payload("ok2", ok2)
+
+
+def test_checker_rejects_fabricated_values():
+    assert check_payload("e", {"metric": "m", "error": "boom",
+                               "value": 3.0})
+    assert not check_payload("e", {"metric": "m", "error": "boom",
+                                   "value": None})
+    assert check_payload("v", {"metric": "m", "value": None,
+                               "unit": "x", "platform": "tpu"})
+
+
+def test_checker_rejects_silent_empty_wrapper(tmp_path):
+    p = tmp_path / "BENCH_rX.json"
+    p.write_text('{"cmd": "python bench.py", "rc": 0, "parsed": null}')
+    assert check_file(str(p))
+    p.write_text('{"cmd": "python bench.py", "rc": 124, "parsed": null}')
+    assert not check_file(str(p))

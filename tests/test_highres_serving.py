@@ -182,7 +182,8 @@ class TestShardedServingEngine:
                 futs = ([eng.submit(*p) for p in small * 3]
                         + [eng.submit(*p) for p in hi * 2])
                 flows = [f.result(120) for f in futs]
-            assert mesh_bucket in eng._streams, \
+            # Dispatch streams carry the wire tag (uint8 frames).
+            assert (*mesh_bucket, "u8") in eng._streams, \
                 sorted(map(str, eng._streams))
         finally:
             eng.close()

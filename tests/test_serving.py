@@ -1107,8 +1107,10 @@ class TestConcurrentDispatch:
         finally:
             eng.close()
         assert res["ok"], res
-        # One independent dispatch stream materialized per bucket.
-        assert set(eng._streams) == {(40, 64), (56, 80)}
+        # One independent dispatch stream materialized per bucket —
+        # keyed with the wire-dtype tag (make_frames is uint8 now, so
+        # only the u8-wire streams saw traffic).
+        assert set(eng._streams) == {(40, 64, "u8"), (56, 80, "u8")}
 
     def test_slow_bucket_does_not_block_other_bucket(self, predictor):
         """A bucket whose dispatch stalls must not delay another
@@ -1175,9 +1177,11 @@ class TestConcurrentDispatch:
                 assert np.array_equal(
                     eng.submit(im1, im2).result(120), refs[i])
                 # The dedicated bucket never retires; dynamic streams
-                # stay within the cap at every step.
-                assert (40, 64) in eng._streams
-                dynamic = [b for b in eng._streams if b != (40, 64)]
+                # stay within the cap at every step. Stream keys carry
+                # the wire tag (uint8 frames ride the u8 wire).
+                assert (40, 64, "u8") in eng._streams
+                dynamic = [b for b in eng._streams
+                           if b[:2] != (40, 64)]
                 assert len(dynamic) <= 2
             assert len(eng._streams) <= 3
             # Three distinct dynamic buckets saw traffic, so at least
