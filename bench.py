@@ -655,7 +655,7 @@ def _sparse_metrics() -> dict:
 SERVING_METRIC = "serving_vs_sequential_batch1_speedup"
 
 
-def serving_main(replicas: int = 1):
+def serving_main(replicas: int = 1, trace: bool = False):
     """``python bench.py serving [--replicas N]`` — dynamic-batching
     serving benchmark.
 
@@ -681,6 +681,13 @@ def serving_main(replicas: int = 1):
     on one host extra replicas add routing, not compute, so the
     interesting numbers are the warmup-sharing and failover machinery,
     not the throughput.
+
+    ``--trace`` enables request-scoped tracing for the run and writes
+    the Perfetto-loadable Chrome trace JSON next to the bench; its path
+    ships in the artifact as ``trace_artifact`` (validated by
+    ``scripts/check_bench_schema.py``: the file must exist and parse as
+    trace JSON). Off by default — the headline numbers stay measured on
+    the zero-instrumentation path.
     """
     import jax
 
@@ -700,6 +707,11 @@ def serving_main(replicas: int = 1):
         small, iters = True, 4
         max_batch, concurrency, n_requests = 8, 8, 48
         max_wait_ms = 4.0
+
+    tracer = None
+    if trace:
+        from raft_tpu.observability import enable_tracing
+        tracer = enable_tracing()   # before engine build: captured at init
 
     predictor = load_predictor("random", small=small, iters=iters)
     frames = loadgen.make_frames(shapes, per_shape=2, seed=0)
@@ -818,6 +830,9 @@ def serving_main(replicas: int = 1):
         "mismatched": len(res["mismatched"]),
         "host_stage_ms": host_stage_ms,
     }
+    if tracer is not None:
+        payload["trace_artifact"] = tracer.write(
+            "/tmp/raft_bench_serving_trace.json")
     if replicas > 1:
         snap = metrics_owner.snapshot()
         payload["fleet"] = {
@@ -1395,6 +1410,12 @@ if __name__ == "__main__":
                                  "mixed-dtype zero-compile pass and "
                                  "records the f32/u8 ratio (the "
                                  "BENCH_r08 artifact)")
+            ap.add_argument("--trace", action="store_true",
+                            help="record a request-scoped trace of the "
+                                 "benchmark run and ship its path as "
+                                 "the artifact's trace_artifact key "
+                                 "(Perfetto-loadable Chrome trace "
+                                 "JSON)")
             args = ap.parse_args(sys.argv[2:])
             if args.wire is not None:
                 try:
@@ -1404,7 +1425,7 @@ if __name__ == "__main__":
                 except BaseException as e:  # noqa: BLE001
                     _wire_failure(f"{type(e).__name__}: {e}")
                 sys.exit(0)
-            serving_main(replicas=args.replicas)
+            serving_main(replicas=args.replicas, trace=args.trace)
         except SystemExit:
             raise
         except BaseException as e:  # noqa: BLE001 — artifact must parse

@@ -68,6 +68,7 @@ from typing import Any, Optional, Set
 import jax
 import orbax.checkpoint as ocp
 
+from raft_tpu.observability.registry import get_registry as obs_get_registry
 from raft_tpu.resilience import (CheckpointCommitError, active_injector,
                                  all_hosts_agree)
 
@@ -184,6 +185,23 @@ class RunCheckpointer:
         self.save_retries = save_retries
         self.retry_delay = retry_delay
         self.async_save = async_save
+        # Checkpoint I/O timings on the process-default telemetry
+        # registry (same surface the serving engines expose per-engine).
+        # The save histogram measures what the TRAIN LOOP paid inside
+        # save(): the full write for sync mode, the dispatch for async
+        # mode (the finalize cost lands in wait_for_pending's own
+        # histogram row via the same instrument).
+        reg = obs_get_registry()
+        self._obs_saves = reg.counter(
+            "train_checkpoint_saves",
+            help="checkpoint save() calls (sync or async dispatch)")
+        self._obs_save_s = reg.histogram(
+            "train_checkpoint_save_seconds",
+            help="wall seconds the train loop spent inside save() / "
+                 "wait_for_pending()")
+        self._obs_restore_s = reg.histogram(
+            "train_checkpoint_restore_seconds",
+            help="wall seconds per attempted step restore")
         if gc_orphans:
             # Only the run's OWNING checkpointer may GC: a read-only
             # helper (latest_step(), a drill inspector) constructed
@@ -266,12 +284,15 @@ class RunCheckpointer:
         call :meth:`wait_for_pending` to finalize + commit it.
         """
         self.wait_for_pending()
+        t0 = time.perf_counter()
         step = int(jax.device_get(state.step))
         arrays = _arrays_of(state)
         if loader_state is not None and hasattr(loader_state, "to_dict"):
             loader_state = loader_state.to_dict()
         if not self.async_save:
             self._save_with_agreement(step, arrays, loader_state)
+            self._obs_save_s.observe(time.perf_counter() - t0)
+            self._obs_saves.inc()
             return
 
         # Async dispatch. The injection hook and (on multi-host) a
@@ -306,6 +327,8 @@ class RunCheckpointer:
                 first_exc = e
         self._pending = (step, arrays, loader_state, first_exc,
                          dispatched)
+        self._obs_save_s.observe(time.perf_counter() - t0)
+        self._obs_saves.inc()
 
     def wait_for_pending(self) -> None:
         """Barrier: finalize, vote on and commit the in-flight async
@@ -317,9 +340,13 @@ class RunCheckpointer:
             return
         step, arrays, loader_state, first_exc, dispatched = self._pending
         self._pending = None
-        self._save_with_agreement(step, arrays, loader_state,
-                                  first_exc=first_exc,
-                                  first_dispatched=dispatched)
+        t0 = time.perf_counter()
+        try:
+            self._save_with_agreement(step, arrays, loader_state,
+                                      first_exc=first_exc,
+                                      first_dispatched=dispatched)
+        finally:
+            self._obs_save_s.observe(time.perf_counter() - t0)
 
     def _attempt(self, step: int, arrays: dict, loader_state,
                  exc: Optional[Exception],
@@ -520,10 +547,12 @@ class RunCheckpointer:
     # -- restore ---------------------------------------------------------
 
     def _restore_step(self, step: int, state):
+        t0 = time.perf_counter()
         target = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                               _arrays_of(state))
         restored = self._mngr.restore(step,
                                       args=ocp.args.StandardRestore(target))
+        self._obs_restore_s.observe(time.perf_counter() - t0)
         return state.replace(step=restored["step"],
                              params=restored["params"],
                              batch_stats=restored["batch_stats"],

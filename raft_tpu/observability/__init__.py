@@ -1,0 +1,44 @@
+"""Observability substrate: request-scoped tracing, a typed metrics
+registry with Prometheus/JSON exposition, and latency SLO tracking.
+
+Three pieces, designed to be adopted by the existing serving/training
+metric bags without changing their public surfaces:
+
+* :mod:`~raft_tpu.observability.tracer` — a process-wide
+  :class:`Tracer` (opt-in via :func:`enable_tracing`) recording
+  monotonic-clock spans into a bounded lock-free ring, exported as
+  Perfetto-loadable Chrome trace-event JSON. Zero-cost when disabled.
+* :mod:`~raft_tpu.observability.registry` — :class:`MetricsRegistry`
+  with :class:`Counter` / :class:`Gauge` / :class:`Histogram`
+  instruments (label sets supported), ``dump()`` in Prometheus text or
+  JSON, and an opt-in stdlib-HTTP ``/metrics`` endpoint.
+* :mod:`~raft_tpu.observability.slo` — :class:`SloTracker`, per-class
+  latency objectives surfaced as rolling violation-ratio gauges.
+
+Stdlib-only on purpose: importable from the serving hot path, the
+train loop, and the checkpointer without pulling in jax or numpy.
+"""
+
+from raft_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                             MetricsRegistry,
+                                             get_registry,
+                                             start_http_server)
+from raft_tpu.observability.slo import SloTracker
+from raft_tpu.observability.tracer import Tracer
+from raft_tpu.observability.tracer import current as current_tracer
+from raft_tpu.observability.tracer import disable as disable_tracing
+from raft_tpu.observability.tracer import enable as enable_tracing
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SloTracker",
+    "Tracer",
+    "current_tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_registry",
+    "start_http_server",
+]

@@ -1,0 +1,347 @@
+"""Typed metrics registry: Counter/Gauge/Histogram instruments with
+label sets, Prometheus text + JSON exposition, and an opt-in stdlib
+HTTP ``/metrics`` endpoint.
+
+The serving and training sides each grew their own counter bags
+(:class:`~raft_tpu.serving.metrics.ServingMetrics`,
+:class:`~raft_tpu.serving.fleet.FleetMetrics`, the train logger's
+degradation totals). This module gives them ONE exposition surface
+without changing any of their existing APIs: each bag *re-registers*
+its live values here as instruments (callable-backed gauges reading
+the bag's own counters — no double bookkeeping, no drift), and
+:meth:`MetricsRegistry.dump` renders the union in Prometheus text
+exposition format or as a flat JSON snapshot.
+
+Instrument model (the Prometheus subset this stack needs):
+
+* :class:`Counter` — monotonically increasing, ``inc(n, **labels)``.
+* :class:`Gauge` — ``set(v, **labels)``, or constructed with ``fn=``
+  (a zero-arg callable returning a scalar, or — for labeled gauges —
+  a ``{(label values...): value}`` dict) evaluated at collection time.
+  Callable gauges are how the existing metric bags bridge in.
+* :class:`Histogram` — ``observe(v, **labels)`` into cumulative
+  ``le`` buckets + sum + count (checkpoint save/restore timings,
+  request latencies).
+
+Collection never raises: a callable gauge that throws collects as 0.0
+(a broken gauge must not take the exposition endpoint down — same
+contract as ``ServingMetrics.snapshot``).
+
+The HTTP endpoint (:func:`start_http_server`) is stdlib-only
+(``http.server.ThreadingHTTPServer`` on a daemon thread), serves
+``GET /metrics`` (Prometheus text) and ``GET /metrics.json``, and is
+strictly opt-in — nothing binds a port unless asked.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+_LabelKey = Tuple[str, ...]
+
+
+def _label_key(labelnames: Tuple[str, ...], labels: dict) -> _LabelKey:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared "
+            f"labelnames {sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+def _render_labels(labelnames: Tuple[str, ...], key: _LabelKey) -> str:
+    if not labelnames:
+        return ""
+    pairs = ",".join(
+        f'{n}="{v}"' for n, v in zip(labelnames, key))
+    return "{" + pairs + "}"
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def collect(self) -> Dict[_LabelKey, float]:
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease "
+                             f"(inc({n}))")
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def collect(self) -> Dict[_LabelKey, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = (),
+                 fn: Optional[Callable[[], object]] = None):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[_LabelKey, float] = {}
+        self._fn = fn
+
+    def set(self, v: float, **labels) -> None:
+        if self._fn is not None:
+            raise RuntimeError(
+                f"gauge {self.name} is callable-backed; set() would "
+                "be silently overwritten at collection")
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = float(v)
+
+    def collect(self) -> Dict[_LabelKey, float]:
+        if self._fn is None:
+            with self._lock:
+                return dict(self._values)
+        try:
+            got = self._fn()
+        except Exception:
+            got = 0.0
+        if isinstance(got, dict):
+            out: Dict[_LabelKey, float] = {}
+            for k, v in got.items():
+                key = k if isinstance(k, tuple) else (str(k),)
+                try:
+                    out[tuple(str(p) for p in key)] = float(v)
+                except (TypeError, ValueError):
+                    out[tuple(str(p) for p in key)] = 0.0
+            return out
+        try:
+            return {(): float(got)}
+        except (TypeError, ValueError):
+            return {(): 0.0}
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    #: Seconds-scaled defaults: queue waits through checkpoint writes.
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                       0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = (),
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        if tuple(sorted(buckets)) != tuple(buckets):
+            raise ValueError(f"histogram buckets must ascend: {buckets}")
+        self.buckets = tuple(float(b) for b in buckets)
+        # key -> [per-bucket counts..., +inf count, sum]
+        self._series: Dict[_LabelKey, List[float]] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(self.labelnames, labels)
+        v = float(v)
+        with self._lock:
+            row = self._series.get(key)
+            if row is None:
+                row = [0.0] * (len(self.buckets) + 2)
+                self._series[key] = row
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    row[i] += 1
+                    break
+            else:
+                row[len(self.buckets)] += 1     # +inf bucket
+            row[-1] += v                        # running sum
+
+    def series(self) -> Dict[_LabelKey, List[float]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._series.items()}
+
+    def collect(self) -> Dict[_LabelKey, float]:
+        """Flat view (count per labelset) — the JSON snapshot's shape;
+        the full bucket layout renders only in Prometheus text."""
+        out = {}
+        for key, row in self.series().items():
+            out[key] = sum(row[:-1])
+        return out
+
+
+class MetricsRegistry:
+    """Name -> instrument map with get-or-create constructors and the
+    two exposition formats. Thread-safe; instrument names are unique
+    across kinds (re-requesting an existing name with a different kind
+    or label set raises — the golden-pin test's invariant)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Tuple[str, ...], **kw):
+        labelnames = tuple(labelnames)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls) or \
+                        inst.labelnames != labelnames:
+                    raise ValueError(
+                        f"instrument {name!r} already registered as "
+                        f"{inst.kind}{list(inst.labelnames)}; cannot "
+                        f"re-register as {cls.kind}{list(labelnames)}")
+                return inst
+            inst = cls(name, help=help, labelnames=labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Tuple[str, ...] = (),
+              fn: Optional[Callable[[], object]] = None) -> Gauge:
+        g = self._get_or_create(Gauge, name, help, labelnames, fn=fn)
+        if fn is not None and g._fn is None:
+            g._fn = fn          # late-bound callable on a re-request
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Tuple[str, ...] = (),
+                  buckets: Tuple[float, ...] = Histogram.DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    # -- reading --------------------------------------------------------
+
+    def names(self) -> List[str]:
+        """Sorted instrument names (the golden-pin surface)."""
+        with self._lock:
+            return sorted(self._instruments)
+
+    def instruments(self) -> Dict[str, _Instrument]:
+        with self._lock:
+            return dict(self._instruments)
+
+    def json_snapshot(self) -> Dict[str, float]:
+        """Flat ``{name or name{labels}: value}`` dict — the same
+        shape ``ServingMetrics.snapshot`` feeds the scalar sinks."""
+        out: Dict[str, float] = {}
+        for name, inst in sorted(self.instruments().items()):
+            for key, val in sorted(inst.collect().items()):
+                out[name + _render_labels(inst.labelnames, key)] = val
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        lines: List[str] = []
+        for name, inst in sorted(self.instruments().items()):
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} {inst.kind}")
+            if isinstance(inst, Histogram):
+                names = inst.labelnames
+                for key, row in sorted(inst.series().items()):
+                    cum = 0.0
+                    for i, b in enumerate(inst.buckets):
+                        cum += row[i]
+                        lab = _render_labels(
+                            names + ("le",), key + (f"{b:g}",))
+                        lines.append(f"{name}_bucket{lab} {cum:g}")
+                    cum += row[len(inst.buckets)]
+                    lab = _render_labels(names + ("le",),
+                                         key + ("+Inf",))
+                    lines.append(f"{name}_bucket{lab} {cum:g}")
+                    base = _render_labels(names, key)
+                    lines.append(f"{name}_sum{base} {row[-1]:g}")
+                    lines.append(f"{name}_count{base} {cum:g}")
+                continue
+            for key, val in sorted(inst.collect().items()):
+                lines.append(
+                    f"{name}{_render_labels(inst.labelnames, key)} "
+                    f"{val:g}")
+        return "\n".join(lines) + "\n"
+
+    def dump(self, fmt: str = "prometheus") -> str:
+        """Render every instrument: ``fmt="prometheus"`` (text
+        exposition) or ``fmt="json"`` (flat snapshot)."""
+        if fmt == "prometheus":
+            return self.prometheus_text()
+        if fmt == "json":
+            return json.dumps(self.json_snapshot(), sort_keys=True)
+        raise ValueError(f"unknown dump format {fmt!r} "
+                         "(expected 'prometheus' or 'json')")
+
+
+# -- process-default registry -------------------------------------------
+#
+# The training side (checkpointer, train loop) records here so one
+# scrape covers both halves of the stack; serving engines keep their
+# own per-engine registry (deterministic instrument sets per engine)
+# but can be pointed at this one explicitly.
+
+_DEFAULT = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (training-side instruments land
+    here)."""
+    return _DEFAULT
+
+
+def start_http_server(registry: MetricsRegistry, port: int,
+                      host: str = "127.0.0.1"):
+    """Serve ``registry`` over stdlib HTTP on a daemon thread:
+    ``GET /metrics`` → Prometheus text, ``GET /metrics.json`` → JSON
+    snapshot, anything else → 404. ``port=0`` binds an ephemeral port
+    (tests); read the bound one off ``server.server_address[1]``.
+    Returns the ``ThreadingHTTPServer`` — call ``.shutdown()`` to
+    stop."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):                      # noqa: N802 (stdlib API)
+            if self.path.split("?")[0] == "/metrics":
+                body = registry.prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/metrics.json":
+                body = registry.dump("json").encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):          # silence per-request spam
+            pass
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="metrics-http", daemon=True)
+    thread.start()
+    return server

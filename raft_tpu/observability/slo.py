@@ -1,0 +1,126 @@
+"""Per-class latency SLOs: rolling violation ratios as registry gauges.
+
+An SLO here is "requests of class C complete within N ms". The engine
+feeds every completed request's (priority class, latency) pair in;
+the tracker keeps a bounded rolling window per class and exposes the
+violation ratio — the fraction of recent requests that missed their
+objective — plus the objective itself, as gauges on a
+:class:`~raft_tpu.observability.registry.MetricsRegistry`. A ratio,
+not a raw count: dashboards alert on "5% of HIGH traffic is late",
+which survives load changes the way an absolute count does not.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Mapping
+
+
+class SloTracker:
+    """Rolling per-class latency-objective tracking.
+
+    Args:
+      objectives_ms: ``{class: objective_ms}`` — e.g. ``{"high": 50.0,
+        "low": 250.0}``. Classes are the serving priority strings;
+        observations for an unconfigured class are counted but never
+        violate (no objective = no SLO).
+      window: rolling per-class window size (bounded memory; the ratio
+        reflects the last ``window`` completions, matching the metrics
+        module's rolling-latency philosophy).
+    """
+
+    def __init__(self, objectives_ms: Mapping[str, float],
+                 window: int = 1000):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.objectives_ms: Dict[str, float] = {
+            str(k): float(v) for k, v in objectives_ms.items()}
+        self._lock = threading.Lock()
+        self._window = int(window)
+        # class -> deque of 0/1 violation flags (rolling)
+        self._flags: Dict[str, deque] = {}
+        self._observed: Dict[str, int] = {}
+        self._violations: Dict[str, int] = {}   # run totals
+
+    def observe(self, cls: str, latency_s: float) -> bool:
+        """Record one completion; returns whether it violated its
+        class objective."""
+        cls = str(cls)
+        objective = self.objectives_ms.get(cls)
+        violated = (objective is not None
+                    and latency_s * 1e3 > objective)
+        with self._lock:
+            flags = self._flags.get(cls)
+            if flags is None:
+                flags = deque(maxlen=self._window)
+                self._flags[cls] = flags
+            flags.append(1 if violated else 0)
+            self._observed[cls] = self._observed.get(cls, 0) + 1
+            if violated:
+                self._violations[cls] = \
+                    self._violations.get(cls, 0) + 1
+        return violated
+
+    def violation_ratio(self, cls: str) -> float:
+        """Fraction of the class's rolling window that missed its
+        objective (0.0 with no observations)."""
+        with self._lock:
+            flags = self._flags.get(str(cls))
+            return (sum(flags) / len(flags)) if flags else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict: per configured class, the objective, rolling
+        violation ratio, and run totals."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            classes = sorted(set(self.objectives_ms) | set(self._flags))
+            for cls in classes:
+                flags = self._flags.get(cls)
+                out[f"slo_{cls}_objective_ms"] = \
+                    self.objectives_ms.get(cls, 0.0)
+                out[f"slo_{cls}_violation_ratio"] = (
+                    (sum(flags) / len(flags)) if flags else 0.0)
+                out[f"slo_{cls}_observed"] = float(
+                    self._observed.get(cls, 0))
+                out[f"slo_{cls}_violations"] = float(
+                    self._violations.get(cls, 0))
+        return out
+
+    def attach_registry(self, registry) -> None:
+        """Re-register the tracker's readouts as labeled gauges
+        (``{class=...}``) on ``registry`` — evaluated live at
+        collection time, no double bookkeeping."""
+        registry.gauge(
+            "slo_objective_ms",
+            help="configured latency objective per priority class",
+            labelnames=("class",),
+            fn=lambda: {(c,): v
+                        for c, v in self.objectives_ms.items()})
+
+        def _ratios():
+            with self._lock:
+                return {(c,): (sum(f) / len(f)) if f else 0.0
+                        for c, f in self._flags.items()} \
+                    or {(c,): 0.0 for c in self.objectives_ms}
+
+        registry.gauge(
+            "slo_violation_ratio",
+            help="rolling fraction of completions over objective",
+            labelnames=("class",), fn=_ratios)
+
+        def _totals(table):
+            def read():
+                with self._lock:
+                    return {(c,): float(n) for c, n in table.items()} \
+                        or {(c,): 0.0 for c in self.objectives_ms}
+            return read
+
+        registry.gauge("slo_observed",
+                       help="completions observed per class",
+                       labelnames=("class",),
+                       fn=_totals(self._observed))
+        registry.gauge("slo_violations",
+                       help="objective misses per class (run total)",
+                       labelnames=("class",),
+                       fn=_totals(self._violations))

@@ -255,6 +255,24 @@ class ResilienceStats:
         with self._lock:
             self.worker_timeouts += n
 
+    def attach_registry(self, registry) -> None:
+        """Expose the four counters as live gauges on ``registry``
+        (gauges, not registry Counters: this object stays the single
+        writer and the registry reads it at collection time — no double
+        bookkeeping, no drift)."""
+        for name, attr, help_ in (
+                ("train_substituted_samples", "substituted_samples",
+                 "corrupt samples replaced by a deterministic neighbor"),
+                ("train_skipped_steps", "skipped_steps",
+                 "non-finite steps whose update was suppressed"),
+                ("train_sample_retries", "sample_retries",
+                 "transient sample-read errors that succeeded on retry"),
+                ("train_worker_timeouts", "worker_timeouts",
+                 "loader worker-pool drains that hit the deadline")):
+            registry.gauge(
+                name, help=help_,
+                fn=(lambda a=attr: float(getattr(self, a))))
+
 
 @dataclasses.dataclass
 class FaultInjector:

@@ -70,13 +70,15 @@ class QueuedRequest:
 
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
                  "deadline", "priority", "poisoned", "session",
-                 "flow_init", "fmap1", "degradable", "low_res", "future")
+                 "flow_init", "fmap1", "degradable", "low_res", "trace",
+                 "future")
 
     def __init__(self, image1, image2, padder, bucket,
                  t_submit: float, deadline: Optional[float] = None,
                  priority: str = PRIORITY_HIGH, poisoned: bool = False,
                  session=None, flow_init=None, fmap1=None,
-                 degradable: bool = False, low_res: bool = False):
+                 degradable: bool = False, low_res: bool = False,
+                 trace=None):
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -96,6 +98,10 @@ class QueuedRequest:
         # explicit client-chosen iters stay where they were queued).
         self.degradable = degradable
         self.low_res = low_res
+        # Request-scoped trace id (observability.tracer), minted by the
+        # engine at submit ONLY when tracing is enabled — None (no
+        # allocation, no id) on the default path.
+        self.trace = trace
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:
@@ -217,7 +223,9 @@ class ShapeBucketBatcher:
         return victim
 
     def rebucket_low(self,
-                     mapper: Callable[[QueuedRequest], Optional[object]]
+                     mapper: Callable[[QueuedRequest], Optional[object]],
+                     on_move: Optional[
+                         Callable[[QueuedRequest, object], None]] = None
                      ) -> int:
         """Move queued LOW requests between buckets (the brownout
         ladder's step transitions): ``mapper`` sees each queued LOW
@@ -225,6 +233,12 @@ class ShapeBucketBatcher:
         ``None`` to leave it where it is (the policy — which requests
         the ladder manages — lives in the caller). Returns the number
         of requests moved.
+
+        ``on_move`` (optional) is invoked as ``on_move(req, new_key)``
+        for each applied move, while the batcher lock is held — keep it
+        cheap and non-reentrant (it exists for trace annotations). An
+        exception from it is swallowed: observability must not be able
+        to wedge the queue.
 
         **Deadline anchoring:** a moved request keeps its original
         ``t_submit`` (the batching ``max_wait`` anchor — its wait so
@@ -260,6 +274,11 @@ class ShapeBucketBatcher:
                 self._buckets.setdefault(new_key, _Bucket()) \
                     .low.append(req)
                 moved += 1
+                if on_move is not None:
+                    try:
+                        on_move(req, new_key)
+                    except Exception:
+                        pass
             if moved:
                 # Moved (older) requests can make the target bucket
                 # full or past-deadline right now — wake the dispatcher

@@ -86,6 +86,7 @@ adds only queueing, stacking and unpadding around
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import queue
@@ -95,6 +96,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.observability import registry as obs_registry
+from raft_tpu.observability import tracer as tracing
+from raft_tpu.observability.slo import SloTracker
 from raft_tpu.resilience import active_injector
 from raft_tpu.serving import health as health_mod
 from raft_tpu.serving.batcher import (PRIORITY_HIGH, PRIORITY_LOW,
@@ -106,6 +110,10 @@ from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
 from raft_tpu.utils.padder import InputPadder
 from raft_tpu.utils.profiling import HostStageTimer
+
+# Shared no-op context for `with <stage>, <maybe-span>:` sites — the
+# disabled-tracing path must not allocate a context manager per batch.
+_NULL = contextlib.nullcontext()
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -213,6 +221,12 @@ def upsample_flow(flow_low: np.ndarray, padder: Optional[InputPadder] = None,
     flow alone doesn't carry. ``low_res`` trades that fidelity for 64x
     fewer D2H + response bytes; callers who need the exact full-res
     flow submit without it."""
+    tr = tracing.current()   # module-level helper: no engine to hold
+    with (tr.span("upsample_flow") if tr is not None else _NULL):
+        return _upsample_flow_impl(flow_low, padder, factor)
+
+
+def _upsample_flow_impl(flow_low, padder, factor) -> np.ndarray:
     f = np.asarray(flow_low, np.float32)
     squeeze = f.ndim == 3
     if squeeze:
@@ -410,6 +424,25 @@ class ServingConfig:
         batching multiplies per-chip activation memory at exactly the
         resolutions that needed sharding). Other buckets keep
         ``max_batch``.
+      trace: force request-scoped tracing on for this engine (mints a
+        process tracer via :func:`raft_tpu.observability.enable_tracing`
+        if none is installed). Default off: the engine still picks up a
+        tracer that was enabled *before* construction, and when neither
+        holds, the request path carries no trace ids, no span
+        allocations, and is bit-identical to pre-tracing builds
+        (asserted by tests/test_observability.py).
+      trace_capacity: ring capacity used when ``trace=True`` has to
+        mint the tracer (ignored when one already exists).
+      metrics_port: when set, serve this engine's telemetry registry
+        over stdlib HTTP on ``127.0.0.1:<port>`` (``/metrics``
+        Prometheus text, ``/metrics.json``). ``0`` binds an ephemeral
+        port (see ``ServingEngine.metrics_server``); ``None`` (default)
+        starts no server.
+      slo_ms: per-priority-class latency objectives,
+        ``(("high", 50.0), ("low", 250.0))``-style. When non-empty the
+        engine feeds every completion into an
+        :class:`~raft_tpu.observability.slo.SloTracker` whose rolling
+        violation ratios ride the engine registry as ``slo_*`` gauges.
     """
 
     max_batch: int = 8
@@ -436,6 +469,10 @@ class ServingConfig:
     sharded_shards: int = 0
     sharded_area_threshold: int = 0
     sharded_max_batch: int = 1
+    trace: bool = False
+    trace_capacity: int = 65536
+    metrics_port: Optional[int] = None
+    slo_ms: Tuple[Tuple[str, float], ...] = ()
 
 
 class _BucketStream:
@@ -509,6 +546,7 @@ class _BucketStream:
                 if left:
                     for r in left:
                         r.future.set_exception(e)
+                        eng._trace_end(r, "fatal")
                     eng.metrics.record_error(len(left))
         finally:
             self.inflight.put(None)
@@ -529,8 +567,11 @@ class _BucketStream:
             # (streams always need it for the warm-start handoff).
             want_full = is_stream or any(not r.low_res for r in batch)
             want_low = is_stream or any(r.low_res for r in batch)
+            tr = eng._tracer
             try:
-                with eng.stages.stage("sync"):
+                with eng.stages.stage("sync"), \
+                        (tr.span("sync", args={"n": len(batch)})
+                         if tr is not None else _NULL):
                     flow_up = np.asarray(out[1]) if want_full else None
                     flow_low = np.asarray(out[0]) if want_low else None
                     if is_stream:
@@ -563,7 +604,9 @@ class _BucketStream:
                     eng.metrics.record_early_exit_saved(saved)
             eng.metrics.record_quality(served_iters, n=len(batch))
             returned = 0
-            with eng.stages.stage("unpad"):
+            with eng.stages.stage("unpad"), \
+                    (tr.span("unpad", args={"n": len(batch)})
+                     if tr is not None else _NULL):
                 for j, r in enumerate(batch):
                     if is_stream:
                         # State handoff BEFORE resolving the future:
@@ -579,7 +622,11 @@ class _BucketStream:
                         result = r.padder.unpad(flow_up[j])
                     returned += result.nbytes
                     r.future.set_result(result)
-                    eng.metrics.record_done(now - r.t_submit)
+                    eng._trace_end(r, "ok")
+                    latency = now - r.t_submit
+                    eng.metrics.record_done(latency)
+                    if eng.slo is not None:
+                        eng.slo.observe(r.priority, latency)
             eng.metrics.record_returned_bytes(returned)
 
 
@@ -797,6 +844,45 @@ class ServingEngine:
             m.set_gauge_source("brownout_time_s",
                                ctl.time_in_brownout_s)
 
+        # -- observability ---------------------------------------------
+        # Tracer reference is captured ONCE, here: every hot-path site
+        # tests `self._tracer is not None` and nothing else, so with
+        # tracing off the request path mints no ids and allocates no
+        # span objects (tests/test_observability.py asserts both).
+        if config.trace:
+            tracing.enable(config.trace_capacity)
+        self._tracer = tracing.current()
+        # Per-engine registry (NOT the process default): instrument
+        # names are deterministic per engine, golden-pinned by
+        # tests/test_observability.py, and two engines in one process
+        # (fleet) never fight over label-free gauges.
+        self.registry = obs_registry.MetricsRegistry()
+        self.metrics.attach_registry(self.registry)
+        self.slo: Optional[SloTracker] = None
+        if config.slo_ms:
+            self.slo = SloTracker(dict(config.slo_ms))
+            self.slo.attach_registry(self.registry)
+        self.metrics_server = None
+        if config.metrics_port is not None:
+            self.metrics_server = obs_registry.start_http_server(
+                self.registry, config.metrics_port)
+
+    # -- trace plumbing -------------------------------------------------
+    #
+    # The root span protocol: submit() mints a trace_id (unless the
+    # fleet minted one and passed it down) and opens the async
+    # "request" span on it; _trace_end closes it exactly where the
+    # request's future resolves — completion loop, isolation retry,
+    # timeout/fastfail drain, shed, eviction, or fatal drain. The
+    # drill's invariant (`open_flows() == []` once all futures
+    # resolve) holds because every resolution site calls _trace_end.
+
+    def _trace_end(self, req, status: str) -> None:
+        """Close ``req``'s root span with a terminal status."""
+        tr = self._tracer
+        if tr is not None and req.trace is not None:
+            tr.end_async("request", req.trace, args={"status": status})
+
     # -- lifecycle ------------------------------------------------------
 
     def start(self, warmup: bool = True) -> "ServingEngine":
@@ -975,6 +1061,9 @@ class ServingEngine:
             # thread, which has exited by now.)
             for s in streams + self._retired:
                 s.join(timeout)
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()
+            self.metrics_server = None
 
     def __enter__(self) -> "ServingEngine":
         if not self._started:
@@ -1133,7 +1222,8 @@ class ServingEngine:
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                priority: str = PRIORITY_HIGH,
                iters: Optional[int] = None,
-               low_res: bool = False):
+               low_res: bool = False,
+               trace_id: Optional[int] = None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
         ``image1``/``image2``: (H, W, 3) arrays in [0, 255], any
@@ -1157,7 +1247,11 @@ class ServingEngine:
         stamped on the future (``future.padder``) so callers can
         recover full resolution host-side via :func:`upsample_flow`
         (documented as NOT bit-equal to the in-graph convex
-        upsampling). Thread-safe.
+        upsampling). ``trace_id``: a pre-minted id for the request's
+        trace track — passed by the fleet so an engine attempt's
+        ``request`` span lands on the same Perfetto lane as the fleet's
+        outer ``fleet_request`` span; clients leave it ``None``
+        (ignored when tracing is disabled). Thread-safe.
         """
         if iters is not None:
             iters = int(iters)
@@ -1184,8 +1278,23 @@ class ServingEngine:
                     "executables) — sharded requests always serve full "
                     "quality")
             return self._submit_sharded(image1, image2, priority,
-                                        sharded_bucket, low_res=low_res)
-        with self.stages.stage("pad"):
+                                        sharded_bucket, low_res=low_res,
+                                        trace_id=trace_id)
+        # Root span: opened here (all validation raises are behind us,
+        # so every opened span has a future that will resolve), closed
+        # by _trace_end wherever that future resolves. With tracing
+        # off, `tr is None` and the request carries no id at all.
+        tr = self._tracer
+        rid = None
+        if tr is not None:
+            rid = tr.mint() if trace_id is None else trace_id
+            tr.begin_async("request", rid,
+                           args={"priority": priority, "iters": iters,
+                                 "shape": list(map(int, image1.shape)),
+                                 "low_res": low_res})
+        with self.stages.stage("pad"), \
+                (tr.span("pad", trace_id=rid) if tr is not None
+                 else _NULL):
             wire, image1, image2 = request_wire(image1, image2)
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self.config.factor)
@@ -1221,14 +1330,15 @@ class ServingEngine:
                             poisoned=active_injector()
                             .poisons_request(seq),
                             degradable=degradable,
-                            low_res=low_res)
+                            low_res=low_res, trace=rid)
         if low_res:
             # Pad geometry for host-side upsample_flow recovery.
             req.future.padder = padder
         return self._enqueue_request(req)
 
     def _submit_sharded(self, image1, image2, priority,
-                        bucket, low_res: bool = False) -> "Future":
+                        bucket, low_res: bool = False,
+                        trace_id: Optional[int] = None) -> "Future":
         """Enqueue one request onto its ``(ph, pw, "mesh", wire)``
         sharded bucket: padded at the sharded factor (rows always
         divide the spatial axis), never brownout-degradable (the
@@ -1237,7 +1347,17 @@ class ServingEngine:
         ``bucket`` arrives wire-untagged from :meth:`sharded_route`
         (the fleet shares that routing and stays dtype-agnostic); the
         tag is appended here."""
-        with self.stages.stage("pad"):
+        tr = self._tracer
+        rid = None
+        if tr is not None:
+            rid = tr.mint() if trace_id is None else trace_id
+            tr.begin_async("request", rid,
+                           args={"priority": priority, "sharded": True,
+                                 "shape": list(map(int, image1.shape)),
+                                 "low_res": low_res})
+        with self.stages.stage("pad"), \
+                (tr.span("pad", trace_id=rid) if tr is not None
+                 else _NULL):
             wire, image1, image2 = request_wire(image1, image2)
             padder = InputPadder(image1.shape, mode=self.config.pad_mode,
                                  factor=self._sharded_factor)
@@ -1254,7 +1374,7 @@ class ServingEngine:
                             poisoned=active_injector()
                             .poisons_request(seq),
                             degradable=False,
-                            low_res=low_res)
+                            low_res=low_res, trace=rid)
         if low_res:
             req.future.padder = padder
         self.metrics.record_sharded()
@@ -1297,9 +1417,11 @@ class ServingEngine:
             # the capacity signal, the reject total the error rate.
             self.metrics.record_shed(req.priority)
             self.metrics.record_reject()
+            self._trace_end(req, "shed")
             raise
         except RuntimeError:
             self.metrics.record_reject()
+            self._trace_end(req, "rejected")
             raise
         if evicted is not None:
             # A queued LOW request was shed to admit this HIGH one; its
@@ -1309,6 +1431,7 @@ class ServingEngine:
                 "shed from the backlog by a higher-priority request"))
             self.metrics.record_shed(evicted.priority)
             self.metrics.record_reject()
+            self._trace_end(evicted, "evicted")
         self.metrics.record_submit(self.batcher.pending(),
                                    priority=req.priority)
         return req.future
@@ -1337,12 +1460,17 @@ class ServingEngine:
         thread (like padding, host prep rides the producers). Returns
         the ``(1, H/8, W/8, C)`` host feature map."""
         self._check_accepting()
-        stack = np.repeat(padded_frame[None], self.config.max_batch, 0)
-        with self._swap_lock:
-            predictor = self.predictor
-        c0 = xla_compile_count()
-        fmap = predictor.encode_dispatch(stack)
-        out = np.asarray(fmap)[:1].copy()
+        tr = self._tracer
+        with (tr.span("prime_encode",
+                      args={"shape": list(map(int, padded_frame.shape))})
+              if tr is not None else _NULL):
+            stack = np.repeat(padded_frame[None],
+                              self.config.max_batch, 0)
+            with self._swap_lock:
+                predictor = self.predictor
+            c0 = xla_compile_count()
+            fmap = predictor.encode_dispatch(stack)
+            out = np.asarray(fmap)[:1].copy()
         self.metrics.record_encoder_cache(hit=False)
         compiles = xla_compile_count() - c0
         if compiles:
@@ -1395,12 +1523,24 @@ class ServingEngine:
         with self._state_lock:
             self._submit_seq += 1
             seq = self._submit_seq
+        tr = self._tracer
+        rid = None
+        if tr is not None:
+            rid = tr.mint()
+            tr.begin_async("request", rid,
+                           args={"priority": priority,
+                                 "stream": session.stream_id,
+                                 "warm": warm})
+            # Warm starts are the streaming path's whole trick — make
+            # each one legible on the request lane.
+            tr.async_instant("warm_start" if warm else "cold_start",
+                             rid, args={"stream": session.stream_id})
         req = QueuedRequest(
             image1, image2, padder, bucket=bucket,
             t_submit=t_submit, deadline=deadline, priority=priority,
             poisoned=active_injector().poisons_request(seq),
             session=session, flow_init=flow_init, fmap1=fmap1,
-            degradable=degradable)
+            degradable=degradable, trace=rid)
         fut = self._enqueue_request(req)
         self.metrics.record_stream_submit(warm)
         self.metrics.record_encoder_cache(hit=True)
@@ -1479,6 +1619,7 @@ class ServingEngine:
                     break
                 for r in left:
                     r.future.set_exception(e)
+                    self._trace_end(r, "fatal")
                 self.metrics.record_error(len(left))
         finally:
             with self._streams_lock:
@@ -1498,7 +1639,21 @@ class ServingEngine:
             inflight = self._inflight_batches
         old, new = ctl.observe(self.batcher.pending() + inflight)
         if new != old:
-            self.batcher.rebucket_low(self._brownout_bucket_for)
+            tr = self._tracer
+            on_move = None
+            if tr is not None:
+                tr.complete("brownout_level_change", 0.0,
+                            args={"from": old, "to": new},
+                            cat="brownout")
+
+                def on_move(req, new_key, _tr=tr, _new=new):
+                    if req.trace is not None:
+                        _tr.async_instant(
+                            "rebucket", req.trace,
+                            args={"level": _new,
+                                  "bucket": repr(new_key)})
+            self.batcher.rebucket_low(self._brownout_bucket_for,
+                                      on_move=on_move)
 
     def _brownout_bucket_for(self, req: QueuedRequest):
         """Rebucket mapper: the bucket a queued controller-managed LOW
@@ -1549,7 +1704,11 @@ class ServingEngine:
         # uint8 wire format the buffer itself is 4x smaller.
         i1 = self.arena.acquire(shape, r0.image1.dtype)
         i2 = self.arena.acquire(shape, r0.image1.dtype)
-        with self.stages.stage("stack", nbytes=i1.nbytes + i2.nbytes):
+        tr = self._tracer
+        with self.stages.stage("stack", nbytes=i1.nbytes + i2.nbytes), \
+                (tr.span("stack", args={"n": n, "bucket":
+                                        repr(r0.bucket)})
+                 if tr is not None else _NULL):
             for j, r in enumerate(batch):
                 i1[j] = r.image1
                 i2[j] = r.image2
@@ -1660,6 +1819,7 @@ class ServingEngine:
                     f"request spent {(now - r.t_submit) * 1e3:.1f} ms "
                     f"in queue (queue_timeout_ms="
                     f"{self.config.queue_timeout_ms})"))
+                self._trace_end(r, "timeout")
             self.metrics.record_timeout(len(expired))
             batch = [r for r in batch if not r.expired(now)]
             if not batch:
@@ -1672,13 +1832,28 @@ class ServingEngine:
                 "circuit breaker open; request drained without dispatch")
             for r in batch:
                 r.future.set_exception(exc)
+                self._trace_end(r, "fastfail")
             self.metrics.record_breaker_fastfail(len(batch))
             self.metrics.record_error(len(batch))
             return
         n = len(batch)
+        tr = self._tracer
+        if tr is not None:
+            # Queue-wait rendered retroactively, one slice per request
+            # ending now: t_submit and the tracer share a monotonic
+            # timebase, so the duration is exact even though the start
+            # predates the slice's recording.
+            t_q = time.monotonic()
+            for r in batch:
+                tr.complete("queue", t_q - r.t_submit, trace_id=r.trace,
+                            args={"priority": r.priority})
         c0 = xla_compile_count()
         try:
-            with self.stages.stage("dispatch"):
+            with self.stages.stage("dispatch"), \
+                    (tr.span("dispatch",
+                             args={"n": n,
+                                   "bucket": repr(batch[0].bucket)})
+                     if tr is not None else _NULL):
                 # Non-blocking: device_put + async dispatch. The device
                 # computes while this thread loops back to stack the
                 # next batch.
@@ -1715,10 +1890,15 @@ class ServingEngine:
         if len(batch) <= 1:
             for r in batch:
                 r.future.set_exception(cause)
+                self._trace_end(r, "error")
             self.metrics.record_error(len(batch))
             return
+        tr = self._tracer
         for r in batch:
             is_stream = r.session is not None
+            if tr is not None and r.trace is not None:
+                tr.async_instant("retry_single", r.trace,
+                                 args={"cause": type(cause).__name__})
             try:
                 if is_stream:
                     out, staged = self._dispatch_stream_arrays([r])
@@ -1740,6 +1920,7 @@ class ServingEngine:
                 # submit on that session re-primes and restarts cold.
                 # (Its staging buffers are dropped, not pooled.)
                 r.future.set_exception(e)
+                self._trace_end(r, "error")
                 self.metrics.record_error(1)
                 self.breaker.record_failure()
                 continue
@@ -1756,7 +1937,11 @@ class ServingEngine:
                       else r.padder.unpad(flow_up[0]))
             self.metrics.record_returned_bytes(result.nbytes)
             r.future.set_result(result)
-            self.metrics.record_done(time.monotonic() - r.t_submit)
+            self._trace_end(r, "ok")
+            latency = time.monotonic() - r.t_submit
+            self.metrics.record_done(latency)
+            if self.slo is not None:
+                self.slo.observe(r.priority, latency)
             self.metrics.record_isolated_retry()
             self.breaker.record_success()
 

@@ -75,6 +75,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu.observability import registry as obs_registry
+from raft_tpu.observability import tracer as tracing
 from raft_tpu.serving import health as health_mod
 from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
 from raft_tpu.serving.engine import ServingConfig, ServingEngine
@@ -294,6 +296,55 @@ class FleetMetrics:
         out["fleet_errors"] = float(errors)
         return out
 
+    def attach_registry(self, registry) -> None:
+        """Re-register the fleet readouts as live gauges on
+        ``registry`` — scalars for the totals, ``{replica=...}``-labeled
+        series for the per-replica streams. Reader-only: ``snapshot()``
+        / ``report()`` are untouched."""
+
+        def _scalar(read):
+            def fn():
+                try:
+                    return float(read())
+                except Exception:
+                    return 0.0
+            return fn
+
+        registry.gauge("fleet_replicas", help="live replica count",
+                       fn=_scalar(lambda: len(self._engines())))
+        registry.gauge("fleet_shed",
+                       help="submits no routable replica accepted",
+                       fn=_scalar(lambda: self.shed))
+        for name, table, help_ in (
+                ("fleet_routed", self.routed,
+                 "accepted submits per accepting replica"),
+                ("fleet_failovers", self.failovers,
+                 "accepted submits landing off the primary owner"),
+                ("fleet_retries", self.retries,
+                 "response-level resubmits per failing replica")):
+            def _read(t=table):
+                with self._lock:
+                    return {(rid,): float(n) for rid, n in t.items()}
+            registry.gauge(name, help=help_,
+                           labelnames=("replica",), fn=_read)
+
+        def _lat():
+            lat = self.latency_ms()
+            return {(q,): v for q, v in lat.items()}
+
+        registry.gauge("fleet_latency_ms",
+                       help="pooled fleet latency percentiles",
+                       labelnames=("quantile",), fn=_lat)
+
+        def _health():
+            return {(rid,): float(
+                health_mod.HEALTH_CODES[eng.health_state()])
+                for rid, eng in self._engines().items()}
+
+        registry.gauge("fleet_health",
+                       help="per-replica health-state code",
+                       labelnames=("replica",), fn=_health)
+
     def report(self) -> str:
         lat = self.latency_ms()
         with self._lock:
@@ -413,6 +464,11 @@ class ServingFleet:
         # routing (replicas serving a stale step take no traffic).
         self._reloader: Optional["FleetReloader"] = None
         self._closed = False
+        # Same capture-once contract as the engine: tracing is a
+        # single attribute test on the routing path when disabled.
+        self._tracer = tracing.current()
+        self.registry = obs_registry.MetricsRegistry()
+        self.metrics.attach_registry(self.registry)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -593,6 +649,22 @@ class ServingFleet:
             raise RuntimeError("fleet is closed")
         outer: concurrent.futures.Future = concurrent.futures.Future()
         outer.replica_id = None
+        # The fleet mints the request's trace id and hands it down to
+        # every engine attempt, so one Perfetto lane carries the outer
+        # fleet_request span, each attempt's request span, and the
+        # failover_hop markers between them.
+        tr = self._tracer
+        trace_id = None
+        if tr is not None:
+            trace_id = tr.mint()
+            tr.begin_async("fleet_request", trace_id,
+                           args={"priority": priority})
+            outer.add_done_callback(
+                lambda f, t=tr, i=trace_id: t.end_async(
+                    "fleet_request", i,
+                    args={"status": ("ok" if f.exception() is None
+                                     else "error"),
+                          "replica": getattr(f, "replica_id", None)}))
         bucket = self.bucket_for(image1.shape)
         if iters is not None:
             bucket = (*bucket, int(iters))
@@ -607,7 +679,7 @@ class ServingFleet:
                 bucket = sharded
         self._dispatch(outer, image1, image2, priority, bucket,
                        tried=set(), hops=0, last_exc=None,
-                       low_res=low_res)
+                       low_res=low_res, trace_id=trace_id)
         return outer
 
     def predict(self, image1: np.ndarray, image2: np.ndarray,
@@ -631,7 +703,8 @@ class ServingFleet:
 
     def _dispatch(self, outer, image1, image2, priority, bucket: Bucket,
                   tried: set, hops: int, last_exc,
-                  low_res: bool = False) -> None:
+                  low_res: bool = False,
+                  trace_id: Optional[int] = None) -> None:
         """Walk the bucket's owner-preference chain and hand the
         request to the first routable replica not yet tried. Called
         once at submit and re-entered (from a replica's completion
@@ -661,19 +734,29 @@ class ServingFleet:
                 iters = (bucket[2] if len(bucket) > 2
                          and isinstance(bucket[2], int) else None)
                 inner = engine.submit(image1, image2, priority=priority,
-                                      iters=iters, low_res=low_res)
+                                      iters=iters, low_res=low_res,
+                                      trace_id=trace_id)
             except Exception as e:
                 # Refused at the door (breaker fast-fail, backlog full,
                 # closed): try the next owner.
                 tried.add(rid)
                 last_exc = e
+                tr = self._tracer
+                if tr is not None and trace_id is not None:
+                    tr.async_instant("refused", trace_id,
+                                     args={"replica": rid,
+                                           "error": type(e).__name__})
                 continue
-            self.metrics.record_routed(
-                rid, failover=(rid != primary or hops > 0))
+            failover = (rid != primary or hops > 0)
+            self.metrics.record_routed(rid, failover=failover)
+            tr = self._tracer
+            if tr is not None and trace_id is not None and failover:
+                tr.async_instant("failover_hop", trace_id,
+                                 args={"to": rid, "hops": hops})
             inner.add_done_callback(
                 lambda f, rid=rid: self._on_reply(
                     outer, f, rid, image1, image2, priority, bucket,
-                    tried, hops, low_res))
+                    tried, hops, low_res, trace_id))
             return
         self.metrics.record_shed()
         if last_exc is None and is_mesh:
@@ -688,7 +771,8 @@ class ServingFleet:
 
     def _on_reply(self, outer, inner, rid: str, image1, image2,
                   priority, bucket: Bucket, tried: set, hops: int,
-                  low_res: bool = False) -> None:
+                  low_res: bool = False,
+                  trace_id: Optional[int] = None) -> None:
         exc = inner.exception()
         if exc is None:
             outer.replica_id = getattr(inner, "replica_id", rid)
@@ -703,10 +787,16 @@ class ServingFleet:
             return
         tried.add(rid)
         self.metrics.record_retry(rid)
+        tr = self._tracer
+        if tr is not None and trace_id is not None:
+            tr.async_instant("replica_failed", trace_id,
+                             args={"replica": rid,
+                                   "error": type(exc).__name__,
+                                   "hops": hops})
         try:
             self._dispatch(outer, image1, image2, priority, bucket,
                            tried, hops + 1, last_exc=exc,
-                           low_res=low_res)
+                           low_res=low_res, trace_id=trace_id)
         except Exception as e:   # never lose a future to a retry bug
             if not outer.done():
                 outer.replica_id = rid

@@ -344,7 +344,8 @@ def run_pair_stream_load(engine, n_streams: int, n_frames: int,
 def run_load(engine, frames, n_requests: int, concurrency: int = 8,
              references: Optional[List[np.ndarray]] = None,
              alt_references: Optional[List[np.ndarray]] = None,
-             timeout: float = 300.0) -> Dict[str, object]:
+             timeout: float = 300.0,
+             slo=None) -> Dict[str, object]:
     """Fire ``n_requests`` through ``engine`` from ``concurrency`` client
     threads (request i uses ``frames[i % len(frames)]``; each thread
     submits its next request as soon as its previous future resolves —
@@ -370,6 +371,15 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     failover resubmits — the number the client actually experiences).
     A fleet drill reads it to NAME the replica that dropped or
     corrupted a response instead of reporting an anonymous failure.
+
+    ``slo`` (an :class:`~raft_tpu.observability.slo.SloTracker`) grades
+    CLIENT-observed latency — submit → result wall time, which for a
+    fleet includes failover resubmits — against the ``"high"``
+    objective, and its ``snapshot()`` rides the result as ``"slo"``.
+    This is deliberately a second vantage point from the engine's own
+    ``slo_ms`` tracker (engine-internal queue+serve latency): an
+    objective can hold inside every replica and still be missed at the
+    client across a failover.
     """
     lock = threading.Lock()
     next_req = [0]
@@ -410,6 +420,8 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
                     _replica_stats(fut)["dropped"] += 1
                 continue
             latency = time.perf_counter() - t_req
+            if slo is not None:
+                slo.observe("high", latency)
             with lock:
                 completed[0] += 1
                 stats = _replica_stats(fut)
@@ -468,6 +480,7 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
         "batch_histogram": engine.metrics.batch_histogram(),
         "metrics": engine.metrics.snapshot(),
         "per_replica": replica_out,
+        **({"slo": slo.snapshot()} if slo is not None else {}),
     }
 
 

@@ -35,33 +35,80 @@ import time
 from collections import Counter, deque
 from typing import Dict, Optional
 
+from raft_tpu.observability.tracer import current as _tracing_current
+
 # -- XLA compile-count probe -------------------------------------------
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _compile_lock = threading.Lock()
 _compile_count = 0
+# Recent compile events (duration + module name when the monitoring
+# stream carries one) for trace attribution; bounded so an unbounded
+# compile storm can't grow host memory.
+_compile_log: deque = deque(maxlen=256)
+# Listener registration state. A DEDICATED lock, distinct from
+# _compile_lock: the old code registered while holding _compile_lock —
+# the same lock the listener callback takes — so a compile event
+# delivered on another thread during registration (or a jax build that
+# flushes buffered events to a new listener synchronously) would
+# deadlock; and two engines starting concurrently before the lazy
+# first call raced the check-then-register window on jax versions
+# where the import itself dropped the module lock. Double-checked
+# fast path + registration under _register_lock closes both: the flag
+# flips only AFTER the one registration call, and re-entry returns on
+# the first check. Double registration would double-count every
+# compile forever (each listener fires per event).
+_register_lock = threading.Lock()
 _listener_on = False
 
 
 def _on_duration_event(event: str, duration: float, **kwargs) -> None:
     global _compile_count
-    if event == _COMPILE_EVENT:
-        with _compile_lock:
-            _compile_count += 1
+    if event != _COMPILE_EVENT:
+        return
+    # jax's monitoring stream does not promise kwargs; take a module
+    # name under any of the keys observed across versions, else the
+    # slice stays anonymous.
+    module = str(kwargs.get("module_name")
+                 or kwargs.get("fingerprint") or "")
+    with _compile_lock:
+        _compile_count += 1
+        _compile_log.append((float(duration), module))
+    tr = _tracing_current()
+    if tr is not None:
+        # Retroactive slice: the event fires when the compile ENDS, so
+        # the slice is [now - duration, now] on the compiling thread's
+        # lane, named by the XLA module when known.
+        name = f"xla_compile:{module}" if module else "xla_compile"
+        tr.complete(name, duration, cat="compile",
+                    args={"module": module,
+                          "duration_s": float(duration)})
 
 
 def _ensure_listener() -> None:
-    """Register the monitoring listener once per process (lazily — the
-    counter only measures deltas, so compiles before the first call to
-    :func:`xla_compile_count` are irrelevant)."""
+    """Register the monitoring listener exactly once per process
+    (lazily — the counter only measures deltas, so compiles before the
+    first call to :func:`xla_compile_count` are irrelevant).
+    Thread-safe under concurrent engine startup: see the
+    ``_register_lock`` note above."""
     global _listener_on
-    with _compile_lock:
+    if _listener_on:               # fast path: flag set post-register
+        return
+    with _register_lock:
         if _listener_on:
             return
         from jax import monitoring
 
         monitoring.register_event_duration_secs_listener(_on_duration_event)
         _listener_on = True
+
+
+def compile_events(n: int = 256) -> list:
+    """The last ``n`` observed backend compiles as ``(duration_s,
+    module_name)`` tuples (module name ``""`` when the jax version's
+    monitoring stream doesn't carry one)."""
+    with _compile_lock:
+        return list(_compile_log)[-n:]
 
 
 def xla_compile_count() -> int:
@@ -422,6 +469,112 @@ class ServingMetrics:
         SLO readout (full-quality count vs the degraded ladder's)."""
         with self._lock:
             return dict(self.quality_hist)
+
+    def attach_registry(self, registry) -> None:
+        """Re-register this bag's live values as typed instruments on
+        a :class:`~raft_tpu.observability.registry.MetricsRegistry` —
+        callable-backed gauges reading the SAME counters ``snapshot()``
+        reads, so the two expositions can never drift and this class's
+        public surface (``snapshot``/``report``) is unchanged. Dynamic
+        families (quality histogram, engine-wired gauge sources) become
+        labeled gauges instead of dynamic names, so the registry's
+        instrument set stays pinnable."""
+        g = registry.gauge
+        for name, attr, help_ in (
+                ("serving_requests", "requests", "accepted submits"),
+                ("serving_rejected", "rejected",
+                 "rejections (sheds + closed-engine refusals)"),
+                ("serving_shed", "sheds", "BacklogFull load-sheds"),
+                ("serving_responses", "responses",
+                 "futures resolved with a result"),
+                ("serving_errors", "errors",
+                 "futures resolved with an exception"),
+                ("serving_timeouts", "timeouts",
+                 "queue-deadline expiries"),
+                ("serving_batches", "batches", "dispatched batches"),
+                ("serving_padded_slots", "padded_slots",
+                 "tail-padding waste (slots)"),
+                ("serving_compiles", "compiles",
+                 "fresh XLA compiles on the serve path"),
+                ("serving_queue_depth_peak", "queue_depth_peak",
+                 "peak backlog depth"),
+                ("serving_swaps", "swaps", "hot reloads served live"),
+                ("serving_rollbacks", "rollbacks",
+                 "canary-failed reloads rolled back"),
+                ("serving_isolated_retries", "isolated_retries",
+                 "batch-failure singles that served"),
+                ("serving_breaker_fastfails", "breaker_fastfails",
+                 "requests failed fast while breaker OPEN"),
+                ("serving_sharded_requests", "sharded_requests",
+                 "submits routed to the spatially-sharded path"),
+                ("serving_warm_requests", "warm_requests",
+                 "warm stream pairs"),
+                ("serving_cold_stream_requests", "cold_stream_requests",
+                 "cold stream pairs"),
+                ("serving_encoder_hits", "encoder_hits",
+                 "encoder fmap cache hits"),
+                ("serving_encoder_misses", "encoder_misses",
+                 "encoder fmap cache misses (primes)"),
+                ("serving_early_exit_iters_saved",
+                 "early_exit_iters_saved",
+                 "refine iterations skipped by convergence early exit"),
+                ("serving_staged_bytes", "staged_bytes",
+                 "bytes memcpy'd into the staging arena"),
+                ("serving_returned_bytes", "returned_bytes",
+                 "bytes returned through resolved futures")):
+            g(name, help=help_,
+              fn=(lambda a=attr: float(getattr(self, a))))
+        g("serving_requests_by_class",
+          help="accepted submits per priority class",
+          labelnames=("class",),
+          fn=lambda: {(c,): float(n)
+                      for c, n in self.requests_by_class.items()})
+        g("serving_shed_by_class",
+          help="load-sheds per priority class", labelnames=("class",),
+          fn=lambda: {(c,): float(n)
+                      for c, n in self.sheds_by_class.items()})
+        g("serving_quality_iters",
+          help="responses served per GRU iteration level",
+          labelnames=("iters",),
+          fn=lambda: {(str(k),): float(v)
+                      for k, v in self.quality_histogram().items()})
+        g("serving_batch_size",
+          help="dispatched batches per real-request count",
+          labelnames=("size",),
+          fn=lambda: {(str(k),): float(v)
+                      for k, v in self.batch_histogram().items()})
+        g("serving_latency_ms",
+          help="rolling-window latency percentiles",
+          labelnames=("quantile",),
+          fn=lambda: {(q,): v for q, v in self.latency_ms().items()})
+        g("serving_throughput_rps",
+          help="responses per second of serving wall time",
+          fn=self.throughput)
+        g("serving_mean_batch_size",
+          help="mean real requests per dispatched batch",
+          fn=self.mean_batch_size)
+        g("serving_encoder_cache_hit_rate",
+          help="encoder fmap cache hit rate",
+          fn=lambda: (self.encoder_hits
+                      / (self.encoder_hits + self.encoder_misses)
+                      if (self.encoder_hits + self.encoder_misses)
+                      else 0.0))
+
+        def _gauges():
+            with self._lock:
+                sources = dict(self._gauge_sources)
+            out = {}
+            for name, fn in sources.items():
+                try:
+                    out[(name,)] = float(fn())
+                except Exception:
+                    out[(name,)] = 0.0
+            return out
+
+        g("serving_gauge",
+          help="engine-wired live gauges (queue depth, inflight "
+               "batches, breaker trips, health code, brownout level)",
+          labelnames=("name",), fn=_gauges)
 
     def write_to(self, train_logger, step: Optional[int] = None) -> None:
         """Stream the snapshot through the existing scalar sinks

@@ -95,10 +95,22 @@ class StreamSession:
         # resolving the future we are waiting on.
         pending = self._pending
         if pending is not None:
-            try:
-                pending.result()
-            except Exception:
-                pass
+            tr = getattr(self.engine, "_tracer", None)
+            if tr is not None:
+                # How long this stream's next frame blocked on its
+                # predecessor — the stream-serialization stall the
+                # warm-start handoff imposes.
+                with tr.span("stream_serialize",
+                             args={"stream": self.stream_id}):
+                    try:
+                        pending.result()
+                    except Exception:
+                        pass
+            else:
+                try:
+                    pending.result()
+                except Exception:
+                    pass
         with self._lock:
             self._pending = None
             frame = np.ascontiguousarray(frame)

@@ -235,6 +235,13 @@ def train(tcfg: TrainConfig, mcfg: RAFTConfig, *,
         check_every = 1 if jax.process_count() == 1 else 10
         consecutive_skips = 0
         loader_stats = getattr(dataloader, "stats", None)
+        if loader_stats is not None and \
+                hasattr(loader_stats, "attach_registry"):
+            # Degradation counters onto the same process registry the
+            # checkpointer's save/restore timings land on — one
+            # telemetry surface for the whole run.
+            from raft_tpu.observability import get_registry
+            loader_stats.attach_registry(get_registry())
         # Counter deltas must start from the RESTORED totals, not zero —
         # otherwise the first post-resume step logs the whole history as
         # one spurious spike.

@@ -184,6 +184,23 @@ class TrainLogger:
                 from raft_tpu.utils.tb_events import EventWriter
                 self._tb = EventWriter(log_dir)
         self._t0 = time.time()
+        # The same run totals, live on the process telemetry registry
+        # (one labeled gauge family; the JSONL/TensorBoard stream stays
+        # the canonical artifact — this is the scrape surface).
+        try:
+            from raft_tpu.observability import get_registry
+            get_registry().gauge(
+                "train_counters",
+                help="run-total degradation counters from the train "
+                     "logger",
+                labelnames=("counter",),
+                fn=lambda: ({(k,): float(v)
+                             for k, v in self.counters.items()}
+                            or {(k,): 0.0 for k in self.COUNTER_KEYS}))
+        except ValueError:
+            # A second TrainLogger in one process (tests): the family
+            # already exists; the first logger keeps the binding.
+            pass
 
     def _status(self, lr: Optional[float]) -> str:
         rate = self.sum_freq / max(time.time() - self._t0, 1e-9)

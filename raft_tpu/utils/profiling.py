@@ -136,6 +136,30 @@ def trace(logdir: Optional[str] = None):
         yield t
 
 
+@contextlib.contextmanager
+def profiled_span(name: str, logdir: Optional[str] = None, tracer=None):
+    """Bridge a device-profiler capture into the request tracer: run
+    ``jax.profiler`` over the with-block AND record the block as one
+    named slice on the observability tracer, with the profiler logdir
+    in the slice args — the trace artifact then says exactly which
+    wall-clock window the xplane capture covers.
+
+    ``tracer`` defaults to the process tracer
+    (:func:`raft_tpu.observability.current_tracer`); with tracing
+    disabled this is just :func:`trace`. Yields the :func:`trace`
+    object (``.logdir``)."""
+    if tracer is None:
+        from raft_tpu.observability.tracer import current
+        tracer = current()
+    with trace(logdir) as t:
+        if tracer is None:
+            yield t
+        else:
+            with tracer.span(name, args={"logdir": t.logdir},
+                             cat="profiler"):
+                yield t
+
+
 def _load_xspace(logdir: str):
     # The xplane proto moved across TF releases; try the known homes.
     XSpace, last_err = None, None

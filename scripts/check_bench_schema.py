@@ -22,6 +22,11 @@ a payload that drops the honesty keys:
     one smoke-honesty key — ``smoke_operating_point`` or
     ``criterion_note`` — naming what the number does and does not
     claim. TPU captures need no disclaimer; they ARE the claim.
+  - an optional ``trace_artifact`` key (written by ``bench.py serving
+    --trace``) must be a path to an existing Chrome trace-event JSON
+    file (top-level object with a ``traceEvents`` list) — a claimed
+    trace that doesn't exist or doesn't load in Perfetto is a
+    violation, same spirit as a faked value.
 
 Run directly (``python scripts/check_bench_schema.py``, nonzero exit on
 any violation) or through the fast test ``tests/test_bench_schema.py``.
@@ -39,12 +44,34 @@ from typing import List
 SMOKE_HONESTY_KEYS = ("smoke_operating_point", "criterion_note")
 
 
+def _check_trace_artifact(path) -> List[str]:
+    """Validate a payload's optional ``trace_artifact`` reference: the
+    path must exist and parse as Chrome trace-event JSON (an object
+    carrying a ``traceEvents`` list)."""
+    if not isinstance(path, str) or not path:
+        return ["'trace_artifact' must be a non-empty path string"]
+    if not os.path.isfile(path):
+        return [f"'trace_artifact' path does not exist: {path!r}"]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"'trace_artifact' is not readable JSON ({e})"]
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        return ["'trace_artifact' is not Chrome trace-event JSON "
+                "(needs a 'traceEvents' list)"]
+    return []
+
+
 def check_payload(name: str, payload: dict) -> List[str]:
     """Validate one bench payload dict; returns a list of violations
     (empty = clean)."""
     problems = []
     if not isinstance(payload.get("metric"), str) or not payload["metric"]:
         problems.append("missing/empty 'metric'")
+    if "trace_artifact" in payload:
+        problems.extend(_check_trace_artifact(payload["trace_artifact"]))
     if payload.get("error") is not None:
         # Honest failure record: named error, no fabricated value.
         if not isinstance(payload["error"], str) or not payload["error"]:

@@ -63,6 +63,17 @@
    bit-matches the reference 1/8-grid flow and host-upsamples back to
    the full frame shape.
 
+10. trace (``--drill trace``) — request-scoped tracing under the full
+    traffic mix: a brownout-ladder engine serves batched HIGH traffic
+    plus a LOW burst, then a 3-replica fleet takes batched load with a
+    mid-load replica kill (one injected failover) and streaming
+    sessions — all with tracing ON. Writes ``/tmp/raft_trace.json``
+    and gates on: well-formed Chrome trace-event JSON, every opened
+    request root span closed (``open_flows() == []``), failover hops
+    visible as ``failover_hop`` instants on the request track, and
+    ZERO post-warmup XLA compiles with tracing enabled (tracing must
+    not perturb the executable cache).
+
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
 bit-identical; under a forced multi-device topology
@@ -1119,6 +1130,155 @@ def drill_wire(root):
         fleet.close()
 
 
+def drill_trace(root):
+    """Tracing ON under the full traffic mix (batched + LOW burst +
+    fleet kill + streams): /tmp/raft_trace.json is well-formed Chrome
+    trace JSON, every request root span closes, failover hops are
+    visible, and zero post-warmup compiles with tracing enabled."""
+    import json
+
+    from raft_tpu.observability import disable_tracing, enable_tracing
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine, loadgen, make_fleet)
+
+    trace_path = "/tmp/raft_trace.json"
+    # Enabled BEFORE any engine exists: engines capture the tracer at
+    # __init__, never retroactively.
+    tracer = enable_tracing()
+    try:
+        predictor = _make_predictor()
+        # -- Phase A: brownout-ladder engine, batched HIGH + LOW burst.
+        a_shapes = [(36, 60), (33, 57)]   # one shared (40, 64) bucket
+        a_frames = loadgen.make_frames(a_shapes, per_shape=2, seed=83)
+        a_refs, ref_kind = _references(predictor, a_frames, max_batch=4)
+        engine = ServingEngine(predictor, ServingConfig(
+            max_batch=4, max_wait_ms=3.0, buckets=(a_shapes[0],),
+            iters_ladder=(1,), brownout_high_water=4,
+            brownout_low_water=1, brownout_dwell_ms=50.0,
+            slo_ms=(("high", 5000.0), ("low", 10000.0))))
+        assert engine._tracer is tracer, \
+            "engine did not capture the enabled tracer at init"
+        engine.warmup()
+
+        # -- Phase B: 3-replica fleet for the injected failover + streams.
+        b_frames = loadgen.make_frames(SHAPES, per_shape=2, seed=84)
+        b_refs, _ = _references(predictor, b_frames, max_batch=4)
+        stream_shape = (36, 60)
+        fleet = make_fleet(predictor, 3, ServingConfig(
+            max_batch=4, max_wait_ms=3.0, buckets=BUCKETS,
+            warm_buckets=(stream_shape,), warm_iters=1,
+            breaker_threshold=2, breaker_cooldown_s=120.0))
+        fleet.start(warm_spares=True)
+        victim = next(rid for rid, bs in fleet.assignments().items()
+                      if bs)
+
+        engine.start(warmup=False)
+        with CompileWatch() as watch:
+            # Phase A traffic: closed-loop HIGH load with bit-exact
+            # references, then a fire-at-once LOW burst deep enough to
+            # dwell past the brownout high-water mark.
+            res_a = loadgen.run_load(engine, a_frames, n_requests=40,
+                                     concurrency=8, references=a_refs)
+            burst = [engine.submit(*a_frames[i % len(a_frames)],
+                                   priority="low") for i in range(36)]
+            for f in burst:
+                f.result(120)   # completion only; LOW may be degraded
+            engine.close()
+
+            # Phase B traffic: kill the victim bucket-owner mid-load —
+            # the re-dispatches are the injected failover hops.
+            out_b = {}
+
+            def load_b():
+                out_b.update(loadgen.run_load(
+                    fleet, b_frames, n_requests=90, concurrency=16,
+                    references=b_refs, timeout=120.0))
+
+            loader = threading.Thread(target=load_b, name="trace-load")
+            loader.start()
+            _await_metric(
+                lambda: sum(e.metrics.responses
+                            for e in fleet.engines.values()),
+                20, 120, "fleet responses before kill")
+            fleet.kill_replica(victim)
+            loader.join(300)
+            assert not loader.is_alive(), "load generator wedged"
+            # Streaming sessions on the degraded fleet: warm-start /
+            # prime / serialize spans land on the same timeline.
+            res_s = loadgen.run_stream_load(fleet, n_streams=2,
+                                            n_frames=6,
+                                            shape=stream_shape,
+                                            timeout=120.0)
+            fleet.close()
+
+        assert res_a["completed"] == 40 and not res_a["mismatched"], \
+            f"phase A: {res_a['completed']}/40 completed, " \
+            f"mismatched {res_a['mismatched']}"
+        assert out_b["completed"] == 90 and not out_b["dropped"], \
+            f"phase B: completed {out_b.get('completed')}, " \
+            f"dropped {out_b.get('dropped')}"
+        assert not out_b["mismatched"], \
+            f"bit-incorrect under tracing: {out_b['mismatched']}"
+        assert res_s["dropped"] == 0, f"streams dropped {res_s['dropped']}"
+        assert watch.compiles == 0, \
+            f"{watch.compiles} fresh XLA compile(s) after warmup with " \
+            "tracing enabled — tracing perturbed the executable cache"
+
+        # Every opened root span resolved (engines closed above).
+        assert tracer.open_flows() == [], \
+            f"unclosed request spans: {tracer.open_flows()}"
+        assert tracer.dropped == 0, \
+            f"ring overflowed ({tracer.dropped} dropped) at default " \
+            "capacity — the drill should fit comfortably"
+
+        written = tracer.write(trace_path)
+        with open(written) as f:
+            doc = json.load(f)
+        assert isinstance(doc, dict) and isinstance(
+            doc.get("traceEvents"), list) and doc["traceEvents"], \
+            "trace artifact is not Chrome trace-event JSON"
+        assert "dropped_events" in doc.get("otherData", {}), doc.keys()
+        for ev in doc["traceEvents"]:
+            need = ({"name", "ph"} if ev.get("ph") == "M"
+                    else {"name", "ph", "ts"})   # metadata has no ts
+            assert need <= set(ev), f"malformed event {ev}"
+            assert "_seq" not in ev, "internal ring bookkeeping leaked"
+        names = {ev["name"] for ev in doc["traceEvents"]}
+        for want in ("request", "fleet_request", "queue", "dispatch",
+                     "pad", "stack", "sync", "unpad", "xla_compile"):
+            assert want in names, f"no '{want}' slice in the trace"
+        # The artifact itself balances: per async id, begins == ends.
+        open_by_id = {}
+        for ev in doc["traceEvents"]:
+            if ev["ph"] in ("b", "e"):
+                k = (ev.get("cat"), ev["name"], ev.get("id"))
+                open_by_id[k] = open_by_id.get(k, 0) + (
+                    1 if ev["ph"] == "b" else -1)
+        unbalanced = {k: v for k, v in open_by_id.items() if v}
+        assert not unbalanced, f"unbalanced async spans: {unbalanced}"
+        hops = sum(ev["name"] == "failover_hop"
+                   for ev in doc["traceEvents"])
+        assert hops >= 1, "replica kill produced no failover_hop events"
+        n_roots = sum(ev["ph"] == "b" and ev["name"] == "request"
+                      for ev in doc["traceEvents"])
+        statuses = sorted({ev.get("args", {}).get("status")
+                           for ev in doc["traceEvents"]
+                           if ev["ph"] == "e" and ev["name"] == "request"})
+        brownout_evs = sum(ev.get("cat") == "brownout"
+                           for ev in doc["traceEvents"])
+        print(f"  {len(doc['traceEvents'])} events -> {written} "
+              f"({tracer.recorded} recorded, 0 dropped); reference = "
+              f"{ref_kind}")
+        print(f"  {n_roots} request root spans (statuses {statuses}), "
+              f"{hops} failover hop(s), {brownout_evs} brownout "
+              f"event(s), {res_s['steady_pairs']} steady stream pairs, "
+              f"0 post-warmup compiles")
+    finally:
+        # Process-global: later drills in an --drill all run must come
+        # up untraced (engines capture at init).
+        disable_tracing()
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1129,6 +1289,7 @@ DRILLS = [
     drill_pallas_kernels,
     drill_highres,
     drill_wire,
+    drill_trace,
 ]
 
 

@@ -34,6 +34,24 @@ def test_checker_rejects_fabricated_values():
                                "unit": "x", "platform": "tpu"})
 
 
+def test_checker_validates_trace_artifact(tmp_path):
+    base = {"metric": "m", "value": 1.0, "unit": "x", "platform": "tpu"}
+    trace = tmp_path / "trace.json"
+    trace.write_text('{"traceEvents": [], "displayTimeUnit": "ms"}')
+    assert not check_payload("ok", dict(base, trace_artifact=str(trace)))
+    # Missing file, non-string, non-JSON, and JSON-but-not-a-trace all
+    # fail — a claimed trace must actually load in Perfetto.
+    assert check_payload("gone", dict(
+        base, trace_artifact=str(tmp_path / "nope.json")))
+    assert check_payload("type", dict(base, trace_artifact=7))
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json {")
+    assert check_payload("garbled", dict(base, trace_artifact=str(bad)))
+    notrace = tmp_path / "notrace.json"
+    notrace.write_text('{"events": []}')
+    assert check_payload("shape", dict(base, trace_artifact=str(notrace)))
+
+
 def test_checker_rejects_silent_empty_wrapper(tmp_path):
     p = tmp_path / "BENCH_rX.json"
     p.write_text('{"cmd": "python bench.py", "rc": 0, "parsed": null}')

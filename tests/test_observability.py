@@ -1,0 +1,372 @@
+"""Observability suite: the Tracer ring + Chrome trace export, the
+typed MetricsRegistry (Prometheus text / JSON / HTTP scrape), the
+SloTracker, and the serving integration contracts:
+
+* golden pins of the ServingMetrics ``snapshot()`` keys and the
+  engine registry's instrument names — renames and silent drops of
+  telemetry the dashboards scrape must show up as a diff here;
+* the zero-cost disabled path — an engine built with tracing OFF makes
+  ZERO tracer calls even when a tracer is enabled later in the process
+  (capture-at-init), and its served output is bit-identical to a traced
+  engine's.
+
+Tracer/registry/SLO tests are pure stdlib; the engine tests use the
+same tiny random-weights predictor as test_serving.py."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu.observability import (MetricsRegistry, SloTracker, Tracer,
+                                    start_http_server)
+from raft_tpu.observability import tracer as tracing
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_complete_and_span_events(self):
+        tr = Tracer()
+        tr.complete("stage", 0.010, args={"n": 3})
+        with tr.span("inner"):
+            time.sleep(0.001)
+        evs = [e for e in tr.events() if e["ph"] == "X"]
+        by_name = {e["name"]: e for e in evs}
+        assert set(by_name) == {"stage", "inner"}
+        assert by_name["stage"]["dur"] == pytest.approx(10_000, rel=0.01)
+        assert by_name["stage"]["args"] == {"n": 3}
+        assert by_name["inner"]["dur"] >= 900      # >= ~0.9 ms in us
+        # Retroactive slices may start before the first now_us() call
+        # (ts = end - dur), but start + dur is always self-consistent.
+        assert by_name["stage"]["ts"] + by_name["stage"]["dur"] >= 0
+        for e in evs:
+            assert "_seq" not in e
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.complete(f"e{i}", 0.0)
+        assert tr.recorded == 20
+        assert tr.dropped == 12
+        evs = [e for e in tr.events() if e["ph"] == "X"]
+        assert len(evs) == 8
+        # Oldest events were overwritten; the survivors are the tail.
+        assert {e["name"] for e in evs} == {f"e{i}" for i in range(12, 20)}
+        assert tr.chrome_trace()["otherData"]["dropped_events"] == 12
+
+    def test_events_sorted_and_thread_metadata(self):
+        tr = Tracer()
+        tr.complete("b", 0.0, end_ts_us=500.0)
+        tr.complete("a", 0.0, end_ts_us=100.0)
+        xs = [e for e in tr.events() if e["ph"] == "X"]
+        assert [e["name"] for e in xs] == ["a", "b"]
+        metas = [e for e in tr.chrome_trace()["traceEvents"]
+                 if e["ph"] == "M"]
+        assert metas and all(e["name"] == "thread_name" for e in metas)
+
+    def test_mint_is_unique_across_threads(self):
+        tr = Tracer()
+        out = []
+
+        def mint_many():
+            out.extend(tr.mint() for _ in range(200))
+
+        threads = [threading.Thread(target=mint_many) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(out)) == len(out) == 800
+
+    def test_async_flows_open_and_close(self):
+        tr = Tracer()
+        rid = tr.mint()
+        tr.begin_async("request", rid, args={"priority": "high"})
+        assert tr.open_flows() == [("request", rid)]
+        tr.async_instant("retry_single", rid)
+        tr.end_async("request", rid, args={"status": "ok"})
+        assert tr.open_flows() == []
+        phases = [e["ph"] for e in tr.events() if e.get("id") == rid]
+        assert phases == ["b", "n", "e"]
+        end = [e for e in tr.events() if e["ph"] == "e"][0]
+        assert end["cat"] == "request"
+        assert end["args"] == {"status": "ok"}
+
+    def test_write_round_trips_chrome_json(self, tmp_path):
+        tr = Tracer()
+        tr.complete("x", 0.001)
+        path = tr.write(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["dropped_events"] == 0
+        assert doc["otherData"]["capacity"] == tr.capacity
+
+    def test_module_enable_is_idempotent_and_disable_clears(self):
+        assert tracing.current() is None
+        try:
+            tr = tracing.enable(capacity=128)
+            assert tracing.current() is tr
+            assert tracing.enable() is tr       # idempotent
+        finally:
+            tracing.disable()
+        assert tracing.current() is None
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs", help="requests", labelnames=("cls",))
+        c.inc(cls="a")
+        c.inc(2.0, cls="a")
+        c.inc(cls="b")
+        snap = reg.json_snapshot()
+        assert snap['reqs{cls="a"}'] == 3.0
+        assert snap['reqs{cls="b"}'] == 1.0
+
+    def test_gauge_fn_and_broken_fn_reads_zero(self):
+        reg = MetricsRegistry()
+        reg.gauge("ok", help="h", fn=lambda: 7.0)
+        reg.gauge("boom", help="h", fn=lambda: 1 / 0)
+        snap = reg.json_snapshot()
+        assert snap["ok"] == 7.0
+        assert snap["boom"] == 0.0          # collection never raises
+
+    def test_gauge_first_fn_binding_wins(self):
+        reg = MetricsRegistry()
+        g1 = reg.gauge("g", help="h", fn=lambda: 1.0)
+        g2 = reg.gauge("g", help="h", fn=lambda: 2.0)
+        assert g1 is g2
+        assert reg.json_snapshot()["g"] == 1.0
+        # A set-style gauge registered first DOES late-bind.
+        reg.gauge("late", help="h").set(5.0)
+        reg.gauge("late", help="h", fn=lambda: 9.0)
+        assert reg.json_snapshot()["late"] == 9.0
+
+    def test_name_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("n", help="h")
+        with pytest.raises(ValueError):
+            reg.gauge("n", help="h")                  # kind mismatch
+        with pytest.raises(ValueError):
+            reg.counter("n", help="h", labelnames=("x",))  # label mismatch
+        assert reg.counter("n", help="h") is reg.counter("n", help="h")
+
+    def test_histogram_prometheus_rendering(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", help="h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = reg.prometheus_text()
+        assert "# HELP lat h" in text
+        assert "# TYPE lat histogram" in text
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 2' in text
+        assert 'lat_bucket{le="+Inf"} 3' in text
+        assert "lat_count 3" in text
+        assert "lat_sum 5.55" in text
+
+    def test_names_sorted(self):
+        reg = MetricsRegistry()
+        reg.counter("b", help="h")
+        reg.gauge("a", help="h")
+        assert reg.names() == ["a", "b"]
+
+    def test_http_scrape_endpoints(self):
+        reg = MetricsRegistry()
+        reg.counter("hits", help="h").inc(3.0)
+        server = start_http_server(reg, port=0)
+        try:
+            port = server.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "hits 3" in text
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/metrics.json").read().decode())
+            assert doc["hits"] == 3.0
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SloTracker
+# ---------------------------------------------------------------------------
+
+class TestSlo:
+    def test_violation_ratio_and_snapshot(self):
+        slo = SloTracker({"high": 100.0})
+        assert slo.observe("high", 0.050) is False
+        assert slo.observe("high", 0.250) is True
+        assert slo.observe("high", 0.020) is False
+        assert slo.violation_ratio("high") == pytest.approx(1 / 3)
+        snap = slo.snapshot()
+        assert snap["slo_high_objective_ms"] == 100.0
+        assert snap["slo_high_observed"] == 3.0
+        assert snap["slo_high_violations"] == 1.0
+        # Unknown class: observed but never a violation.
+        assert slo.observe("other", 99.0) is False
+
+    def test_registry_gauges(self):
+        reg = MetricsRegistry()
+        slo = SloTracker({"high": 100.0, "low": 500.0})
+        slo.attach_registry(reg)
+        slo.observe("high", 0.250)
+        snap = reg.json_snapshot()
+        # Objectives render for every configured class; the rolling
+        # series appear per class as observations arrive.
+        assert snap['slo_objective_ms{class="high"}'] == 100.0
+        assert snap['slo_objective_ms{class="low"}'] == 500.0
+        assert snap['slo_violation_ratio{class="high"}'] == 1.0
+        assert snap['slo_observed{class="high"}'] == 1.0
+        assert snap['slo_violations{class="high"}'] == 1.0
+        assert "slo_violation_ratio" in reg.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: golden pins + the zero-cost disabled path
+# ---------------------------------------------------------------------------
+
+# The scrape surfaces the dashboards depend on. A rename, drop, or
+# accidental addition must show up as an explicit diff in these pins.
+SNAPSHOT_KEYS = [
+    "serving_batches", "serving_breaker_fastfails",
+    "serving_cold_stream_requests", "serving_compiles",
+    "serving_early_exit_iters_saved", "serving_encoder_cache_hit_rate",
+    "serving_encoder_hits", "serving_encoder_misses", "serving_errors",
+    "serving_isolated_retries", "serving_latency_mean_ms",
+    "serving_latency_p50_ms", "serving_latency_p95_ms",
+    "serving_latency_p99_ms", "serving_mean_batch_size",
+    "serving_padded_slots", "serving_queue_depth_peak",
+    "serving_rejected", "serving_requests", "serving_requests_high",
+    "serving_requests_low", "serving_responses",
+    "serving_returned_bytes", "serving_rollbacks",
+    "serving_sharded_requests", "serving_shed", "serving_shed_high",
+    "serving_shed_low", "serving_staged_bytes", "serving_swaps",
+    "serving_throughput_rps", "serving_timeouts",
+    "serving_warm_requests",
+]
+
+# Live gauges the engine registers on top of the counter bag.
+ENGINE_GAUGE_KEYS = [
+    "serving_breaker_trips", "serving_health_state",
+    "serving_inflight_batches", "serving_queue_depth",
+    "serving_sharded_shards",
+]
+
+REGISTRY_NAMES = [
+    "serving_batch_size", "serving_batches", "serving_breaker_fastfails",
+    "serving_cold_stream_requests", "serving_compiles",
+    "serving_early_exit_iters_saved", "serving_encoder_cache_hit_rate",
+    "serving_encoder_hits", "serving_encoder_misses", "serving_errors",
+    "serving_gauge", "serving_isolated_retries", "serving_latency_ms",
+    "serving_mean_batch_size", "serving_padded_slots",
+    "serving_quality_iters", "serving_queue_depth_peak",
+    "serving_rejected", "serving_requests", "serving_requests_by_class",
+    "serving_responses", "serving_returned_bytes", "serving_rollbacks",
+    "serving_sharded_requests", "serving_shed", "serving_shed_by_class",
+    "serving_staged_bytes", "serving_swaps", "serving_throughput_rps",
+    "serving_timeouts", "serving_warm_requests",
+]
+
+SLO_NAMES = ["slo_objective_ms", "slo_observed", "slo_violation_ratio",
+             "slo_violations"]
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    return load_predictor("random", small=True, iters=2)
+
+
+@pytest.fixture(scope="module")
+def frame():
+    rng = np.random.default_rng(7)
+    shape = (36, 60, 3)
+    return (rng.integers(0, 255, shape).astype(np.uint8),
+            rng.integers(0, 255, shape).astype(np.uint8))
+
+
+class TestServingIntegration:
+    def test_snapshot_keys_golden_pin(self):
+        from raft_tpu.serving.metrics import ServingMetrics
+        assert sorted(ServingMetrics().snapshot()) == SNAPSHOT_KEYS
+
+    def test_engine_registry_names_golden_pin(self, predictor):
+        from raft_tpu.serving import ServingConfig, ServingEngine
+        eng = ServingEngine(predictor, ServingConfig(
+            max_batch=2, max_wait_ms=3.0, buckets=((36, 60),)))
+        assert sorted(eng.metrics.snapshot()) == sorted(
+            SNAPSHOT_KEYS + ENGINE_GAUGE_KEYS)
+        assert eng.registry.names() == REGISTRY_NAMES
+        assert eng.slo is None and eng.metrics_server is None
+        eng_slo = ServingEngine(predictor, ServingConfig(
+            max_batch=2, max_wait_ms=3.0, buckets=((36, 60),),
+            slo_ms=(("high", 1000.0),)))
+        assert eng_slo.registry.names() == sorted(
+            REGISTRY_NAMES + SLO_NAMES)
+        # Per-engine registries: incrementing one never leaks into the
+        # other (no process-global gauge fights between replicas).
+        assert eng.registry is not eng_slo.registry
+
+    def test_disabled_path_makes_zero_tracer_calls(self, predictor,
+                                                   frame):
+        """Capture-at-init zero-cost contract: an engine built with no
+        tracer enabled mints nothing and records nothing — even if a
+        tracer is enabled AFTER init — and serves bit-identically to a
+        traced engine."""
+        from raft_tpu.serving import ServingConfig, ServingEngine
+
+        assert tracing.current() is None
+        cfg = dict(max_batch=2, max_wait_ms=3.0, buckets=((36, 60),))
+        eng = ServingEngine(predictor, ServingConfig(**cfg))
+        assert eng._tracer is None
+        try:
+            eng.start()
+            # First request untraced — this is also where the bucket
+            # executable compiles, so the enable() below can't pick up
+            # compile slices from the global listener feed.
+            flow_plain = eng.submit(*frame).result(120)
+            late = tracing.enable()       # AFTER init: must not retrofit
+            assert eng._tracer is None
+            flow_plain2 = eng.submit(*frame).result(120)
+            eng.close()
+            # The enabled-but-uncaptured tracer saw zero activity from
+            # the disabled engine: no spans, no minted ids, no flows.
+            assert late.recorded == 0 and late.open_flows() == []
+            assert np.array_equal(flow_plain, flow_plain2)
+        finally:
+            tracing.disable()
+
+        # Traced engine over the same frame: output bit-identical, root
+        # span closed ok with the queue/dispatch slices on the timeline.
+        tr = tracing.enable()
+        try:
+            eng2 = ServingEngine(predictor, ServingConfig(**cfg))
+            assert eng2._tracer is tr
+            eng2.start()
+            flow_traced = eng2.submit(*frame).result(120)
+            eng2.close()
+        finally:
+            tracing.disable()
+        assert np.array_equal(flow_plain, flow_traced), \
+            "tracing changed the served output"
+        assert tr.open_flows() == []
+        names = {e["name"] for e in tr.events()}
+        assert {"request", "queue", "dispatch", "pad", "stack",
+                "sync", "unpad"} <= names
+        ends = [e for e in tr.events()
+                if e["ph"] == "e" and e["name"] == "request"]
+        assert [e["args"]["status"] for e in ends] == ["ok"]
