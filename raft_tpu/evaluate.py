@@ -479,16 +479,47 @@ class FlowPredictor:
 
     def _require_session_path(self, what: str) -> None:
         from raft_tpu.models.raft import RAFT
-        if self.mesh is not None:
-            raise ValueError(
-                f"the streaming {what} path is not supported with "
-                "spatially-sharded eval — the cached feature maps would "
-                "need their own sharding specs")
         if not isinstance(self.model, RAFT):
             raise ValueError(
                 f"the streaming {what} path applies to the canonical "
                 "RAFT family only (other families have no split "
                 "encode/refine entry point)")
+
+    def _session_mesh(self, shape, what: str):
+        """Resolve the session entry points' spatial-sharding context:
+        ``(mesh_key, n_sp, n_dt)`` for a meshed predictor (the cached
+        per-session feature maps get row-sharding specs like
+        ``flow_init``'s — the round-6 refusal, closed), or ``(None, 1,
+        1)`` unsharded. The /8 feature rows must divide the spatial
+        axis — the same divisibility the warm sharded family already
+        requires — so indivisible heights fail loudly here instead of
+        surfacing as a GSPMD error mid-stream."""
+        if self.mesh is None:
+            return None, 1, 1
+        from raft_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+        n_sp = self.mesh.shape[SPATIAL_AXIS]
+        n_dt = self.mesh.shape.get(DATA_AXIS, 1)
+        if int(shape[1]) % (n_sp * 8) != 0:
+            raise ValueError(
+                f"the streaming {what} path over spatially-sharded eval "
+                f"needs padded rows divisible by spatial_shards*8 = "
+                f"{n_sp * 8} (the cached fmaps are row-sharded at 1/8 "
+                f"resolution), got H={shape[1]}")
+        mesh_key = (n_dt, n_sp,
+                    tuple(d.id for d in self.mesh.devices.flat))
+        return mesh_key, n_sp, n_dt
+
+    def _session_shardings(self, n_args: int):
+        """``in_shardings`` for a meshed session executable: variables
+        replicated, every array argument (images, fmaps, flow_init)
+        row-sharded with the images' (data, spatial) spec — fmaps live
+        at 1/8 resolution, same layout rationale as ``spatial_jit
+        (warm_init=True)``'s flow_init spec."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from raft_tpu.parallel.spatial import image_spec
+        ispec = NamedSharding(self.mesh, image_spec())
+        rep = NamedSharding(self.mesh, P())
+        return (rep,) + (ispec,) * n_args
 
     def encode_dispatch(self, images):
         """Non-blocking encoder-only forward: (B, H, W, 3) image stack →
@@ -498,7 +529,9 @@ class FlowPredictor:
         returned fmap is NOT donated anywhere — the engine syncs and
         slices it into per-session host caches."""
         img = jnp.asarray(images)
-        key = (img.shape, "encode", str(img.dtype))
+        mesh_key, _, _ = self._session_mesh(img.shape, "encode")
+        key = (img.shape, "encode" if mesh_key is None
+               else ("encode", mesh_key), str(img.dtype))
         if key not in self._cache:
             self._require_session_path("encode")
             from raft_tpu.models.raft import RAFT
@@ -508,8 +541,19 @@ class FlowPredictor:
                 return self.model.apply(variables, images,
                                         method=RAFT.encode_features)
 
-            self._cache[key] = jax.jit(
-                run, donate_argnums=(1,) if donate else ())
+            if mesh_key is None:
+                self._cache[key] = jax.jit(
+                    run, donate_argnums=(1,) if donate else ())
+            else:
+                from raft_tpu.parallel.spatial import spatial_kernel_mesh
+                mesh = self.mesh
+
+                def traced(variables, images):
+                    with spatial_kernel_mesh(mesh):
+                        return run(variables, images)
+
+                self._cache[key] = jax.jit(
+                    traced, in_shardings=self._session_shardings(1))
         return self._cache[key](self.variables, img)
 
     def refine_dispatch(self, images1, fmap1, fmap2, flow_init=None,
@@ -550,11 +594,13 @@ class FlowPredictor:
             iters_used = (self.warm_iters if warm and self.warm_iters
                           else self.iters)
         donate = bool(self.donate_images) and self.mesh is None
-        key = (img1.shape, ("refine", bool(warm)), iters_used, donate,
-               str(img1.dtype))
+        mesh_key, n_sp, n_dt = self._session_mesh(img1.shape, "refine")
+        tag = (("refine", bool(warm)) if mesh_key is None
+               else ("refine", bool(warm), mesh_key))
+        key = (img1.shape, tag, iters_used, donate, str(img1.dtype))
         if key not in self._cache:
             self._require_session_path("refine")
-            model = self._pick_engine(img1.shape)
+            model = self._pick_engine(img1.shape, n_sp=n_sp, n_dt=n_dt)
             if warm:
                 def run(variables, image1, fmap1, fmap2, flow_init,
                         model=model):
@@ -567,13 +613,162 @@ class FlowPredictor:
                     return model.apply(
                         variables, image1, None, iters=iters_used,
                         fmap1=fmap1, fmap2=fmap2, test_mode=True)
-            self._cache[key] = jax.jit(
-                run, donate_argnums=(1, 2) if donate else ())
+            if mesh_key is None:
+                self._cache[key] = jax.jit(
+                    run, donate_argnums=(1, 2) if donate else ())
+            else:
+                from raft_tpu.parallel.spatial import spatial_kernel_mesh
+                mesh, inner = self.mesh, run
+
+                def run(variables, *arrays, _inner=inner):
+                    with spatial_kernel_mesh(mesh):
+                        return _inner(variables, *arrays)
+
+                self._cache[key] = jax.jit(
+                    run, in_shardings=self._session_shardings(
+                        4 if warm else 3))
         fn = self._cache[key]
         if warm:
             return fn(self.variables, img1, fm1, fm2,
                       jnp.asarray(flow_init))
         return fn(self.variables, img1, fm1, fm2)
+
+    # ----- step-granular (continuous batching) entry points ---------------
+    # The continuous serving scheduler (serving/contbatch.py) drives the
+    # refinement loop in chunks over a fixed-slot device-resident carry
+    # instead of one monolithic k-iteration executable per batch: admit
+    # writes freshly initialized samples into freed slots (in-carry
+    # scatter), step runs `s` masked update iterations for every
+    # occupied slot at once, finalize reads the mask-computing last
+    # iteration for retiring slots. One compile per (H, W, slots, s) —
+    # the iters ladder, early exit, and mixed traffic all share it.
+    # Cache keys use "stepcarry"/"stepadmit"/"step"/"stepfin" tags,
+    # disjoint from every existing family in the one shared cache.
+
+    def _require_step_path(self, what: str) -> None:
+        from raft_tpu.models.raft import RAFT
+        if self.mesh is not None:
+            raise ValueError(
+                f"the continuous {what} path is not supported with "
+                "spatially-sharded eval — the slot carry has no "
+                "sharding specs (serve sharded buckets through the "
+                "monolithic path)")
+        if not isinstance(self.model, RAFT):
+            raise ValueError(
+                f"the continuous {what} path applies to the canonical "
+                "RAFT family only (other families have no step-granular "
+                "refine entry point)")
+
+    @staticmethod
+    def _carry_shape(carry):
+        """(slots, H, W) of a slot carry — net is (slots, H/8, W/8, C)."""
+        net = carry["net"]
+        return (int(net.shape[0]), int(net.shape[1]) * 8,
+                int(net.shape[2]) * 8)
+
+    def step_carry_dispatch(self, images1, images2):
+        """Bootstrap one bucket's slot table: a full-width
+        ``refine_init`` over ``(slots, H, W, 3)`` stacks → the
+        device-resident carry dict. Called once per bucket at warmup
+        (the zeros it computes are placeholder occupants; real requests
+        overwrite their slots via :meth:`step_admit_dispatch`)."""
+        img1 = jnp.asarray(images1)
+        img2 = jnp.asarray(images2)
+        key = (img1.shape, ("stepcarry",), str(img1.dtype))
+        if key not in self._cache:
+            self._require_step_path("bootstrap")
+            from raft_tpu.models.raft import RAFT
+            model = self._pick_engine(img1.shape)
+
+            def run(variables, i1, i2, model=model):
+                return model.apply(variables, i1, i2,
+                                   method=RAFT.refine_init)
+
+            self._cache[key] = jax.jit(run)
+        return self._cache[key](self.variables, img1, img2)
+
+    def step_admit_dispatch(self, images1, images2, idx, carry):
+        """Admit ``m`` requests into slot rows ``idx`` of ``carry``:
+        ONE fused executable runs ``refine_init`` over the ``(m, H, W,
+        3)`` stacks and scatters the fresh per-sample state (context,
+        coords, correlation payload, zeroed early-exit counters) into
+        the donated slot table. ``m`` is the admission width — the
+        scheduler pads to a power of two by repeating the last real
+        admission (duplicate indices write identical values), so the
+        family stays at ``log2(slots)+1`` executables per wire dtype.
+        Returns the new carry (the old one's buffers are consumed when
+        donation is on)."""
+        img1 = jnp.asarray(images1)
+        img2 = jnp.asarray(images2)
+        idx = jnp.asarray(idx, jnp.int32)
+        slots = int(carry["net"].shape[0])
+        donate = bool(self.donate_images)
+        key = (img1.shape, ("stepadmit", slots), donate,
+               str(img1.dtype))
+        if key not in self._cache:
+            self._require_step_path("admit")
+            from raft_tpu.models.raft import RAFT, scatter_carry
+            model = self._pick_engine((slots, *img1.shape[1:]))
+
+            def run(variables, i1, i2, idx, carry, model=model):
+                fresh = model.apply(variables, i1, i2,
+                                    method=RAFT.refine_init)
+                return scatter_carry(carry, fresh, idx, slots)
+
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1, 2, 4) if donate else ())
+        return self._cache[key](self.variables, img1, img2, idx, carry)
+
+    def step_dispatch(self, carry, remaining, steps: int):
+        """Run ``steps`` masked refinement iterations over the slot
+        carry; ``remaining`` is the per-slot (slots,) int32 budget of
+        mask-free iterations still owed (host-computed each launch — the
+        brownout re-target is free host arithmetic, never a device
+        scatter). Slots with no budget (or early-exited, with the
+        predictor's ``early_exit`` set) are frozen in-executable.
+        Returns ``(carry', remaining')`` device values; wire-agnostic
+        (the carry's dtypes are fixed at bootstrap)."""
+        slots, H, W = self._carry_shape(carry)
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        donate = bool(self.donate_images)
+        ee = self.early_exit
+        key = ((slots, H, W), ("step", steps, ee), donate)
+        if key not in self._cache:
+            self._require_step_path("step")
+            from raft_tpu.models.raft import refine_chunk
+            model = self._pick_engine((slots, H, W, 3))
+
+            def run(variables, carry, remaining, model=model):
+                return refine_chunk(model.config, variables, carry,
+                                    remaining, steps, ee)
+
+            self._cache[key] = jax.jit(
+                run, donate_argnums=(1,) if donate else ())
+        return self._cache[key](self.variables, carry,
+                                jnp.asarray(remaining, jnp.int32))
+
+    def step_finalize_dispatch(self, carry):
+        """The mask-computing final iteration over ALL slots: one
+        update + convex upsample, carry NOT consumed (co-resident slots
+        keep stepping from it). Returns ``(flow_low, flow_up)`` device
+        arrays at the slot width; the scheduler slices retiring slots
+        host-side after sync. A request's ``k-1`` chunked iterations
+        plus this call reproduce the monolithic two-call scan —
+        per-request flow parity with ``dispatch_batch(iters=k)``."""
+        slots, H, W = self._carry_shape(carry)
+        key = ((slots, H, W), ("stepfin",))
+        if key not in self._cache:
+            self._require_step_path("finalize")
+            from raft_tpu.models.raft import refine_finalize
+            model = self._pick_engine((slots, H, W, 3))
+
+            def run(variables, carry, model=model):
+                return refine_finalize(model.config, variables, carry)
+
+            self._cache[key] = jax.jit(run)
+        return self._cache[key](self.variables, carry)
 
 
 def _predict_dataset(predictor, dataset, mode: Optional[str] = None):

@@ -55,6 +55,14 @@ class _UpdateStep(nn.Module):
 
     config: RAFTConfig
     early_exit: Optional[Tuple[float, int]] = None
+    # Continuous-batching hook: when True, the mask-free test_mode
+    # branch returns this iteration's float32 delta-flow as a scan
+    # output instead of () — the step-granular scheduler computes its
+    # convergence test OUTSIDE the module (refine_chunk), on exactly
+    # the value the in-scan masked branch would have used, so the two
+    # paths agree bit-for-bit on when a sample converged. Static field:
+    # the default keeps every existing trace byte-identical.
+    emit_delta: bool = False
 
     def setup(self):
         dtype = (jnp.bfloat16 if self.config.mixed_precision
@@ -127,7 +135,10 @@ class _UpdateStep(nn.Module):
 
         if compute_up is None and not self.is_initializing():
             # test_mode non-final: no mask, no upsample, no per-
-            # iteration outputs.
+            # iteration outputs (unless the continuous scheduler asked
+            # for the delta — see emit_delta).
+            if self.emit_delta:
+                return (net, coords1), delta_flow.astype(jnp.float32)
             return (net, coords1), ()
         # Training / init / final test_mode iteration: upsampled flow
         # is a scan output (the sequence loss consumes all of them; the
@@ -159,18 +170,30 @@ def _build_corr_state(cfg: RAFTConfig, fmap1, fmap2, inference: bool):
     VMEM for the backward too) and the output dtype ride in the state
     tuple as static values alongside the "alt"/"allpairs" tag.
     """
+    kind, meta = corr_state_meta(cfg, inference)
+    if kind == "alt":
+        return (kind, meta,
+                (fmap1, corr.build_feature_pyramid(fmap2, cfg.corr_levels)))
+    return (kind, meta,
+            corr.build_corr_pyramid(
+                fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
+                cfg.corr_storage(inference)))
+
+
+def corr_state_meta(cfg: RAFTConfig, inference: bool):
+    """The STATIC prefix of a correlation state tuple — ``(kind,
+    (mxu_dtype, differentiable, out_dtype))`` — separated from the array
+    payload so the step-granular dispatch family can keep only the
+    payload device-resident in its carry (strings and bools can't cross
+    a jit boundary) and rebuild the full state per executable."""
     if cfg.alternate_corr:
         # out dtype = the update block's compute dtype: the lookup's
         # consumer casts to it anyway (corr.astype(net.dtype)), and
         # emitting it from inside the kernel skips the convert+copy at
         # the custom-call boundary.
         out_dt = "bfloat16" if cfg.mixed_precision else "float32"
-        return ("alt", (cfg.corr_mxu(inference), not inference, out_dt),
-                (fmap1, corr.build_feature_pyramid(fmap2, cfg.corr_levels)))
-    return ("allpairs", ("float32", not inference, "float32"),
-            corr.build_corr_pyramid(
-                fmap1, fmap2, cfg.corr_levels, cfg.corr_scale,
-                cfg.corr_storage(inference)))
+        return "alt", (cfg.corr_mxu(inference), not inference, out_dt)
+    return "allpairs", ("float32", not inference, "float32")
 
 
 def _lookup(cfg: RAFTConfig, corr_state, coords):
@@ -228,6 +251,57 @@ class RAFT(nn.Module):
                  else jnp.float32)
         x = normalize_image(image, dtype)
         return self.fnet(x, train=False, deterministic=True)
+
+    def refine_init(self, image1, image2=None, fmap1=None, fmap2=None,
+                    flow_init=None):
+        """The scan-invariant prologue of the refinement loop as its own
+        inference entry point: encoders + correlation state + context,
+        returned as an ALL-ARRAY carry dict — the slot table of the
+        continuous (step-granular) serving scheduler.
+
+        Like :meth:`encode_features` this is a plain method (setup-built
+        submodules only; ``__call__`` keeps the single ``@nn.compact``
+        slot), so it composes under one ``model.apply``. The carry holds
+        only array leaves — the correlation state's static ``(kind,
+        meta)`` prefix is rebuilt per executable via
+        :func:`corr_state_meta` — and crosses jit boundaries between
+        launches under buffer donation. Keys: ``net``/``inp`` (context
+        split), ``coords0``/``coords1`` (float32 pixel grids),
+        ``corr`` (engine payload pytree), ``consec``/``done``/``used``
+        (per-slot early-exit accounting, zeroed here)."""
+        cfg = self.config
+        dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+        if (fmap1 is None) != (fmap2 is None):
+            raise ValueError("fmap1 and fmap2 must be given together")
+        image1 = normalize_image(image1, dtype)
+        if fmap1 is None:
+            image2 = normalize_image(image2, dtype)
+            fmaps = self.fnet(jnp.concatenate([image1, image2], axis=0),
+                              train=False, deterministic=True)
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        else:
+            fmap1 = fmap1.astype(dtype)
+            fmap2 = fmap2.astype(dtype)
+        corr_state = _build_corr_state(cfg, fmap1, fmap2, inference=True)
+        cnet_out = self.cnet(image1, train=False, deterministic=True)
+        net, inp = jnp.split(cnet_out, [cfg.hdim], axis=-1)
+        net = jnp.tanh(net)
+        inp = nn.relu(inp)
+        B, H8, W8, _ = fmap1.shape
+        coords0 = coords_grid(B, H8, W8)
+        coords1 = coords0
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+        return {
+            "net": net,
+            "inp": inp,
+            "coords0": coords0,
+            "coords1": coords1,
+            "corr": corr_state[2],
+            "consec": jnp.zeros((B,), jnp.int32),
+            "done": jnp.zeros((B,), bool),
+            "used": jnp.zeros((B,), jnp.int32),
+        }
 
     @nn.compact
     def __call__(self, image1, image2, iters: Optional[int] = None,
@@ -360,3 +434,125 @@ class RAFT(nn.Module):
             # init-time test_mode (static path): all iterations upsample.
             return coords1 - coords0, flow_predictions[-1]
         return flow_predictions
+
+
+# -- step-granular (continuous batching) refine family -------------------
+#
+# The monolithic test_mode loop runs all k iterations in ONE executable;
+# the continuous serving scheduler instead drives the SAME update block
+# in fixed-size chunks over a slot-table carry (refine_init's dict),
+# masking each slot by its own remaining-iterations budget and its
+# early-exit flag. These are module-level pure functions (not RAFT
+# methods): they apply a standalone _UpdateStep against the
+# ``variables["params"]["update"]`` subtree — structurally identical to
+# the nn.scan-lifted "update" scope because ``variable_broadcast=
+# "params"`` stores the body's params unstacked — so the scheduler never
+# needs the full model apply (no fnet/cnet in the step executable).
+
+
+def _update_variables(variables):
+    """The refine body's own variable tree, sliced out of the full
+    model's: the scan lift stores the update block's params unstacked
+    under the broadcast "update" scope, so a standalone _UpdateStep
+    apply accepts them as-is."""
+    return {"params": variables["params"]["update"]}
+
+
+def scatter_carry(full, fresh, idx, slots: int):
+    """Write ``fresh`` (a refine_init carry over ``m`` admitted samples)
+    into slot rows ``idx`` of ``full`` (the ``slots``-wide table).
+
+    Leaf-wise ``.at[idx].set``; leaves whose leading dim folds batch
+    with spatial rows (the all-pairs correlation pyramid levels are
+    ``(B*H8*W8, h, w)``) are reshaped to expose the slot axis first.
+    Duplicate indices in ``idx`` (tail-padded admissions repeat the
+    last real one) write identical values, so the scatter stays
+    deterministic."""
+    m = int(idx.shape[0])
+
+    def _scat(f, n):
+        lead = f.shape[0]
+        if lead == slots:
+            return f.at[idx].set(n.astype(f.dtype))
+        per = lead // slots
+        fr = f.reshape(slots, per, *f.shape[1:])
+        nr = n.reshape(m, per, *n.shape[1:])
+        return fr.at[idx].set(nr.astype(f.dtype)).reshape(f.shape)
+
+    return jax.tree_util.tree_map(_scat, full, fresh)
+
+
+def refine_chunk(cfg: RAFTConfig, variables, carry, remaining,
+                 steps: int, early_exit: Optional[Tuple[float, int]]):
+    """Run ``steps`` masked refinement iterations over a slot carry.
+
+    ``remaining`` is the per-slot (slots,) int32 budget of mask-free
+    iterations still owed (a request served at ``iters=k`` owes ``k-1``
+    here plus the one mask-computing :func:`refine_finalize` pass — the
+    monolithic two-call scan structure, so flow parity holds per
+    request). A slot is *active* while it has budget and isn't done;
+    inactive slots are frozen exactly like the in-scan masked branch
+    (the update is computed — one static executable — but not applied),
+    so a retired slot's value is independent of how long it stays
+    resident. Returns ``(carry', remaining')``.
+
+    Ordering matches _UpdateStep's masked branch bit-for-bit: consec
+    updates on this iteration's delta, freeze on the PREVIOUS done
+    flag (here: the active mask), ``used`` ticks before ``done`` absorbs
+    the patience test."""
+    step = _UpdateStep(cfg, None, emit_delta=True)
+    upd_vars = _update_variables(variables)
+    kind, meta = corr_state_meta(cfg, inference=True)
+    inp, coords0 = carry["inp"], carry["coords0"]
+    corr_state = (kind, meta, carry["corr"])
+
+    def body(c, _):
+        net, coords1, consec, done, used, rem = c
+        (net2, coords12), delta32 = step.apply(
+            upd_vars, (net, coords1), jnp.zeros(()), None, corr_state,
+            inp, coords0)
+        active = jnp.logical_and(~done, rem > 0)
+        if early_exit is not None:
+            tol, patience = early_exit
+            delta_norm = jnp.sqrt(
+                jnp.mean(jnp.sum(delta32 * delta32, axis=-1),
+                         axis=(1, 2)))
+            below = delta_norm < jnp.float32(tol)
+            consec = jnp.where(active,
+                               jnp.where(below, consec + 1, 0), consec)
+        keep = (~active)[:, None, None, None]
+        net = jnp.where(keep, net, net2)
+        coords1 = jnp.where(keep, coords1, coords12)
+        tick = jnp.where(active, 1, 0).astype(jnp.int32)
+        used = used + tick
+        rem = rem - tick
+        if early_exit is not None:
+            done = done | (active & (consec >= patience))
+        return (net, coords1, consec, done, used, rem), ()
+
+    c0 = (carry["net"], carry["coords1"], carry["consec"],
+          carry["done"], carry["used"],
+          remaining.astype(jnp.int32))
+    (net, coords1, consec, done, used, rem), _ = jax.lax.scan(
+        body, c0, None, length=int(steps))
+    out = dict(carry)
+    out.update(net=net, coords1=coords1, consec=consec, done=done,
+               used=used)
+    return out, rem
+
+
+def refine_finalize(cfg: RAFTConfig, variables, carry):
+    """The mask-computing final iteration over ALL slots: one update +
+    convex upsample, carry untouched (retiring slots read their result
+    here while co-resident slots keep stepping). Returns ``(flow_low,
+    flow_up)`` at the slot width. A request's full trajectory —
+    ``k-1`` chunked iterations then this call — reproduces the
+    monolithic two-call scan, so ``iters_used = carry["used"] + 1``."""
+    step = _UpdateStep(cfg, None)
+    upd_vars = _update_variables(variables)
+    kind, meta = corr_state_meta(cfg, inference=True)
+    corr_state = (kind, meta, carry["corr"])
+    (net, coords1), flow_up = step.apply(
+        upd_vars, (carry["net"], carry["coords1"]), jnp.zeros(()), True,
+        corr_state, carry["inp"], carry["coords0"])
+    return coords1 - carry["coords0"], flow_up

@@ -71,14 +71,14 @@ class QueuedRequest:
     __slots__ = ("image1", "image2", "padder", "bucket", "t_submit",
                  "deadline", "priority", "poisoned", "session",
                  "flow_init", "fmap1", "degradable", "low_res", "trace",
-                 "future")
+                 "iters", "future")
 
     def __init__(self, image1, image2, padder, bucket,
                  t_submit: float, deadline: Optional[float] = None,
                  priority: str = PRIORITY_HIGH, poisoned: bool = False,
                  session=None, flow_init=None, fmap1=None,
                  degradable: bool = False, low_res: bool = False,
-                 trace=None):
+                 trace=None, iters: Optional[int] = None):
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
@@ -102,6 +102,12 @@ class QueuedRequest:
         # engine at submit ONLY when tracing is enabled — None (no
         # allocation, no id) on the default path.
         self.trace = trace
+        # Assigned GRU iteration count for the CONTINUOUS (slot
+        # scheduler) path, where quality is per-request state instead of
+        # a bucket-key level: all iters levels share one ``(ph, pw,
+        # "cont")`` bucket and one executable family. ``None`` on the
+        # monolithic path (quality rides the bucket key there).
+        self.iters = iters
         self.future: Future = Future()
 
     def expired(self, now: float) -> bool:

@@ -1,0 +1,554 @@
+"""Iteration-granular continuous batching: the slot-based refine
+scheduler that turns early exit and the brownout quality ladder into
+wall-clock.
+
+The monolithic serving path dispatches one k-iteration executable per
+closed batch, which leaves two sources of wasted device time that only
+*look* free in the counters:
+
+* **Early exit saves counted iterations, not wall-clock.** A converged
+  sample stays in the masked scan burning full FLOPs until the slowest
+  co-batched sample finishes — ``metrics.early_exit_iters_saved`` ticks
+  up while the device runs exactly as long as it would have anyway.
+* **The iters ladder fragments traffic.** Every distinct quality level
+  is its own ``(ph, pw, lvl, wire)`` bucket with its own executable, so
+  mixed-quality traffic (brownout transitions, explicit ``iters=``
+  clients) shrinks effective batch size at exactly the moment —
+  overload — when batching matters most.
+
+Continuous batching (Orca-style iteration-level scheduling, as
+popularized for LLM serving by vLLM) fixes both by scheduling the
+refinement loop itself. RAFT's GRU refinement is structurally the same
+shape as LLM decode — a recurrent loop over a per-sample carry — so the
+same move applies: keep a fixed table of device-resident *slots* per
+shape bucket, run the update loop in small chunks over every occupied
+slot at once, and admit/retire individual samples between chunks.
+
+One :class:`_ContWorker` per padded shape owns the slot table and
+drives the ``FlowPredictor`` step family end to end:
+
+* ``step_carry_dispatch`` — bootstrap the ``(slots, H, W)`` carry once
+  (placeholder occupants; warmup does this so serving never pays it).
+* ``step_admit_dispatch`` — scatter freshly initialized samples into
+  freed slot rows (ONE fused executable per power-of-two admission
+  width per wire dtype; the width pads by repeating the last real
+  admission, so duplicate indices write identical values).
+* ``step_dispatch`` — ``contbatch_steps`` masked update iterations for
+  every occupied slot; the per-slot ``remaining`` budget is HOST state
+  handed in fresh each launch (int32, so the transfer never compiles),
+  which is what makes the brownout re-target free host arithmetic.
+* ``step_finalize_dispatch`` — the mask-computing final update +
+  convex upsample over all slots; retiring slots are sliced host-side.
+
+A request assigned ``k`` iterations runs ``k - 1`` chunked iterations
+plus the finalize — the same two-call split as the monolithic scan, so
+per-request flow parity with ``dispatch_batch(iters=k)`` holds (and the
+early-exit ``iters_used`` accounting matches exactly: ``used + 1``).
+
+Quality is **per-request state** (``QueuedRequest.iters``), not a
+bucket key: every ladder level, explicit ``iters=`` choice and
+early-exit outcome shares the one ``(ph, pw, "cont")`` bucket and the
+one executable family. A brownout rung change re-targets occupied
+slots' remaining budgets in place (``min(rem, new_target - 1 - used)``
+— degrade only; recovery never *adds* iterations to in-flight work,
+matching the monolithic ladder where a dispatched batch keeps its
+level). No re-bucketing, no per-rung executables.
+
+The expected win model (BASELINE.md round 9): slot-seconds per request
+drop from ``max_iters`` to its *actual* iterations, so throughput on
+mixed traffic improves toward ``max_iters / mean_iters`` — plus the
+de-fragmentation win of one dense slot table instead of per-level
+partial batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.serving.batcher import QueuedRequest, RequestTimedOut
+from raft_tpu.serving.metrics import xla_compile_count
+
+# Shared no-op context, same idiom as engine.py: the disabled-tracing
+# path must not allocate a context manager per cycle.
+import contextlib
+
+_NULL = contextlib.nullcontext()
+
+
+def _pow2_width(m: int, slots: int) -> int:
+    """Admission width for ``m`` real admissions: the next power of two
+    (capped at ``slots``), so the admit family stays at
+    ``log2(slots) + 1`` executables per wire dtype instead of one per
+    partial width."""
+    w = 1
+    while w < m:
+        w *= 2
+    return min(w, slots)
+
+
+class _ContWorker:
+    """One padded shape's slot table + scheduler thread.
+
+    The engine's router hands closed batches to ``inbox``; the worker
+    thread loops admit → step → retire, blocking only when the table is
+    empty and nothing is queued. All device work happens on this thread;
+    ``retarget`` (router thread, brownout) touches only the host-side
+    ``remaining``/``assigned`` arrays under ``_lock``.
+    """
+
+    def __init__(self, sched: "ContinuousScheduler",
+                 shape: Tuple[int, int]):
+        self.sched = sched
+        self.engine = sched.engine
+        self.shape = shape                      # padded (ph, pw)
+        self.slots = sched.slots
+        self.steps = sched.steps
+        self.inbox: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self.carry = None                       # device pytree
+        # Host-side slot state. remaining/used/assigned are the masked
+        # scan's budget arithmetic; requests maps slot -> QueuedRequest.
+        # int32 THROUGHOUT: an int64 array would compile a tiny cast
+        # executable inside jnp.asarray and break the zero-compile
+        # contract.
+        self.remaining = np.zeros(self.slots, np.int32)
+        self.used = np.zeros(self.slots, np.int32)
+        self.assigned = np.zeros(self.slots, np.int32)
+        self.requests: List[Optional[QueuedRequest]] = \
+            [None] * self.slots
+        self._pending: List[QueuedRequest] = []
+        self._closing = False
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"serving-cont-{shape[0]}x{shape[1]}")
+        self.thread.start()
+
+    # -- host-state helpers (any thread, take _lock) --------------------
+
+    def occupied(self) -> int:
+        with self._lock:
+            return sum(r is not None for r in self.requests)
+
+    def load(self) -> int:
+        """Occupied slots + queued admissions — this worker's share of
+        the brownout pressure signal."""
+        return self.occupied() + self.inbox.qsize() + len(self._pending)
+
+    def retarget(self, target_iters: int) -> int:
+        """Brownout rung change: cap every occupied *degradable* slot's
+        remaining budget at what ``target_iters`` total would leave it
+        (``used`` chunked iterations are already spent; the finalize is
+        the +1). Degrade-only — stepping back up never adds iterations
+        to in-flight work, same contract as the monolithic ladder.
+        Returns the number of slots actually re-targeted."""
+        hit = 0
+        with self._lock:
+            for i, req in enumerate(self.requests):
+                if req is None or not req.degradable:
+                    continue
+                new_rem = min(int(self.remaining[i]),
+                              max(int(target_iters) - 1
+                                  - int(self.used[i]), 0))
+                if new_rem != int(self.remaining[i]):
+                    self.remaining[i] = new_rem
+                    self.assigned[i] = min(int(self.assigned[i]),
+                                           int(target_iters))
+                    hit += 1
+        return hit
+
+    # -- scheduler thread ------------------------------------------------
+
+    def _run(self) -> None:
+        eng = self.engine
+        try:
+            while True:
+                if not self.occupied() and not self._pending:
+                    item = self.inbox.get()      # idle: block for work
+                    if item is None:
+                        break
+                    self._pending.extend(item)
+                # Drain whatever else queued without blocking — new
+                # arrivals admit into this cycle's freed slots.
+                while True:
+                    try:
+                        item = self.inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        self._closing = True
+                        break
+                    self._pending.extend(item)
+                self._cycle()
+                if (self._closing and not self._pending
+                        and not self.occupied()):
+                    break
+        except BaseException as e:   # fatal: fail fast, not silently
+            eng._set_fatal(e)
+            self._drain_failed(e)
+        finally:
+            self.sched._worker_done(self)
+
+    def _drain_failed(self, e: BaseException) -> None:
+        """Resolve every held request with ``e`` (fatal-path cleanup —
+        a future must never be left dangling)."""
+        eng = self.engine
+        with self._lock:
+            held = [r for r in self.requests if r is not None]
+            self.requests = [None] * self.slots
+        held.extend(self._pending)
+        self._pending = []
+        while True:
+            try:
+                item = self.inbox.get_nowait()
+            except queue.Empty:
+                break
+            if item:
+                held.extend(item)
+        for r in held:
+            if not r.future.done():
+                r.future.set_exception(e)
+                eng._trace_end(r, "fatal")
+        if held:
+            eng.metrics.record_error(len(held))
+
+    def _expire_pending(self) -> None:
+        now = time.monotonic()
+        expired = [r for r in self._pending if r.expired(now)]
+        if not expired:
+            return
+        eng = self.engine
+        for r in expired:
+            r.future.set_exception(RequestTimedOut(
+                f"request spent {(now - r.t_submit) * 1e3:.1f} ms in "
+                f"queue (queue_timeout_ms="
+                f"{eng.config.queue_timeout_ms})"))
+            eng._trace_end(r, "timeout")
+        eng.metrics.record_timeout(len(expired))
+        self._pending = [r for r in self._pending
+                         if not r.expired(now)]
+
+    def _assigned_iters(self, req: QueuedRequest) -> int:
+        """The iteration budget a request enters its slot with: its
+        stamped per-request ``iters``, re-read through the CURRENT
+        brownout level for controller-managed traffic (a rung change
+        while it waited in the batcher must not serve stale quality)."""
+        eng = self.engine
+        if req.degradable and eng.brownout is not None:
+            lvl = eng.brownout.level
+            return (eng._full_iters if lvl == 0
+                    else eng._iters_ladder[lvl - 1])
+        return int(req.iters) if req.iters else eng._full_iters
+
+    def _bootstrap(self, predictor) -> None:
+        ph, pw = self.shape
+        z = np.zeros((self.slots, ph, pw, 3), np.float32)
+        self.carry = predictor.step_carry_dispatch(z, np.zeros_like(z))
+
+    def _admit(self, predictor) -> int:
+        """Scatter pending requests into free slots, grouped by wire
+        dtype (uint8 and float32 admissions use distinct pre-warmed
+        executables; the carry they write into is dtype-agnostic).
+        Returns the number of requests admitted."""
+        self._expire_pending()
+        eng = self.engine
+        # Injected poisoned inputs fail alone at admission — the slot
+        # table gives per-request isolation for free (no co-batched
+        # neighbors to take down, no retry-as-singles pass needed).
+        poisoned = [r for r in self._pending if r.poisoned]
+        if poisoned:
+            for r in poisoned:
+                r.future.set_exception(RuntimeError(
+                    "injected poisoned input in admitted request"))
+                eng._trace_end(r, "error")
+            eng.metrics.record_error(len(poisoned))
+            self._pending = [r for r in self._pending if not r.poisoned]
+        if not self._pending:
+            return 0
+        with self._lock:
+            free = [i for i, r in enumerate(self.requests) if r is None]
+        if not free:
+            return 0
+        take = self._pending[:len(free)]
+        self._pending = self._pending[len(free):]
+        tr = eng._tracer
+        total = 0
+        for dt in ("uint8", "float32"):
+            group = [r for r in take if str(r.image1.dtype) == dt]
+            if not group:
+                continue
+            m = len(group)
+            width = _pow2_width(m, self.slots)
+            idx = np.empty(width, np.int32)
+            idx[:m] = free[total:total + m]
+            idx[m:] = idx[m - 1]        # repeat: identical values, safe
+            ph, pw = self.shape
+            i1 = np.empty((width, ph, pw, 3), group[0].image1.dtype)
+            i2 = np.empty_like(i1)
+            for j, r in enumerate(group):
+                i1[j] = r.image1
+                i2[j] = r.image2
+            if m < width:
+                i1[m:] = i1[m - 1]
+                i2[m:] = i2[m - 1]
+            eng.metrics.record_staged_bytes(i1.nbytes + i2.nbytes)
+            if tr is not None:
+                t_q = time.monotonic()
+                for r in group:
+                    tr.complete("queue", t_q - r.t_submit,
+                                trace_id=r.trace,
+                                args={"priority": r.priority})
+            with (tr.span("cont_admit",
+                          args={"n": m, "width": width, "wire": dt})
+                  if tr is not None else _NULL):
+                self.carry = predictor.step_admit_dispatch(
+                    i1, i2, idx, self.carry)
+            with self._lock:
+                for j, r in enumerate(group):
+                    slot = int(idx[j])
+                    k = self._assigned_iters(r)
+                    self.requests[slot] = r
+                    self.assigned[slot] = k
+                    self.remaining[slot] = k - 1
+                    self.used[slot] = 0
+            total += m
+        eng.metrics.record_contbatch_admit(total)
+        return total
+
+    def _cycle(self) -> None:
+        """One admit → step → retire pass over the slot table."""
+        eng = self.engine
+        with eng._swap_lock:
+            predictor = eng.predictor
+        c0 = xla_compile_count()
+        if self.carry is None:
+            self._bootstrap(predictor)
+        admitted = self._admit(predictor)
+        with self._lock:
+            occupied = [i for i, r in enumerate(self.requests)
+                        if r is not None]
+            rem = self.remaining.copy()
+        if not occupied:
+            if admitted or xla_compile_count() - c0:
+                eng.metrics.record_batch(admitted, self.slots,
+                                         compiles=xla_compile_count()
+                                         - c0)
+            return
+        tr = eng._tracer
+        live = [i for i in occupied if rem[i] > 0]
+        if live:
+            with eng.stages.stage("dispatch"), \
+                    (tr.span("cont_step",
+                             args={"occupied": len(occupied),
+                                   "steps": self.steps})
+                     if tr is not None else _NULL):
+                self.carry, rem_dev = predictor.step_dispatch(
+                    self.carry, rem, self.steps)
+            with eng.stages.stage("sync"):
+                new_rem = np.asarray(rem_dev).astype(np.int32)
+                done = np.asarray(self.carry["done"])
+                used = np.asarray(self.carry["used"]).astype(np.int32)
+            with self._lock:
+                # Re-target may have shrunk remaining while the step
+                # ran; keep the smaller budget (monotone: budgets only
+                # ever shrink, so min is always the fresher intent).
+                self.remaining = np.minimum(self.remaining,
+                                            new_rem).astype(np.int32)
+                self.used = used
+                rem = self.remaining.copy()
+        else:
+            with self._lock:
+                done = np.asarray(self.carry["done"])
+                used = self.used.copy()
+        eng.metrics.record_contbatch_step(len(occupied))
+        retiring = [i for i in occupied
+                    if bool(done[i]) or int(rem[i]) == 0]
+        if retiring:
+            self._retire(predictor, retiring, used, tr)
+        eng.metrics.record_batch(
+            admitted if admitted else len(occupied),
+            self.slots, compiles=xla_compile_count() - c0)
+
+    def _retire(self, predictor, retiring: List[int],
+                used: np.ndarray, tr) -> None:
+        """Finalize (one update + convex upsample over ALL slots —
+        co-resident slots keep stepping from the untouched carry),
+        slice the retiring slots host-side, resolve their futures and
+        free the slots."""
+        eng = self.engine
+        with self._lock:
+            reqs = {i: self.requests[i] for i in retiring}
+        want_full = any(not r.low_res for r in reqs.values())
+        want_low = any(r.low_res for r in reqs.values())
+        with eng.stages.stage("dispatch"), \
+                (tr.span("cont_finalize", args={"n": len(retiring)})
+                 if tr is not None else _NULL):
+            flow_low, flow_up = predictor.step_finalize_dispatch(
+                self.carry)
+        with eng.stages.stage("sync"):
+            up = np.asarray(flow_up) if want_full else None
+            low = np.asarray(flow_low) if want_low else None
+            if up is not None:
+                eng.stages.add_bytes("sync", up.nbytes)
+            if low is not None:
+                eng.stages.add_bytes("sync", low.nbytes)
+        now = time.monotonic()
+        freed = 0
+        returned = 0
+        with eng.stages.stage("unpad"):
+            for i in retiring:
+                r = reqs[i]
+                iters_used = int(used[i]) + 1
+                assigned = None
+                with self._lock:
+                    assigned = int(self.assigned[i])
+                    self.requests[i] = None
+                    self.remaining[i] = 0
+                saved = max(assigned - iters_used, 0)
+                freed += saved
+                if saved:
+                    eng.metrics.record_early_exit_saved(saved)
+                eng.metrics.record_quality(assigned)
+                result = (low[i].copy() if r.low_res
+                          else r.padder.unpad(up[i]))
+                returned += result.nbytes
+                r.future.set_result(result)
+                eng._trace_end(r, "ok")
+                latency = now - r.t_submit
+                eng.metrics.record_done(latency)
+                if eng.slo is not None:
+                    eng.slo.observe(r.priority, latency)
+        eng.metrics.record_returned_bytes(returned)
+        eng.metrics.record_contbatch_retire(len(retiring), freed)
+
+    # -- warmup ----------------------------------------------------------
+
+    def warm(self) -> None:
+        """Pre-compile this shape's whole step family with the exact
+        runtime dtypes: bootstrap, every power-of-two admission width in
+        BOTH wire dtypes, the chunk step, and the finalize. idx and
+        remaining are np.int32 here for the same reason they are at
+        runtime — an int64 input would compile a cast executable and
+        show up as a post-warmup compile. Leaves every slot free."""
+        eng = self.engine
+        with eng._swap_lock:
+            predictor = eng.predictor
+        ph, pw = self.shape
+        if self.carry is None:
+            self._bootstrap(predictor)
+        width = 1
+        while width <= self.slots:
+            idx = (np.arange(width) % self.slots).astype(np.int32)
+            for dt in (np.float32, np.uint8):
+                z1 = np.zeros((width, ph, pw, 3), dt)
+                self.carry = predictor.step_admit_dispatch(
+                    z1, np.zeros_like(z1), idx, self.carry)
+            width *= 2
+        self.carry, rem_dev = predictor.step_dispatch(
+            self.carry, np.ones(self.slots, np.int32), self.steps)
+        np.asarray(rem_dev)
+        flow_low, flow_up = predictor.step_finalize_dispatch(self.carry)
+        np.asarray(flow_up)
+        np.asarray(flow_low)
+        with self._lock:
+            self.requests = [None] * self.slots
+            self.remaining[:] = 0
+            self.used[:] = 0
+            self.assigned[:] = 0
+
+    def close(self) -> None:
+        self.inbox.put(None)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.thread.join(timeout)
+
+
+class ContinuousScheduler:
+    """The engine-facing front of the continuous path: routes closed
+    ``(ph, pw, "cont")`` batches to per-shape :class:`_ContWorker`
+    slot tables, fans brownout re-targets out to them, and reports the
+    aggregate load/occupancy the engine's pressure signal and gauges
+    read.
+
+    The batcher still sits in front (backlog cap, priority classes,
+    queue timeouts all keep working); what changes is what happens
+    after a batch closes — instead of one monolithic dispatch, its
+    requests join a standing slot table and occupy device slots only
+    for the iterations they actually use. ``slots`` defaults to the
+    engine's ``max_batch`` (``ServingConfig.contbatch_slots``
+    overrides), ``steps`` is the chunk size between scheduling points
+    (``ServingConfig.contbatch_steps``) — smaller chunks retire and
+    admit sooner at more launch overhead."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        cfg = engine.config
+        self.slots = int(getattr(cfg, "contbatch_slots", 0)
+                         or cfg.max_batch)
+        self.steps = max(1, int(getattr(cfg, "contbatch_steps", 2)))
+        self._workers: Dict[Tuple[int, int], _ContWorker] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _worker_for(self, shape: Tuple[int, int]) -> _ContWorker:
+        with self._lock:
+            w = self._workers.get(shape)
+            if w is None:
+                w = _ContWorker(self, shape)
+                self._workers[shape] = w
+            return w
+
+    def _worker_done(self, worker: _ContWorker) -> None:
+        pass   # workers stay registered for join(); nothing to reclaim
+
+    def put(self, batch: List[QueuedRequest]) -> None:
+        """Router thread: hand one closed ``(ph, pw, "cont")`` batch to
+        its shape's worker."""
+        shape = (int(batch[0].bucket[0]), int(batch[0].bucket[1]))
+        self._worker_for(shape).inbox.put(batch)
+
+    def retarget(self, target_iters: int) -> int:
+        """Brownout rung change: re-target every worker's occupied
+        degradable slots in place. Returns total slots touched."""
+        with self._lock:
+            workers = list(self._workers.values())
+        hit = 0
+        for w in workers:
+            hit += w.retarget(target_iters)
+        if hit:
+            self.engine.metrics.record_contbatch_retarget(hit)
+        return hit
+
+    def warmup_bucket(self, ph: int, pw: int) -> None:
+        self._worker_for((int(ph), int(pw))).warm()
+
+    def occupied(self) -> int:
+        with self._lock:
+            workers = list(self._workers.values())
+        return sum(w.occupied() for w in workers)
+
+    def load(self) -> int:
+        """Pending + occupied across workers — added to the engine's
+        brownout pressure signal (work the batcher no longer sees but
+        the device still owes)."""
+        with self._lock:
+            workers = list(self._workers.values())
+        return sum(w.load() for w in workers)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain every worker: each finishes its occupied slots and
+        queued admissions (0 dropped requests — the kill-under-load
+        contract) and exits."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = list(self._workers.values())
+        for w in workers:
+            w.close()
+        for w in workers:
+            w.join(timeout)

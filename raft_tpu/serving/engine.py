@@ -424,6 +424,25 @@ class ServingConfig:
         batching multiplies per-chip activation memory at exactly the
         resolutions that needed sharding). Other buckets keep
         ``max_batch``.
+      continuous: iteration-granular continuous batching
+        (:class:`~raft_tpu.serving.contbatch.ContinuousScheduler`).
+        ``True`` routes stateless traffic on configured ``buckets``
+        through per-shape slot tables — requests occupy device slots
+        only for the GRU iterations they actually use, so early exit
+        and the iters ladder become wall-clock instead of counted
+        savings, and every quality level shares ONE ``(ph, pw,
+        "cont")`` bucket and one step-executable family instead of a
+        bucket each. ``False`` pins the monolithic path. ``None``
+        (default) defers to the ``RAFT_CONTBATCH`` env flag ('1' = on;
+        'auto'/'0' = off — opt-in until an on-TPU capture, BASELINE.md
+        round 9). Stream, sharded and unconfigured-shape traffic
+        always keeps the monolithic path. With the scheduler off the
+        serve path is byte-identical to previous builds.
+      contbatch_steps: update iterations per continuous ``step``
+        launch (the scheduling quantum: smaller chunks retire/admit
+        sooner at more launch overhead; one executable per value).
+      contbatch_slots: slot-table width per continuous bucket
+        (``0`` → ``max_batch``).
       trace: force request-scoped tracing on for this engine (mints a
         process tracer via :func:`raft_tpu.observability.enable_tracing`
         if none is installed). Default off: the engine still picks up a
@@ -469,6 +488,9 @@ class ServingConfig:
     sharded_shards: int = 0
     sharded_area_threshold: int = 0
     sharded_max_batch: int = 1
+    continuous: Optional[bool] = None
+    contbatch_steps: int = 2
+    contbatch_slots: int = 0
     trace: bool = False
     trace_capacity: int = 65536
     metrics_port: Optional[int] = None
@@ -807,6 +829,21 @@ class ServingEngine:
             # under mixed traffic.
             | frozenset((*p, "mesh", wt) for p in self._sharded_padded
                         for wt in _WIRE_TAGS))
+        # Continuous (iteration-granular) batching: config wins when
+        # set; None defers to the RAFT_CONTBATCH env flag, read ONCE
+        # here at construction (like donation — never between warmup
+        # and serving, so the executable family can't change under
+        # load). Only configured stateless buckets route continuous:
+        # their step family is warmed, and unconfigured shapes keep
+        # the bounded dynamic-stream path.
+        cont = self.config.continuous
+        if cont is None:
+            from raft_tpu.utils.envflags import resolve_contbatch
+            cont = resolve_contbatch() == "1"
+        self.contbatch = None
+        if cont:
+            from raft_tpu.serving.contbatch import ContinuousScheduler
+            self.contbatch = ContinuousScheduler(self)
         self._retired: List[_BucketStream] = []
         self._streams_lock = threading.Lock()
         self._router: Optional[threading.Thread] = None
@@ -833,6 +870,9 @@ class ServingEngine:
             "sharded_shards",
             lambda: (self._sharded_shards
                      if self._sharded_mesh is not None else 0))
+        if self.contbatch is not None:
+            m.set_gauge_source("contbatch_occupied",
+                               self.contbatch.occupied)
         m.set_gauge_source(
             "health_state",
             lambda: health_mod.HEALTH_CODES[self.health_state()])
@@ -958,6 +998,18 @@ class ServingEngine:
                     stats[(ph, pw, lvl)] = {
                         "compiles": float(w.compiles),
                         "seconds": time.perf_counter() - t0}
+                if self.contbatch is not None:
+                    # The whole continuous step family for this shape:
+                    # bootstrap + every pow2 admission width in both
+                    # wire dtypes + chunk step + finalize. After this,
+                    # mixed ladder/early-exit/wire traffic through the
+                    # slot table runs at zero compiles.
+                    t0 = time.perf_counter()
+                    with CompileWatch() as w:
+                        self.contbatch.warmup_bucket(ph, pw)
+                    stats[(ph, pw, "cont")] = {
+                        "compiles": float(w.compiles),
+                        "seconds": time.perf_counter() - t0}
             for raw_hw in (self.config.warm_buckets
                            if buckets is None else ()):
                 stats.update(self._warmup_session_bucket(raw_hw))
@@ -1061,6 +1113,12 @@ class ServingEngine:
             # thread, which has exited by now.)
             for s in streams + self._retired:
                 s.join(timeout)
+            if self.contbatch is not None:
+                # After the router exits every accepted continuous
+                # request sits in a worker inbox or an occupied slot;
+                # close() drains both to futures (0 dropped — the
+                # kill-under-load contract).
+                self.contbatch.close(timeout)
         if self.metrics_server is not None:
             self.metrics_server.shutdown()
             self.metrics_server = None
@@ -1316,8 +1374,22 @@ class ServingEngine:
             lvl = self.brownout.level
             if lvl:
                 bucket_iters = self._iters_ladder[lvl - 1]
-        bucket = ((*padded, wire) if bucket_iters is None
-                  else (*padded, bucket_iters, wire))
+        req_iters = None
+        if self.contbatch is not None and padded in self._stateless_padded:
+            # Continuous path: quality is per-request state, not a
+            # bucket key — every iters level and both wire dtypes share
+            # the one (ph, pw, "cont") bucket and its slot table (the
+            # scheduler groups admissions by dtype). The bucket key is
+            # wire-untagged by design: the ONE exception to the
+            # wire-tag-last convention, because the executable family
+            # it routes to is carry-resident and dtype-agnostic past
+            # admission.
+            bucket = (*padded, "cont")
+            req_iters = (bucket_iters if bucket_iters is not None
+                         else (iters or self._full_iters))
+        else:
+            bucket = ((*padded, wire) if bucket_iters is None
+                      else (*padded, bucket_iters, wire))
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
@@ -1330,7 +1402,8 @@ class ServingEngine:
                             poisoned=active_injector()
                             .poisons_request(seq),
                             degradable=degradable,
-                            low_res=low_res, trace=rid)
+                            low_res=low_res, trace=rid,
+                            iters=req_iters)
         if low_res:
             # Pad geometry for host-side upsample_flow recovery.
             req.future.padder = padder
@@ -1610,6 +1683,12 @@ class ServingEngine:
                 self._brownout_tick()
                 if not batch:
                     continue
+                if batch[0].bucket[-1] == "cont":
+                    # Continuous bucket: the batcher still closed the
+                    # batch (deadline/size), but it joins a standing
+                    # slot table instead of a monolithic dispatch.
+                    self.contbatch.put(batch)
+                    continue
                 self._stream_for(batch[0].bucket).put(batch)
         except BaseException as e:  # fatal: fail fast, not silently
             self._set_fatal(e)
@@ -1637,7 +1716,12 @@ class ServingEngine:
             return
         with self._state_lock:
             inflight = self._inflight_batches
-        old, new = ctl.observe(self.batcher.pending() + inflight)
+        pressure = self.batcher.pending() + inflight
+        if self.contbatch is not None:
+            # Work the batcher no longer sees but the device still
+            # owes: occupied slots + admissions queued at the workers.
+            pressure += self.contbatch.load()
+        old, new = ctl.observe(pressure)
         if new != old:
             tr = self._tracer
             on_move = None
@@ -1654,6 +1738,15 @@ class ServingEngine:
                                   "bucket": repr(new_key)})
             self.batcher.rebucket_low(self._brownout_bucket_for,
                                       on_move=on_move)
+            if self.contbatch is not None:
+                # In-flight slots re-target their remaining budgets in
+                # place — free host arithmetic, no re-bucketing, no
+                # per-rung executables. Queued continuous requests need
+                # nothing: the worker re-reads the level for degradable
+                # traffic at admission.
+                target = (self._full_iters if new == 0
+                          else self._iters_ladder[new - 1])
+                self.contbatch.retarget(target)
 
     def _brownout_bucket_for(self, req: QueuedRequest):
         """Rebucket mapper: the bucket a queued controller-managed LOW
@@ -1663,6 +1756,11 @@ class ServingEngine:
         even while its request waits in a bucket the ladder also
         uses."""
         if not req.degradable:
+            return None
+        if req.bucket[-1] == "cont":
+            # Continuous requests never re-bucket: quality is
+            # per-request state, applied by the slot worker at
+            # admission from the then-current level.
             return None
         lvl = self.brownout.level
         base = req.bucket[:2]

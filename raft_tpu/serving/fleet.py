@@ -136,7 +136,10 @@ class BucketRouter:
         # as "HxW@I" — the "@" keeps them disjoint from both the
         # golden-pinned "HxW" namespace and the "stream:" prefix, and
         # the digest stays bit-stable per (shape, level) so a ladder
-        # level always routes to the same replica.
+        # level always routes to the same replica. The sharded path's
+        # ``(h, w, "mesh")`` and the continuous scheduler's ``(h, w,
+        # "cont")`` render the same way — "HxW@mesh" / "HxW@cont",
+        # each its own disjoint namespace.
         key = f"{bucket[0]}x{bucket[1]}"
         if len(bucket) > 2:
             key = f"{key}@{bucket[2]}"
@@ -455,6 +458,24 @@ class ServingFleet:
                         "mesh-hosting fleet replicas must share the "
                         "sharded_* config (sharded bucket keys and "
                         "digests would diverge across replicas)")
+        # Continuous (iteration-granular) batching agreement: read the
+        # RESOLVED state (engine.contbatch — config field plus the
+        # RAFT_CONTBATCH env fallback, fixed at construction), not the
+        # config field. A mixed fleet would route one workload across
+        # incompatible digest namespaces ("HxW@cont" vs "HxW"/"HxW@I"),
+        # splitting the slot-table consolidation the scheduler exists
+        # for — same precedent as the pad_mode/sharded_* checks above.
+        cont_states = {rid: eng.contbatch is not None
+                       for rid, eng in self._engines.items()}
+        if len(set(cont_states.values())) > 1:
+            on = sorted(r for r, c in cont_states.items() if c)
+            off = sorted(r for r, c in cont_states.items() if not c)
+            raise ValueError(
+                "fleet replicas must agree on continuous batching "
+                f"(resolved on for {', '.join(on)}; off for "
+                f"{', '.join(off)}) — bucket digests would diverge "
+                "across replicas")
+        self._continuous = next(iter(cont_states.values()), False)
         self.router = BucketRouter(list(self._engines))
         self.metrics = FleetMetrics(lambda: self._engines)
         self.warmup_stats: Dict[str, Dict[str, float]] = {}
@@ -666,20 +687,31 @@ class ServingFleet:
                                      else "error"),
                           "replica": getattr(f, "replica_id", None)}))
         bucket = self.bucket_for(image1.shape)
-        if iters is not None:
-            bucket = (*bucket, int(iters))
-        elif self._sharded_rids:
+        sharded = None
+        if iters is None and self._sharded_rids:
             # The mesh-hosting replicas' shared routing rule decides
             # whether this shape serves spatially sharded; a sharded
             # request rendezvous-routes on its own (ph, pw, "mesh")
             # bucket — the disjoint "HxW@mesh" digest namespace.
             sharded = self._engines[self._sharded_rids[0]] \
                 .sharded_route(image1.shape)
-            if sharded is not None:
-                bucket = sharded
+        if sharded is not None:
+            bucket = sharded
+        elif self._continuous:
+            # Continuous fleet: every quality level of one shape shares
+            # one slot table, so every level must also share ONE
+            # rendezvous digest — "HxW@cont", disjoint from the
+            # golden-pinned "HxW" and per-level "HxW@I" namespaces.
+            # Splitting levels across replicas here would shred the
+            # mixed-iters consolidation the scheduler exists for; the
+            # requested level rides the threaded ``iters`` argument
+            # instead of the bucket key.
+            bucket = (*bucket, "cont")
+        elif iters is not None:
+            bucket = (*bucket, int(iters))
         self._dispatch(outer, image1, image2, priority, bucket,
                        tried=set(), hops=0, last_exc=None,
-                       low_res=low_res, trace_id=trace_id)
+                       low_res=low_res, trace_id=trace_id, iters=iters)
         return outer
 
     def predict(self, image1: np.ndarray, image2: np.ndarray,
@@ -704,7 +736,8 @@ class ServingFleet:
     def _dispatch(self, outer, image1, image2, priority, bucket: Bucket,
                   tried: set, hops: int, last_exc,
                   low_res: bool = False,
-                  trace_id: Optional[int] = None) -> None:
+                  trace_id: Optional[int] = None,
+                  iters: Optional[int] = None) -> None:
         """Walk the bucket's owner-preference chain and hand the
         request to the first routable replica not yet tried. Called
         once at submit and re-entered (from a replica's completion
@@ -729,12 +762,16 @@ class ServingFleet:
             try:
                 # A routed bucket with an int third element carries its
                 # quality level (the engine re-validates it against its
-                # warmed ladder); the "mesh" tag is the sharded path's
-                # marker, never an iteration count.
-                iters = (bucket[2] if len(bucket) > 2
-                         and isinstance(bucket[2], int) else None)
+                # warmed ladder); the "mesh"/"cont" tags are path
+                # markers, never iteration counts — on a continuous
+                # fleet the level rides the threaded ``iters`` argument
+                # (the "@cont" digest is level-agnostic by design).
+                lvl = iters
+                if lvl is None and len(bucket) > 2 \
+                        and isinstance(bucket[2], int):
+                    lvl = bucket[2]
                 inner = engine.submit(image1, image2, priority=priority,
-                                      iters=iters, low_res=low_res,
+                                      iters=lvl, low_res=low_res,
                                       trace_id=trace_id)
             except Exception as e:
                 # Refused at the door (breaker fast-fail, backlog full,
@@ -756,7 +793,7 @@ class ServingFleet:
             inner.add_done_callback(
                 lambda f, rid=rid: self._on_reply(
                     outer, f, rid, image1, image2, priority, bucket,
-                    tried, hops, low_res, trace_id))
+                    tried, hops, low_res, trace_id, iters))
             return
         self.metrics.record_shed()
         if last_exc is None and is_mesh:
@@ -772,7 +809,8 @@ class ServingFleet:
     def _on_reply(self, outer, inner, rid: str, image1, image2,
                   priority, bucket: Bucket, tried: set, hops: int,
                   low_res: bool = False,
-                  trace_id: Optional[int] = None) -> None:
+                  trace_id: Optional[int] = None,
+                  iters: Optional[int] = None) -> None:
         exc = inner.exception()
         if exc is None:
             outer.replica_id = getattr(inner, "replica_id", rid)
@@ -796,7 +834,8 @@ class ServingFleet:
         try:
             self._dispatch(outer, image1, image2, priority, bucket,
                            tried, hops + 1, last_exc=exc,
-                           low_res=low_res, trace_id=trace_id)
+                           low_res=low_res, trace_id=trace_id,
+                           iters=iters)
         except Exception as e:   # never lose a future to a retry bug
             if not outer.done():
                 outer.replica_id = rid

@@ -484,6 +484,109 @@ def run_load(engine, frames, n_requests: int, concurrency: int = 8,
     }
 
 
+def run_mixed_iters_load(engine, frames, n_requests: int,
+                         levels: Sequence[int],
+                         refs_by_iters: Dict[int, List[np.ndarray]],
+                         concurrency: int = 8, epe_tol: float = 1e-4,
+                         timeout: float = 300.0) -> Dict[str, object]:
+    """Mixed-iteration-count traffic: request ``i`` asks for
+    ``iters=levels[i % len(levels)]`` over ``frames[i % len(frames)]``
+    — the workload iteration-granular continuous batching exists for.
+    On the monolithic path every distinct level lands in its own
+    ``(H, W, lvl, wire)`` bucket (fragmenting batches and tail-padding
+    each); the continuous scheduler packs all of them into one slot
+    table and retires each the step its budget runs out.
+
+    Unlike :func:`run_load`, correctness here is graded by endpoint
+    error, not bit-equality: continuous serving runs the SAME per-step
+    math as ``dispatch_batch(iters=k)`` but through differently-fused
+    executables (chunked scan + separate finalize), so results agree to
+    float-accumulation noise (measured ~2e-6 EPE on this host), not
+    byte-for-byte. ``refs_by_iters`` maps each level in ``levels`` to
+    reference flows aligned to ``frames`` — computed by the caller via
+    ``dispatch_batch(iters=k)`` with the predictor's early-exit setting
+    live, so early-exited requests still match their reference. A
+    response whose EPE vs its own level's reference exceeds ``epe_tol``
+    counts as mismatched. Returns ``ok`` / ``completed`` / ``dropped``
+    / ``mismatched`` / ``worst_epe`` / per-level request counts plus
+    the usual throughput, latency and metrics-snapshot fields."""
+    missing = [k for k in set(levels) if k not in refs_by_iters]
+    if missing:
+        raise ValueError(f"refs_by_iters missing levels {missing}")
+    lock = threading.Lock()
+    next_req = [0]
+    dropped: List[int] = []
+    mismatched: List[int] = []
+    completed = [0]
+    worst_epe = [0.0]
+    lats: List[float] = []
+    level_counts: Dict[int, int] = {int(k): 0 for k in set(levels)}
+
+    def client():
+        while True:
+            with lock:
+                i = next_req[0]
+                if i >= n_requests:
+                    return
+                next_req[0] += 1
+            im1, im2 = frames[i % len(frames)]
+            lvl = int(levels[i % len(levels)])
+            t_req = time.perf_counter()
+            try:
+                flow = engine.submit(im1, im2, iters=lvl).result(timeout)
+            except Exception:
+                with lock:
+                    dropped.append(i)
+                continue
+            latency = time.perf_counter() - t_req
+            ref = refs_by_iters[lvl][i % len(frames)]
+            if flow.shape != ref.shape:
+                epe = float("inf")
+            else:
+                epe = float(np.sqrt(
+                    ((flow - ref) ** 2).sum(-1)).mean())
+            with lock:
+                completed[0] += 1
+                lats.append(latency)
+                level_counts[lvl] += 1
+                worst_epe[0] = max(worst_epe[0], epe)
+                if not epe <= epe_tol:
+                    mismatched.append(i)
+
+    threads = [threading.Thread(target=client, name=f"mixed-load-{t}")
+               for t in range(concurrency)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    dt = time.perf_counter() - t0
+    slats = sorted(lats)
+    return {
+        "ok": not dropped and not mismatched
+              and completed[0] == n_requests,
+        "requests": n_requests,
+        "concurrency": concurrency,
+        "levels": [int(k) for k in levels],
+        "level_counts": dict(sorted(level_counts.items(),
+                                    reverse=True)),
+        "completed": completed[0],
+        "dropped": sorted(dropped),
+        "mismatched": sorted(mismatched),
+        "worst_epe": worst_epe[0],
+        "epe_tol": epe_tol,
+        "seconds": dt,
+        "throughput_rps": n_requests / dt if dt > 0 else 0.0,
+        "latency_ms": {
+            "p50": _percentile(slats, 50) * 1e3,
+            "p95": _percentile(slats, 95) * 1e3,
+            "p99": _percentile(slats, 99) * 1e3,
+            "mean": (sum(slats) / len(slats) * 1e3) if slats else 0.0,
+        },
+        "metrics": engine.metrics.snapshot(),
+    }
+
+
 def run_overload(engine, frames, n_low: int, n_high: int,
                  refs_by_iters: Dict[int, List[np.ndarray]],
                  full_iters: int, low_concurrency: int = 16,

@@ -207,6 +207,20 @@ class ServingMetrics:
         # summed over early-exit-enabled responses).
         self.quality_hist: Counter = Counter()
         self.early_exit_iters_saved = 0
+        # continuous (iteration-granular) batching accounting: admits /
+        # retires are slot-table membership changes, steps counts
+        # step_dispatch launches, occupancy_sum accumulates occupied
+        # slots per step (mean occupancy = sum / steps — the scheduler's
+        # fill factor), and freed_iters is the budget the slot table
+        # handed back by retiring samples the moment they converged or
+        # hit their per-request iters (the wall-clock the monolithic
+        # masked scan would have burned).
+        self.contbatch_admits = 0
+        self.contbatch_retires = 0
+        self.contbatch_steps = 0
+        self.contbatch_occupancy_sum = 0
+        self.contbatch_freed_iters = 0
+        self.contbatch_retargets = 0
         # wire-format byte accounting: staged_bytes is what the host
         # actually memcpy'd into the staging arena per dispatched batch
         # (uint8 wire → 4x less than float32), returned_bytes is what
@@ -326,6 +340,35 @@ class ServingMetrics:
         with self._lock:
             self.early_exit_iters_saved += int(iters_saved)
 
+    def record_contbatch_admit(self, n: int = 1) -> None:
+        """Requests scattered into freed slots of a continuous slot
+        table (on top of ``record_submit``)."""
+        with self._lock:
+            self.contbatch_admits += n
+
+    def record_contbatch_retire(self, n: int, freed_iters: int) -> None:
+        """``n`` slots retired (converged or per-request iters hit),
+        freeing ``freed_iters`` refine iterations of slot budget the
+        monolithic masked scan would have burned as padding."""
+        with self._lock:
+            self.contbatch_retires += n
+            self.contbatch_freed_iters += int(freed_iters)
+
+    def record_contbatch_step(self, occupied: int) -> None:
+        """One ``step_dispatch`` launch with ``occupied`` live slots —
+        mean occupancy (``occupancy_sum / steps``) is the scheduler's
+        fill factor."""
+        with self._lock:
+            self.contbatch_steps += 1
+            self.contbatch_occupancy_sum += int(occupied)
+
+    def record_contbatch_retarget(self, n: int = 1) -> None:
+        """In-flight slots whose remaining-iters budget was re-targeted
+        in place on a brownout rung change (no re-bucketing, no fresh
+        executable)."""
+        with self._lock:
+            self.contbatch_retargets += n
+
     def record_staged_bytes(self, n: int) -> None:
         """Bytes the host copied into the staging arena for one
         dispatched batch (both input planes, tail-padding included —
@@ -443,6 +486,17 @@ class ServingMetrics:
                     self.early_exit_iters_saved),
                 "serving_staged_bytes": float(self.staged_bytes),
                 "serving_returned_bytes": float(self.returned_bytes),
+                "serving_contbatch_admits": float(self.contbatch_admits),
+                "serving_contbatch_retires": float(
+                    self.contbatch_retires),
+                "serving_contbatch_steps": float(self.contbatch_steps),
+                "serving_contbatch_mean_occupancy": (
+                    self.contbatch_occupancy_sum / self.contbatch_steps
+                    if self.contbatch_steps else 0.0),
+                "serving_contbatch_freed_iters": float(
+                    self.contbatch_freed_iters),
+                "serving_contbatch_retargets": float(
+                    self.contbatch_retargets),
             }
             for iters, n in self.quality_hist.items():
                 out[f"serving_quality_iters_{iters}"] = float(n)
@@ -521,7 +575,18 @@ class ServingMetrics:
                 ("serving_staged_bytes", "staged_bytes",
                  "bytes memcpy'd into the staging arena"),
                 ("serving_returned_bytes", "returned_bytes",
-                 "bytes returned through resolved futures")):
+                 "bytes returned through resolved futures"),
+                ("serving_contbatch_admits", "contbatch_admits",
+                 "requests admitted into continuous slot tables"),
+                ("serving_contbatch_retires", "contbatch_retires",
+                 "continuous slots retired at convergence/budget"),
+                ("serving_contbatch_steps", "contbatch_steps",
+                 "continuous step_dispatch launches"),
+                ("serving_contbatch_freed_iters",
+                 "contbatch_freed_iters",
+                 "slot iterations freed by early retirement"),
+                ("serving_contbatch_retargets", "contbatch_retargets",
+                 "in-flight slots re-targeted on brownout rung moves")):
             g(name, help=help_,
               fn=(lambda a=attr: float(getattr(self, a))))
         g("serving_requests_by_class",
@@ -553,6 +618,11 @@ class ServingMetrics:
         g("serving_mean_batch_size",
           help="mean real requests per dispatched batch",
           fn=self.mean_batch_size)
+        g("serving_contbatch_mean_occupancy",
+          help="mean live slots per continuous step",
+          fn=lambda: (self.contbatch_occupancy_sum
+                      / self.contbatch_steps
+                      if self.contbatch_steps else 0.0))
         g("serving_encoder_cache_hit_rate",
           help="encoder fmap cache hit rate",
           fn=lambda: (self.encoder_hits

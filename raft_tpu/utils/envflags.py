@@ -105,6 +105,23 @@ def env_int_choice(
     return val
 
 
+# Continuous (iteration-granular) serving batching. Read at ENGINE
+# CONSTRUCTION time, not trace time: '1' turns the slot scheduler on
+# for every configured stateless bucket when ServingConfig.continuous
+# is left unset, '0' pins it off, 'auto' (default) defers to the
+# config (and currently resolves off — the scheduler is opt-in until
+# an on-TPU capture earns it a default; BASELINE.md round 9).
+CONTBATCH_FLAG = "RAFT_CONTBATCH"
+
+
+def resolve_contbatch() -> str:
+    """Resolved ``RAFT_CONTBATCH`` mode, one of ``'auto'/'0'/'1'`` —
+    the loud-parse gate for the continuous serving scheduler
+    (:mod:`raft_tpu.serving.contbatch`); a misspelled value fails at
+    engine construction, before any bucket warms."""
+    return env_enum(CONTBATCH_FLAG, ("auto", "0", "1"), "auto")
+
+
 @contextlib.contextmanager
 def forced_flag(name: str, value: str | None):
     """Set (or, with ``value=None``, unset) an environment flag for the

@@ -43,6 +43,12 @@ from typing import List
 
 SMOKE_HONESTY_KEYS = ("smoke_operating_point", "criterion_note")
 
+# The round-9 contbatch artifact is an A/B claim: a speedup ratio only
+# means something if BOTH arms were measured in the same run. A payload
+# carrying this metric (without an error) must ship both arms' numbers.
+CONTBATCH_METRIC = "contbatch_vs_bucketed_mixed_iters_throughput_speedup"
+CONTBATCH_ARMS = ("continuous", "bucketed")
+
 
 def _check_trace_artifact(path) -> List[str]:
     """Validate a payload's optional ``trace_artifact`` reference: the
@@ -93,6 +99,15 @@ def check_payload(name: str, payload: dict) -> List[str]:
         problems.append(
             f"off-TPU measurement (platform={platform!r}) carries none "
             f"of the smoke-honesty keys {SMOKE_HONESTY_KEYS}")
+    if payload.get("metric") == CONTBATCH_METRIC:
+        arms = payload.get("per_arm")
+        missing = [a for a in CONTBATCH_ARMS
+                   if not isinstance(arms, dict)
+                   or not isinstance(arms.get(a), dict)]
+        if missing:
+            problems.append(
+                f"contbatch A/B artifact missing arm(s) {missing} in "
+                "'per_arm' — a speedup ratio needs both measurements")
     return [f"{name}: {p}" for p in problems]
 
 

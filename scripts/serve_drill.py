@@ -1279,6 +1279,148 @@ def drill_trace(root):
         disable_tracing()
 
 
+def drill_contbatch(root):
+    """Kill a continuous-batching engine under mixed-iters load: every
+    request accepted before close() resolves to a correct flow (0
+    dropped — occupied slots finish, queued admissions drain), every
+    post-close submit is a clean refusal, and the whole episode —
+    admits, chunked steps, early-exit retires, the drain — compiles
+    nothing post-warmup."""
+    import threading
+
+    import numpy as np
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine, loadgen)
+    from raft_tpu.utils.padder import InputPadder
+
+    full_iters, ladder = 4, (2, 1)
+    levels = [full_iters, *ladder]
+    predictor = load_predictor("random", small=True, iters=full_iters)
+    # Early exit live (loose tolerance): retires must free slots before
+    # their assigned budget, or the drill is not exercising the thing
+    # continuous batching exists for.
+    predictor.early_exit = (5.0, 1)
+    shape = (36, 60)
+    frames = loadgen.make_frames([shape], per_shape=3, seed=67,
+                                 dtype=np.float32)
+
+    # On the continuous path EVERY request runs the early-exit-enabled
+    # step family, full quality included, so all references go through
+    # the iters executables (matches to float-accumulation noise, not
+    # bit-exactly — chunked scan + separate finalize fuse differently).
+    def _refs_at(iters):
+        refs = []
+        for im1, im2 in frames:
+            p = InputPadder(im1.shape, mode="sintel", factor=8)
+            a, b = p.pad(im1, im2)
+            s1 = np.repeat(a[None], 4, 0)
+            s2 = np.repeat(b[None], 4, 0)
+            out = predictor.dispatch_batch(s1, s2, iters=iters)
+            refs.append(p.unpad(np.asarray(out[1])[0]))
+        return refs
+
+    refs_by_iters = {lvl: _refs_at(lvl) for lvl in levels}
+
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=4, max_wait_ms=3.0, buckets=(shape,),
+        iters_ladder=ladder, continuous=True, contbatch_steps=1))
+    warm = engine.warmup()
+    engine.start(warmup=False)
+    assert engine.contbatch is not None, "continuous scheduler not built"
+    warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
+                          for k, v in warm.items())
+    print(f"  warmup: {{bucket: compiles}} = {{{warm_desc}}}")
+    assert any(len(k) > 2 and k[2] == "cont" for k in warm), \
+        f"warmup never touched the continuous step family: {list(warm)}"
+
+    lock = threading.Lock()
+    counter = [0]
+    accepted = []            # (frame_idx, level, future)
+    refused = [0]
+
+    def pump():
+        """Closed-loop client: submit, record the future, wait for it,
+        repeat — exits on the first refusal (the engine closed)."""
+        while True:
+            with lock:
+                i = counter[0]
+                counter[0] += 1
+            im1, im2 = frames[i % len(frames)]
+            lvl = levels[i % len(levels)]
+            try:
+                fut = engine.submit(im1, im2, iters=lvl)
+            except Exception:
+                with lock:
+                    refused[0] += 1
+                return
+            with lock:
+                accepted.append((i % len(frames), lvl, fut))
+            try:
+                fut.result(120)
+            except Exception:
+                return          # graded below via the accepted list
+
+    try:
+        with CompileWatch() as watch:
+            pumps = [threading.Thread(target=pump,
+                                      name=f"contkill-{t}")
+                     for t in range(8)]
+            for th in pumps:
+                th.start()
+            # Let the slot table fill and cycle, then kill mid-flight.
+            deadline = time.monotonic() + 10.0
+            while engine.contbatch.occupied() == 0:
+                if time.monotonic() >= deadline:
+                    raise AssertionError(
+                        "slot table never became occupied under load")
+                time.sleep(0.005)
+            with lock:
+                in_flight = sum(not f.done() for _, _, f in accepted)
+            load_at_kill = engine.contbatch.load()
+            engine.close()
+            for th in pumps:
+                th.join(120)
+    finally:
+        engine.close()
+
+    dropped = 0
+    worst = 0.0
+    for fi, lvl, fut in accepted:
+        try:
+            flow = fut.result(0)
+        except Exception:
+            dropped += 1
+            continue
+        ref = refs_by_iters[lvl][fi]
+        epe = float(np.sqrt(((flow - ref) ** 2).sum(-1)).mean())
+        worst = max(worst, epe)
+    snap = engine.metrics.snapshot()
+    print(f"  kill: {len(accepted)} accepted ({in_flight} unresolved "
+          f"at close, scheduler load {load_at_kill}), "
+          f"{refused[0]} clean post-close refusals")
+    print(f"  drain: dropped={dropped}, worst EPE={worst:.2e}, "
+          f"admits={int(snap['serving_contbatch_admits'])}, "
+          f"retires={int(snap['serving_contbatch_retires'])}, "
+          f"freed_iters={int(snap['serving_contbatch_freed_iters'])}")
+    assert engine.health_state() == "closed", engine.health_state()
+    assert load_at_kill > 0, "close() did not land under load"
+    assert refused[0] == 8, \
+        f"every pump must end on one clean refusal, got {refused[0]}"
+    assert dropped == 0, f"{dropped} accepted requests dropped by close"
+    assert accepted, "no requests accepted before the kill"
+    assert worst <= 1e-4, f"worst EPE {worst} vs iters-path references"
+    assert snap["serving_contbatch_admits"] == \
+        snap["serving_contbatch_retires"], \
+        (f"slots leaked: admits {snap['serving_contbatch_admits']} != "
+         f"retires {snap['serving_contbatch_retires']}")
+    assert snap["serving_contbatch_freed_iters"] > 0, \
+        "early exit never freed a slot-iteration under this tolerance"
+    assert watch.compiles == 0, \
+        f"{watch.compiles} fresh XLA compile(s) during the episode"
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1290,6 +1432,7 @@ DRILLS = [
     drill_highres,
     drill_wire,
     drill_trace,
+    drill_contbatch,
 ]
 
 

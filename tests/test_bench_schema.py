@@ -9,7 +9,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
-from check_bench_schema import check_file, check_payload, main  # noqa: E402
+from check_bench_schema import (CONTBATCH_METRIC, check_file,  # noqa: E402
+                                check_payload, main)
 
 
 def test_committed_artifacts_honor_schema(capsys):
@@ -50,6 +51,27 @@ def test_checker_validates_trace_artifact(tmp_path):
     notrace = tmp_path / "notrace.json"
     notrace.write_text('{"events": []}')
     assert check_payload("shape", dict(base, trace_artifact=str(notrace)))
+
+
+def test_checker_requires_both_contbatch_arms():
+    base = {"metric": CONTBATCH_METRIC, "value": 1.5, "unit": "x",
+            "platform": "cpu", "smoke_operating_point": True}
+    # Both arms present and dict-shaped: clean.
+    ok = dict(base, per_arm={"continuous": {"mixed_iters_pairs_per_sec":
+                                            3.0},
+                             "bucketed": {"mixed_iters_pairs_per_sec":
+                                          2.0}})
+    assert not check_payload("ok", ok)
+    # Missing per_arm entirely, missing one arm, or an arm that is not
+    # an object: all violations — the ratio claim needs both numbers.
+    assert check_payload("none", base)
+    assert check_payload("half", dict(
+        base, per_arm={"continuous": {"x": 1}}))
+    assert check_payload("shape", dict(
+        base, per_arm={"continuous": {"x": 1}, "bucketed": None}))
+    # An honest error record is exempt — there is no ratio to back.
+    assert not check_payload("err", {
+        "metric": CONTBATCH_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_rejects_silent_empty_wrapper(tmp_path):

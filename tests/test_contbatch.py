@@ -1,0 +1,293 @@
+"""Iteration-granular continuous batching (serving/contbatch.py).
+
+Covers the round-9 slot scheduler end to end at a tiny CPU operating
+point, plus the host-side contracts that don't need a device at all:
+
+- the ``RAFT_CONTBATCH`` flag parses loudly through ``env_enum`` and
+  ``forced_flag`` round-trips the environment exactly (nesting,
+  was-unset vs was-set);
+- engine construction resolves the knob (config beats environment,
+  'auto' stays off) without warming anything;
+- ``dispatch_batch(iters=k)`` with ``early_exit`` set never reports
+  more iterations used than the budget ``k`` — the accounting the
+  scheduler's freed-iters metric is built on;
+- ``rebucket_low`` preserves the ``t_submit``/``deadline`` anchors when
+  a brownout rung change interleaves (either way) with the continuous
+  scheduler popping its next admission batch, and never moves requests
+  out of the ``(ph, pw, "cont")`` bucket — quality is per-request state
+  there, not a bucket key;
+- the in-place slot re-target arithmetic (degrade-only, degradable
+  slots only, spent iterations honored);
+- the served path: mixed-iters traffic through a continuous engine
+  matches per-level ``dispatch_batch(iters=k)`` references within the
+  cross-executable EPE tolerance, with zero post-warmup compiles and a
+  slot table that admits exactly as often as it retires.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils import envflags
+
+# Cross-executable tolerance: the chunked step family runs the same
+# per-iteration math as the monolithic masked scan but XLA fuses the
+# two programs differently, so flow parity is float-accumulation noise
+# (measured ~2e-6 EPE at this operating point), not bit-equality. The
+# acceptance budget is 1e-4; assert with headroom against drift.
+EPE_TOL = 1e-4
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    from raft_tpu.evaluate import load_predictor
+    pred = load_predictor("random", small=True, iters=4)
+    # Loose tolerance so a fraction of requests genuinely converge
+    # before their budget — the thing the scheduler turns into freed
+    # slots (cache keys carry early_exit, so this can't corrupt other
+    # suites' executables).
+    pred.early_exit = (5.0, 1)
+    return pred
+
+
+# -- flag parsing -------------------------------------------------------
+
+
+def test_contbatch_flag_forced_flag_roundtrip(monkeypatch):
+    flag = envflags.CONTBATCH_FLAG
+    assert flag == "RAFT_CONTBATCH"
+    monkeypatch.delenv(flag, raising=False)
+    assert envflags.resolve_contbatch() == "auto"
+    # Round-trip from unset: forced value visible inside, deleted after.
+    with envflags.forced_flag(flag, "1"):
+        assert envflags.resolve_contbatch() == "1"
+        # Nested unset restores the outer forced value on exit.
+        with envflags.forced_flag(flag, None):
+            assert envflags.resolve_contbatch() == "auto"
+        assert envflags.resolve_contbatch() == "1"
+    assert os.environ.get(flag) is None
+    # Round-trip from a set value, including via an exception exit.
+    monkeypatch.setenv(flag, "0")
+    with pytest.raises(RuntimeError, match="arm blew up"):
+        with envflags.forced_flag(flag, "1"):
+            assert envflags.resolve_contbatch() == "1"
+            raise RuntimeError("arm blew up")
+    assert os.environ[flag] == "0"
+    assert envflags.resolve_contbatch() == "0"
+    # Loud parse: a misspelling names the flag and the accepted set.
+    monkeypatch.setenv(flag, "maybe")
+    with pytest.raises(ValueError, match="RAFT_CONTBATCH must be one"):
+        envflags.resolve_contbatch()
+
+
+def test_engine_resolves_contbatch_knob(predictor, monkeypatch):
+    """Construction-time resolution, no warmup: config wins over the
+    environment; 'auto' (and unset) stays off."""
+    from raft_tpu.serving import ServingConfig, ServingEngine
+
+    base = dict(max_batch=2, max_wait_ms=2.0, buckets=((36, 60),))
+    monkeypatch.delenv(envflags.CONTBATCH_FLAG, raising=False)
+    assert ServingEngine(predictor, ServingConfig(**base)) \
+        .contbatch is None
+    assert ServingEngine(predictor, ServingConfig(
+        **base, continuous=True)).contbatch is not None
+    monkeypatch.setenv(envflags.CONTBATCH_FLAG, "1")
+    assert ServingEngine(predictor, ServingConfig(**base)) \
+        .contbatch is not None
+    # Explicit config beats the environment in both directions.
+    assert ServingEngine(predictor, ServingConfig(
+        **base, continuous=False)).contbatch is None
+    monkeypatch.setenv(envflags.CONTBATCH_FLAG, "0")
+    assert ServingEngine(predictor, ServingConfig(
+        **base, continuous=True)).contbatch is not None
+
+
+# -- early-exit accounting ---------------------------------------------
+
+
+def test_iters_used_never_exceeds_budget(predictor, rng):
+    """``dispatch_batch(iters=k)`` with early_exit set reports
+    per-sample iterations used in [1, k] — at a tolerance loose enough
+    that everything converges immediately AND one tight enough that
+    nothing ever does."""
+    i1 = rng.uniform(0, 255, (2, 40, 64, 3)).astype(np.float32)
+    i2 = rng.uniform(0, 255, (2, 40, 64, 3)).astype(np.float32)
+    saved = predictor.early_exit
+    try:
+        for tol in (100.0, 1e-12):
+            predictor.early_exit = (tol, 1)
+            for k in (1, 3):
+                out = predictor.dispatch_batch(i1, i2, iters=k)
+                assert len(out) == 3, \
+                    "early-exit iters path must report iters_used"
+                used = np.asarray(out[2])
+                assert used.shape == (2,)
+                assert np.all(used >= 1), used
+                assert np.all(used <= k), \
+                    f"iters_used {used} exceeds budget {k} (tol={tol})"
+                if tol == 1e-12:
+                    assert np.all(used == k), \
+                        f"nothing can converge at tol=1e-12: {used}"
+    finally:
+        predictor.early_exit = saved
+
+
+# -- batcher anchors under the rung-change/retirement race --------------
+
+
+def _low_req(bucket, t_submit, iters=None):
+    from raft_tpu.serving.batcher import PRIORITY_LOW, QueuedRequest
+    img = np.zeros((40, 64, 3), np.float32)
+    return QueuedRequest(img, img, None, bucket, t_submit=t_submit,
+                         deadline=t_submit + 30.0,
+                         priority=PRIORITY_LOW, degradable=True,
+                         iters=iters)
+
+
+def test_rebucket_low_anchors_vs_retirement_race():
+    """A brownout rung change (``rebucket_low``) and the continuous
+    scheduler popping its next admission batch (what a slot retirement
+    triggers) serialize on the batcher lock, so the two interleavings
+    are exactly 'rung change first' and 'pop first'. In BOTH: moved
+    monolithic requests keep their original ``t_submit``/``deadline``
+    anchors, and ``(ph, pw, "cont")`` requests never move — their
+    quality is per-request state the scheduler re-targets in place."""
+    from raft_tpu.serving.batcher import ShapeBucketBatcher
+
+    cont_bucket = (40, 64, "cont")
+    full_bucket = (40, 64, "f32")
+    level_bucket = (40, 64, 2, "f32")
+
+    def mapper(req):
+        # The engine's rung-change policy shape: continuous requests
+        # stay put; full-quality monolithic LOW moves to the rung.
+        if req.bucket[-1] == "cont":
+            return None
+        return level_bucket
+
+    def build():
+        clock = [1000.0]
+        b = ShapeBucketBatcher(max_batch=4, max_wait_s=0.0,
+                               clock=lambda: clock[0])
+        cont = _low_req(cont_bucket, 1000.0, iters=4)
+        mono = _low_req(full_bucket, 1000.5)
+        b.enqueue(cont)
+        b.enqueue(mono)
+        clock[0] = 1002.0       # both past max_wait, neither expired
+        return b, cont, mono
+
+    # Interleaving 1: rung change lands before the scheduler's pop.
+    b, cont, mono = build()
+    assert b.rebucket_low(mapper) == 1
+    assert cont.bucket == cont_bucket and cont.iters == 4
+    assert mono.bucket == level_bucket
+    assert (cont.t_submit, cont.deadline) == (1000.0, 1030.0)
+    assert (mono.t_submit, mono.deadline) == (1000.5, 1030.5)
+    popped = [b.next_batch(timeout=1.0), b.next_batch(timeout=1.0)]
+    got = {r.bucket for batch in popped for r in batch}
+    assert got == {cont_bucket, level_bucket}
+
+    # Interleaving 2: the pop (retirement-driven admission) wins the
+    # lock first; the rung change then sees only what is still queued.
+    b, cont, mono = build()
+    first = b.next_batch(timeout=1.0)
+    assert first, "a batch must close once past max_wait"
+    assert b.rebucket_low(mapper) == (0 if first[0] is mono else 1)
+    for r in (cont, mono):
+        assert r.t_submit in (1000.0, 1000.5)
+        assert r.deadline == r.t_submit + 30.0
+    assert cont.bucket == cont_bucket, \
+        "a popped-or-queued continuous request must never be re-bucketed"
+
+
+# -- in-place slot re-target -------------------------------------------
+
+
+def test_worker_retarget_degrade_only():
+    """The brownout re-target arithmetic on a hand-built slot table:
+    occupied degradable slots get ``min(rem, max(target - 1 - used,
+    0))``; explicit-iters (non-degradable) slots and free slots are
+    untouched; stepping back up never adds iterations."""
+    from raft_tpu.serving.contbatch import _ContWorker
+
+    w = object.__new__(_ContWorker)      # host-state surface only
+    w._lock = threading.Lock()
+    w.slots = 4
+    w.remaining = np.array([3, 3, 2, 0], np.int32)
+    w.used = np.array([0, 1, 0, 0], np.int32)
+    w.assigned = np.array([4, 4, 4, 0], np.int32)
+    free = object()
+    reqs = [_low_req((40, 64, "cont"), 1000.0, iters=4)
+            for _ in range(3)]
+    reqs[2].degradable = False           # explicit client iters
+    w.requests = reqs + [None]
+
+    assert w.retarget(2) == 2
+    # slot 0: used 0 -> rem min(3, 2-1-0)=1; slot 1: used 1 -> rem 0;
+    # slot 2 non-degradable and slot 3 free: untouched.
+    assert w.remaining.tolist() == [1, 0, 2, 0]
+    assert w.assigned.tolist() == [2, 2, 4, 0]
+    # Recovery to full quality never re-inflates in-flight budgets.
+    assert w.retarget(4) == 0
+    assert w.remaining.tolist() == [1, 0, 2, 0]
+    del free
+
+
+# -- served path --------------------------------------------------------
+
+
+def test_continuous_engine_mixed_iters_parity(predictor, rng):
+    """Mixed-iters traffic through a continuous engine: every response
+    within EPE tolerance of its level's ``dispatch_batch(iters=k)``
+    reference, zero post-warmup compiles, admits == retires (no leaked
+    slots), and early exit actually freeing slot-iterations at this
+    tolerance."""
+    from raft_tpu.serving import (CompileWatch, ServingConfig,
+                                  ServingEngine)
+    from raft_tpu.utils.padder import InputPadder
+
+    levels = [4, 2, 1]
+    frames = []
+    for _ in range(6):
+        frames.append((
+            rng.uniform(0, 255, (36, 60, 3)).astype(np.float32),
+            rng.uniform(0, 255, (36, 60, 3)).astype(np.float32)))
+
+    def ref_flow(a, b, iters):
+        p = InputPadder(a.shape, mode="sintel", factor=8)
+        pa, pb = p.pad(a, b)
+        out = predictor.dispatch_batch(np.repeat(pa[None], 2, 0),
+                                       np.repeat(pb[None], 2, 0),
+                                       iters=iters)
+        return p.unpad(np.asarray(out[1])[0])
+
+    refs = [ref_flow(a, b, levels[i % 3])
+            for i, (a, b) in enumerate(frames)]
+
+    eng = ServingEngine(predictor, ServingConfig(
+        max_batch=2, max_wait_ms=2.0, buckets=((36, 60),),
+        iters_ladder=(2, 1), continuous=True, contbatch_steps=1))
+    eng.start()
+    try:
+        with CompileWatch() as w:
+            futs = [eng.submit(a, b, iters=levels[i % 3])
+                    for i, (a, b) in enumerate(frames)]
+            flows = [f.result(120) for f in futs]
+    finally:
+        eng.close()
+
+    worst = max(float(np.sqrt(((fl - ref) ** 2).sum(-1)).mean())
+                for fl, ref in zip(flows, refs))
+    assert worst <= EPE_TOL, worst
+    assert w.compiles == 0, \
+        f"{w.compiles} fresh XLA compile(s) under warmed mixed traffic"
+    snap = eng.metrics.snapshot()
+    assert snap["serving_contbatch_admits"] == 6
+    assert snap["serving_contbatch_retires"] == 6
+    assert snap["serving_contbatch_steps"] >= 1
+    assert snap["serving_contbatch_freed_iters"] > 0, \
+        "tol=5.0 traffic must converge early somewhere"
+    assert snap["serving_early_exit_iters_saved"] >= \
+        snap["serving_contbatch_freed_iters"]

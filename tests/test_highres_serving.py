@@ -21,8 +21,10 @@ Covers the serving-stack threading of ``parallel/spatial.py``:
 - the fleet's disjoint ``"HxW@mesh"`` digest namespace, golden-pinned,
   and the capacity gate: sharded buckets route only to mesh-hosting
   replicas and shed with an error naming the mesh when none is left
-- the streaming-path refusal (deferred half of the warm-start
-  satellite): cached feature maps have no sharding specs yet
+- the streaming session path OVER a meshed predictor (round-6's
+  deferred refusal, closed): cached per-session feature maps carry
+  row-sharding specs like ``flow_init``'s, with only the precise
+  indivisible-rows case still refusing loudly
 """
 
 import numpy as np
@@ -127,16 +129,39 @@ class TestShardedDispatchParity:
         assert not np.allclose(up_s, np.asarray(up_cold)[0], atol=1e-3)
 
     @pytest.mark.multidevice
-    def test_streaming_refusal_pinned(self, predictor, mesh4):
-        """Deferred half of the warm-start satellite: the split
-        encode/refine streaming path still refuses meshed predictors —
-        the cached feature maps would need their own sharding specs
-        (ROADMAP notes the deferral)."""
+    def test_streaming_over_sharded(self, predictor, mesh4, rng):
+        """Round-6's deferred refusal, closed: the split encode/refine
+        session path runs over a meshed predictor — the cached
+        per-session feature maps carry row-sharding specs like
+        ``flow_init``'s — and matches the unsharded session path within
+        the cross-executable tolerance."""
         meshed = predictor.clone_with_variables(predictor.variables)
         meshed.mesh = mesh4
-        with pytest.raises(ValueError, match="streaming encode path is "
-                           "not supported with spatially-sharded eval"):
-            meshed.encode_dispatch(np.zeros((1, *HI, 3), np.float32))
+        i1 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        i2 = rng.uniform(0, 255, (1, *HI, 3)).astype(np.float32)
+        fm1 = meshed.encode_dispatch(i1)
+        fm2 = meshed.encode_dispatch(i2)
+        low_s, up_s = map(np.asarray,
+                          meshed.refine_dispatch(i1, fm1, fm2))
+        uf1 = predictor.encode_dispatch(i1)
+        uf2 = predictor.encode_dispatch(i2)
+        low_u, up_u = map(np.asarray,
+                          predictor.refine_dispatch(i1, uf1, uf2))
+        assert up_s.shape == up_u.shape == (1, *HI, 2)
+        assert np.max(np.abs(up_s - up_u)) < TOL
+        assert np.max(np.abs(low_s - low_u)) < TOL
+
+    @pytest.mark.multidevice
+    def test_streaming_sharded_indivisible_rows_refused(self, predictor,
+                                                        mesh4):
+        """What remains refused is precise, not blanket: padded heights
+        that don't divide ``spatial_shards * 8`` (the fmaps are
+        row-sharded at 1/8 resolution) fail loudly at dispatch instead
+        of surfacing as a GSPMD error mid-stream."""
+        meshed = predictor.clone_with_variables(predictor.variables)
+        meshed.mesh = mesh4
+        with pytest.raises(ValueError, match="padded rows divisible"):
+            meshed.encode_dispatch(np.zeros((1, 40, 64, 3), np.float32))
 
     @pytest.mark.multidevice
     def test_per_request_iters_refused(self, predictor, mesh4):
