@@ -1547,6 +1547,182 @@ def _contbatch_failure(msg: str) -> None:
            "error": msg})
 
 
+GATEWAY_METRIC = "gateway_vs_inprocess_p50_latency_overhead_ms"
+
+
+def gateway_main(arm: str = "ab"):
+    """``python bench.py serving --gateway {ab,on,off}`` — socket-hop
+    overhead of the multi-process serving tier (BENCH_gateway).
+
+    Both arms run the SAME predictor, engine config, frames, and
+    closed-loop load: the ``in_process`` arm submits straight to a
+    :class:`~raft_tpu.serving.engine.ServingEngine` (the path every
+    serving benchmark to date measured); the ``gateway`` arm puts that
+    same engine behind a :class:`~raft_tpu.serving.worker.WorkerServer`
+    socket in this process and routes through a
+    :class:`~raft_tpu.serving.gateway.ServingGateway` over a file lease
+    store — so the delta is exactly the network tier's toll (length-
+    prefixed framing, the uint8 wire bytes over a local socket into the
+    worker's staging arena, lease-routed dispatch) and not a different
+    model, batcher, or host. The headline is client-observed p50
+    latency through the gateway minus in-process p50, in ms (both from
+    ``run_load``'s submit-to-result clock, the number a caller actually
+    feels). ``on``/``off`` run a single arm for debugging.
+
+    Honesty contract: every response in BOTH arms is checked bit-exact
+    against same-executable references, and both arms must serve with
+    ZERO post-warmup compiles — the gateway path rides the exact
+    executables the in-process path warmed."""
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+    from raft_tpu.serving.gateway import GatewayConfig, ServingGateway
+    from raft_tpu.serving.metrics import CompileWatch
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+    platform = jax.devices()[0].platform
+    ncores = os.cpu_count() or 1
+    if platform == "tpu":
+        shapes = [(436, 1024)]
+        small, iters = False, ITERS
+        max_batch, concurrency, n_requests = 16, 16, 128
+        max_wait_ms = 5.0
+    else:
+        shapes = [(64, 96), (61, 93)]     # two raws, one padded bucket
+        small, iters = True, 2
+        max_batch, concurrency, n_requests = 4, 8, 48
+        max_wait_ms = 3.0
+
+    predictor = load_predictor("random", small=small, iters=iters)
+    frames = loadgen.make_frames(shapes, per_shape=2, seed=0)
+    refs = loadgen.batched_reference_flows(frames=frames,
+                                           predictor=predictor,
+                                           max_batch=max_batch)
+    cfg = ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        buckets=tuple(shapes), persistent_cache=True)
+
+    def _arm_record(res, watch, warm_s) -> dict:
+        # Single replica per arm, so per_replica has exactly one entry:
+        # its client-observed (submit -> result) latency is the number
+        # both arms are compared on.
+        client = next(iter(res["per_replica"].values()))["latency_ms"]
+        return {
+            "completed": res["completed"],
+            "dropped": len(res["dropped"]),
+            "mismatched": len(res["mismatched"]),
+            "p50_ms": round(client["p50"], 3),
+            "p99_ms": round(client["p99"], 3),
+            "throughput_rps": round(res["throughput_rps"], 3),
+            "post_warmup_compiles": watch.compiles,
+            "warmup_seconds": warm_s,
+        }
+
+    def _run_in_process() -> dict:
+        engine = ServingEngine(predictor, cfg)
+        t0 = time.perf_counter()
+        engine.warmup()
+        warm_s = round(time.perf_counter() - t0, 3)
+        engine.start(warmup=False)
+        try:
+            with CompileWatch() as watch:
+                res = loadgen.run_load(
+                    engine, frames, n_requests=n_requests,
+                    concurrency=concurrency, references=refs,
+                    timeout=600.0)
+        finally:
+            engine.close()
+        return _arm_record(res, watch, warm_s)
+
+    def _run_gateway(lease_dir: str) -> dict:
+        store = FileLeaseStore(lease_dir)
+        engine = ServingEngine(predictor, dataclasses.replace(
+            cfg, replica_id="w0"))
+        server = WorkerServer(
+            engine,
+            WorkerConfig(worker_id="w0", lease_dir=lease_dir,
+                         heartbeat_interval_s=0.2,
+                         buckets=tuple(shapes), max_batch=max_batch,
+                         max_wait_ms=max_wait_ms, step=0),
+            lease_store=store)
+        t0 = time.perf_counter()
+        server.start(warmup=True)
+        warm_s = round(time.perf_counter() - t0, 3)
+        gw = ServingGateway(store, GatewayConfig(
+            queue_timeout_ms=600_000, lease_ttl_s=2.0,
+            poll_interval_s=0.1, dispatch_threads=concurrency,
+            expected_step=0))
+        try:
+            gw.start()
+            t_join = time.monotonic() + 120.0
+            while not gw.live_workers():
+                if time.monotonic() > t_join:
+                    raise RuntimeError("worker never became routable")
+                time.sleep(0.05)
+            with CompileWatch() as watch:
+                res = loadgen.run_load(
+                    gw, frames, n_requests=n_requests,
+                    concurrency=concurrency, references=refs,
+                    timeout=600.0)
+            lease = store.read_all().get("w0")
+            lease_compiles = (lease.extra.get("post_warmup_compiles")
+                              if lease is not None else None)
+        finally:
+            gw.close()
+            server.stop()
+        rec = _arm_record(res, watch, warm_s)
+        rec["worker_lease_compiles"] = lease_compiles
+        return rec
+
+    per_arm = {}
+    if arm in ("ab", "off"):
+        per_arm["in_process"] = _run_in_process()
+    if arm in ("ab", "on"):
+        with tempfile.TemporaryDirectory() as lease_dir:
+            per_arm["gateway"] = _run_gateway(lease_dir)
+
+    overhead = None
+    if "in_process" in per_arm and "gateway" in per_arm:
+        overhead = round(per_arm["gateway"]["p50_ms"]
+                         - per_arm["in_process"]["p50_ms"], 3)
+    payload = {
+        "metric": GATEWAY_METRIC,
+        "value": overhead,
+        "unit": "ms",
+        "platform": platform,
+        "host_cores": ncores,
+        "model": "raft-small" if small else "raft-large",
+        "iters": iters,
+        "shapes": [list(s) for s in shapes],
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "gateway_arm": arm,
+        "per_arm": per_arm,
+    }
+    if platform != "tpu":
+        payload["smoke_operating_point"] = True
+        payload["criterion_note"] = (
+            "both arms run the same small-model executables on this "
+            f"{ncores}-core {platform} host, so the p50 DELTA isolates "
+            "the local-socket gateway hop (framing + wire bytes + "
+            "lease routing) at a smoke operating point; absolute "
+            "latencies are smoke numbers, and the flagship-shape "
+            "on-TPU capture is tracked as ROADMAP debt")
+    _emit(payload)
+
+
+def _gateway_failure(msg: str) -> None:
+    _emit({"metric": GATEWAY_METRIC, "value": None, "unit": "ms",
+           "error": msg})
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "streaming":
         try:
@@ -1609,6 +1785,15 @@ if __name__ == "__main__":
                                  "bucketed monolithic path and records "
                                  "the throughput ratio (the BENCH_r09 "
                                  "artifact); 'on'/'off' run one arm")
+            ap.add_argument("--gateway", choices=("ab", "on", "off"),
+                            default=None,
+                            help="multi-process gateway-hop benchmark "
+                                 "instead of the throughput benchmark: "
+                                 "'ab' serves the same load in-process "
+                                 "and through the socket gateway and "
+                                 "records the p50 latency overhead "
+                                 "(the BENCH_gateway artifact); "
+                                 "'on'/'off' run one arm")
             ap.add_argument("--trace", action="store_true",
                             help="record a request-scoped trace of the "
                                  "benchmark run and ship its path as "
@@ -1616,6 +1801,14 @@ if __name__ == "__main__":
                                  "(Perfetto-loadable Chrome trace "
                                  "JSON)")
             args = ap.parse_args(sys.argv[2:])
+            if args.gateway is not None:
+                try:
+                    gateway_main(arm=args.gateway)
+                except SystemExit:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    _gateway_failure(f"{type(e).__name__}: {e}")
+                sys.exit(0)
             if args.contbatch is not None:
                 try:
                     contbatch_main(arm=args.contbatch)

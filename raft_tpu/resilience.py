@@ -303,6 +303,22 @@ class FaultInjector:
       containing it fails at dispatch, and on the engine's
       retry-as-singles isolation pass only the poisoned request itself
       fails. Exercises batch error isolation without monkeypatching.
+    * ``RAFT_FAULT_WORKER_KILL_NTH=N`` — the Nth request a serving
+      worker process receives (1-based receive order) kills the
+      process with ``os._exit`` mid-request — the true process-death
+      simulation behind the multi-process gateway drill: the accepted
+      request's connection drops, the gateway retries it on the next
+      healthy owner, and the supervisor respawns the worker.
+    * ``RAFT_FAULT_WORKER_HEARTBEAT_STALL_S=S`` — the worker's
+      heartbeat-lease publisher stalls ONCE for S seconds (the process
+      keeps serving): its lease goes stale, the gateway marks it
+      unroutable, and the supervisor's stale-lease detector fires —
+      the alive-but-unproven failure mode.
+    * ``RAFT_FAULT_WORKER_SOCKET_DROP=N`` — the first N responses a
+      worker would send are dropped by closing the connection AFTER
+      the request was accepted and served — the post-acceptance
+      network fault the gateway's retry-on-next-owner contract is
+      proven against.
     * ``RAFT_FAULT_TARGET_PROCESS=K`` — restrict EVERY host-side fault
       above to the host with ``jax.process_index() == K`` (multi-host
       drills: exactly one simulated host fails while the others
@@ -321,6 +337,9 @@ class FaultInjector:
     ckpt_commit_errors: int = 0
     serving_dispatch_errors: int = 0
     serving_poison_nth: int = 0
+    worker_kill_nth: int = 0
+    worker_heartbeat_stall_s: float = 0.0
+    worker_socket_drop: int = 0
     target_process: Optional[int] = None
 
     @staticmethod
@@ -342,6 +361,13 @@ class FaultInjector:
                 os.environ.get("RAFT_FAULT_SERVING_DISPATCH_ERRORS", "0")),
             serving_poison_nth=int(
                 os.environ.get("RAFT_FAULT_SERVING_POISON_NTH", "0")),
+            worker_kill_nth=int(
+                os.environ.get("RAFT_FAULT_WORKER_KILL_NTH", "0")),
+            worker_heartbeat_stall_s=float(
+                os.environ.get("RAFT_FAULT_WORKER_HEARTBEAT_STALL_S",
+                               "0")),
+            worker_socket_drop=int(
+                os.environ.get("RAFT_FAULT_WORKER_SOCKET_DROP", "0")),
             target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
@@ -392,6 +418,41 @@ class FaultInjector:
         return (self.serving_poison_nth > 0 and self._on_target()
                 and submit_seq % self.serving_poison_nth == 0)
 
+    def kills_worker_request(self, recv_seq: int) -> bool:
+        """Whether the ``recv_seq``-th request RECEIVED by this worker
+        process (1-based receive order) should kill the process. The
+        caller (``WorkerServer``) does the actual ``os._exit`` so the
+        death happens mid-request — after the gateway's bytes were
+        accepted, before any response — which is exactly the window
+        the gateway's post-acceptance retry must cover. Fires once:
+        the respawned worker starts a fresh receive counter, but the
+        injector state does not cross the exec boundary unless the
+        env var is re-exported to it."""
+        return (self.worker_kill_nth > 0 and self._on_target()
+                and recv_seq == self.worker_kill_nth)
+
+    def take_heartbeat_stall(self) -> float:
+        """One-shot: the first call on the target process returns the
+        configured stall seconds (the worker's heartbeat loop sleeps
+        that long before its next publish, letting the lease expire
+        while the process serves on); later calls return 0."""
+        if self.worker_heartbeat_stall_s > 0 and self._on_target():
+            stall = self.worker_heartbeat_stall_s
+            self.worker_heartbeat_stall_s = 0.0
+            return stall
+        return 0.0
+
+    def maybe_drop_worker_socket(self) -> bool:
+        """Whether to drop this response's connection instead of
+        replying; burns one unit of the budget per True. Called by the
+        worker AFTER the request was served — the reply bytes are the
+        only casualty, so the gateway's retry on the next owner must
+        still produce a bit-exact response."""
+        if self.worker_socket_drop > 0 and self._on_target():
+            self.worker_socket_drop -= 1
+            return True
+        return False
+
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
@@ -404,7 +465,10 @@ class FaultInjector:
         return bool(self.ckpt_save_errors or self.corrupt_sample_indices
                     or self.nan_loss_steps or self.ckpt_commit_errors
                     or self.serving_dispatch_errors
-                    or self.serving_poison_nth)
+                    or self.serving_poison_nth
+                    or self.worker_kill_nth
+                    or self.worker_heartbeat_stall_s
+                    or self.worker_socket_drop)
 
 
 _ACTIVE: Optional[FaultInjector] = None

@@ -42,6 +42,16 @@ forward itself:
   response-level failover, fleet-wide rolling hot reload
   (canary-one-then-wave, whole-fleet rollback on drift), and
   fleet-aggregated metrics.
+* :mod:`~raft_tpu.serving.netproto` / :mod:`~raft_tpu.serving.worker`
+  / :mod:`~raft_tpu.serving.gateway` / :mod:`~raft_tpu.serving
+  .supervisor` — the multi-process tier: replica engines in separate
+  OS processes behind a length-prefixed local-socket protocol (the
+  uint8 wire bytes network-fed into each worker's staging arena, with
+  absolute deadlines propagated and enforced at every hop), heartbeat-
+  lease membership over the coordination KV (file-store fallback),
+  rendezvous routing over live lease-holders with the fleet's
+  failover-not-timeout retry contract, and supervised respawn with
+  exponential backoff + a crash-loop breaker.
 * :mod:`~raft_tpu.serving.session` — stateful streaming sessions
   (``open_stream``): warm-start ``flow_init`` from the previous pair's
   flow at reduced ``warm_iters``, plus encoder feature-map reuse (one
@@ -64,13 +74,24 @@ from raft_tpu.serving.fleet import (BucketRouter, FleetMetrics,
                                     FleetReloadConfig, FleetReloader,
                                     FleetStreamSession, ServingFleet,
                                     make_fleet)
+from raft_tpu.serving.gateway import (GatewayConfig, GatewayMetrics,
+                                      ServingGateway, SocketTransport,
+                                      WorkerConnectionError)
 from raft_tpu.serving.health import (CircuitBreaker, EngineUnhealthy,
-                                     HEALTH_CODES, ROUTABLE, is_routable)
+                                     HEALTH_CODES, ROUTABLE, STALE,
+                                     is_routable)
 from raft_tpu.serving.metrics import (CompileWatch, ServingMetrics,
                                       xla_compile_count)
+from raft_tpu.serving.netproto import (CoordKVLeaseStore, FileLeaseStore,
+                                       Lease, ProtocolError,
+                                       default_lease_store, owners_key)
 from raft_tpu.serving.reload import (CanaryResult, HotReloader,
-                                     ReloadConfig, load_step_variables)
+                                     ReloadConfig, ReloadSnapshot,
+                                     load_step_variables)
 from raft_tpu.serving.session import StreamSession
+from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+from raft_tpu.serving.worker import (WorkerConfig, WorkerServer,
+                                     spawn_worker)
 
 __all__ = [
     "BacklogFull",
@@ -79,34 +100,52 @@ __all__ = [
     "CanaryResult",
     "CircuitBreaker",
     "CompileWatch",
+    "CoordKVLeaseStore",
     "EngineUnhealthy",
+    "FileLeaseStore",
     "FleetMetrics",
     "FleetReloadConfig",
     "FleetReloader",
     "FleetStreamSession",
+    "GatewayConfig",
+    "GatewayMetrics",
     "HEALTH_CODES",
     "HotReloader",
+    "Lease",
+    "ProtocolError",
     "PRIORITIES",
     "PRIORITY_HIGH",
     "PRIORITY_LOW",
     "QueuedRequest",
     "ROUTABLE",
     "ReloadConfig",
+    "ReloadSnapshot",
     "RequestTimedOut",
+    "STALE",
     "ServingConfig",
     "ServingEngine",
     "ServingFleet",
+    "ServingGateway",
     "ServingMetrics",
     "ShapeBucketBatcher",
+    "SocketTransport",
     "StreamSession",
     "WIRE_F32",
     "WIRE_U8",
+    "WorkerConfig",
+    "WorkerConnectionError",
+    "WorkerServer",
+    "WorkerSpec",
+    "WorkerSupervisor",
+    "default_lease_store",
     "enable_persistent_compile_cache",
     "is_routable",
     "load_step_variables",
     "make_engine",
     "make_fleet",
+    "owners_key",
     "request_wire",
+    "spawn_worker",
     "upsample_flow",
     "wire_cast",
     "xla_compile_count",

@@ -1281,7 +1281,8 @@ class ServingEngine:
                priority: str = PRIORITY_HIGH,
                iters: Optional[int] = None,
                low_res: bool = False,
-               trace_id: Optional[int] = None):
+               trace_id: Optional[int] = None,
+               deadline_s: Optional[float] = None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the unpadded ``(H, W, 2)`` flow (float32 numpy).
         ``image1``/``image2``: (H, W, 3) arrays in [0, 255], any
@@ -1309,7 +1310,13 @@ class ServingEngine:
         trace track — passed by the fleet so an engine attempt's
         ``request`` span lands on the same Perfetto lane as the fleet's
         outer ``fleet_request`` span; clients leave it ``None``
-        (ignored when tracing is disabled). Thread-safe.
+        (ignored when tracing is disabled). ``deadline_s``: an absolute
+        ``time.monotonic()`` deadline carried in from an upstream hop
+        (the network gateway propagates the client's budget this way);
+        the request's queue deadline becomes the EARLIER of this and
+        the config-derived ``queue_timeout_ms`` one, so a request whose
+        budget was mostly spent upstream expires here instead of
+        serving a too-late answer. Thread-safe.
         """
         if iters is not None:
             iters = int(iters)
@@ -1337,7 +1344,8 @@ class ServingEngine:
                     "quality")
             return self._submit_sharded(image1, image2, priority,
                                         sharded_bucket, low_res=low_res,
-                                        trace_id=trace_id)
+                                        trace_id=trace_id,
+                                        deadline_s=deadline_s)
         # Root span: opened here (all validation raises are behind us,
         # so every opened span has a future that will resolve), closed
         # by _trace_end wherever that future resolves. With tracing
@@ -1393,6 +1401,9 @@ class ServingEngine:
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
+        if deadline_s is not None:
+            deadline = (deadline_s if deadline is None
+                        else min(deadline, deadline_s))
         with self._state_lock:
             self._submit_seq += 1
             seq = self._submit_seq
@@ -1411,7 +1422,8 @@ class ServingEngine:
 
     def _submit_sharded(self, image1, image2, priority,
                         bucket, low_res: bool = False,
-                        trace_id: Optional[int] = None) -> "Future":
+                        trace_id: Optional[int] = None,
+                        deadline_s: Optional[float] = None) -> "Future":
         """Enqueue one request onto its ``(ph, pw, "mesh", wire)``
         sharded bucket: padded at the sharded factor (rows always
         divide the spatial axis), never brownout-degradable (the
@@ -1438,6 +1450,9 @@ class ServingEngine:
         t_submit = time.monotonic()
         timeout = self.config.queue_timeout_ms
         deadline = (t_submit + timeout / 1e3) if timeout else None
+        if deadline_s is not None:
+            deadline = (deadline_s if deadline is None
+                        else min(deadline, deadline_s))
         with self._state_lock:
             self._submit_seq += 1
             seq = self._submit_seq

@@ -83,7 +83,7 @@ from raft_tpu.serving.engine import ServingConfig, ServingEngine
 from raft_tpu.serving.health import EngineUnhealthy, is_routable
 from raft_tpu.serving.metrics import CompileWatch, _percentile
 from raft_tpu.serving.reload import (HotReloader, ReloadConfig,
-                                     load_step_variables)
+                                     ReloadSnapshot, load_step_variables)
 from raft_tpu.utils.padder import InputPadder
 
 logger = logging.getLogger(__name__)
@@ -1299,6 +1299,19 @@ class FleetReloader:
         if ok:
             standby = candidate
         return standby, reason, watch.compiles, infra
+
+    def snapshot(self) -> ReloadSnapshot:
+        """Serializable point-in-time rollout state: the adopted step,
+        pinned (canary-rejected) steps, the in-flight wave target, and
+        the step each replica serves. The supported read surface for
+        anything outside this process — a worker lease publishing its
+        served step, the gateway's cross-process step-sync gate — so
+        membership plumbing never reaches into reloader internals."""
+        return ReloadSnapshot(
+            current_step=self.current_step,
+            pinned_steps=tuple(sorted(self.pinned_steps)),
+            wave_step=self._wave_step,
+            replica_steps=dict(self.replica_steps))
 
     def replica_in_sync(self, replica_id: str) -> bool:
         """Whether ``replica_id`` serves the fleet's adopted weights

@@ -1,0 +1,686 @@
+"""The serving gateway: one process-local front door routing requests
+to replica worker PROCESSES over the lease/socket membership plane.
+
+The multi-process counterpart of :class:`~raft_tpu.serving.fleet
+.ServingFleet` — same routing math, same failover contract, but the
+replicas are OS processes discovered through heartbeat leases
+(:mod:`~raft_tpu.serving.netproto`) instead of engine objects held
+in-process:
+
+* **Membership** — :meth:`refresh_membership` reads the lease store;
+  a lease older than ``lease_ttl_s`` is assigned
+  :data:`~raft_tpu.serving.health.STALE` (the process may live, the
+  replica is unproven), and a worker is *routable* only when its lease
+  is fresh, its self-reported health state passes
+  :func:`~raft_tpu.serving.health.is_routable`, and — when
+  ``expected_step`` is set — its lease reports that checkpoint step
+  (the PR-6 weight-sync gate, now cross-process: a respawned worker
+  serving stale weights takes no traffic until it catches up).
+
+* **Routing** — the exact :class:`~raft_tpu.serving.fleet.BucketRouter`
+  rendezvous digests, scored over live lease-holders via the shared
+  ``"HxW"`` / ``"HxW@I"`` key namespaces
+  (:func:`~raft_tpu.serving.netproto.owners_key`), so gateway and
+  in-process fleet agree on every bucket's owner chain.
+
+* **The failover contract** (identical to ``ServingFleet``): each
+  worker is tried at most once per request; a post-acceptance failure
+  (connection death, typed error reply) walks to the next live owner;
+  ``RequestTimedOut`` is NEVER retried — the queue budget is the
+  client's, and a retry would only serve a staler answer later; when
+  no live lease-holder remains the request sheds with
+  :class:`~raft_tpu.serving.health.EngineUnhealthy` naming the workers
+  it saw.
+
+* **Deadlines at every hop** — ``submit`` stamps an absolute
+  ``time.monotonic()`` deadline from ``queue_timeout_ms``. It is
+  checked (1) when the request leaves the gateway queue — an expired
+  request resolves ``RequestTimedOut`` without EVER being dispatched,
+  (2) before every retry hop, and (3) on the wire: the worker
+  re-checks it at admission and carries it into its engine's queue
+  gate. One budget, enforced end to end.
+
+Observability: ``gateway_request`` root spans with per-hop child spans
+on the PR-2 tracer, and a :class:`GatewayMetrics` surface that
+duck-types what ``loadgen.run_load`` reads plus per-worker
+liveness/routed/retry gauges on a PR-14
+:class:`~raft_tpu.observability.registry.MetricsRegistry`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import queue as queue_mod
+import socket
+import threading
+import time
+from collections import Counter, deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.observability import registry as obs_registry
+from raft_tpu.observability import tracer as tracing
+from raft_tpu.serving import health as health_mod
+from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
+from raft_tpu.serving.engine import request_wire
+from raft_tpu.serving.fleet import BucketRouter
+from raft_tpu.serving.health import EngineUnhealthy, is_routable
+from raft_tpu.serving.metrics import _percentile
+from raft_tpu.serving.netproto import (Lease, ProtocolError, owners_key,
+                                       read_message, write_message)
+from raft_tpu.utils.padder import InputPadder
+
+
+class WorkerConnectionError(RuntimeError):
+    """A worker connection died before a complete reply (connect
+    refused, reset, closed mid-frame) — the post-acceptance failure
+    class the gateway retries on the next owner."""
+
+
+class SocketTransport:
+    """Blocking request/reply over pooled worker connections.
+
+    One idle-connection pool per worker address (a request checks a
+    connection out, runs its frame exchange, returns it on success;
+    any error discards it — the next request reconnects). Socket
+    timeouts are derived from the request's remaining deadline, so a
+    hung worker surfaces as ``RequestTimedOut`` when the budget is
+    spent rather than hanging a dispatcher thread forever.
+    """
+
+    def __init__(self, connect_timeout_s: float = 2.0):
+        self.connect_timeout_s = connect_timeout_s
+        self._lock = threading.Lock()
+        self._idle: Dict[Tuple[str, int], List[socket.socket]] = {}
+
+    def _checkout(self, addr: Tuple[str, int]) -> socket.socket:
+        with self._lock:
+            pool = self._idle.get(addr)
+            if pool:
+                return pool.pop()
+        try:
+            return socket.create_connection(
+                addr, timeout=self.connect_timeout_s)
+        except OSError as e:
+            raise WorkerConnectionError(
+                f"connect to {addr} failed: {e}") from e
+
+    def _checkin(self, addr: Tuple[str, int],
+                 sock: socket.socket) -> None:
+        with self._lock:
+            self._idle.setdefault(addr, []).append(sock)
+
+    def request(self, addr: Tuple[str, int], header: dict,
+                body: bytes = b"",
+                deadline: Optional[float] = None,
+                clock=time.monotonic) -> Tuple[dict, bytearray]:
+        """One frame exchange. Raises :class:`RequestTimedOut` when the
+        deadline expires mid-exchange (the reply, if it ever comes, is
+        already too late — the connection is discarded so a late reply
+        can never be mis-paired with a future request), and
+        :class:`WorkerConnectionError` on any connection-level death."""
+        sock = self._checkout(addr)
+        try:
+            if deadline is not None:
+                remaining = deadline - clock()
+                if remaining <= 0:
+                    raise RequestTimedOut(
+                        f"deadline expired before dispatch to {addr}")
+                sock.settimeout(remaining)
+            else:
+                sock.settimeout(None)
+            write_message(sock, header, body)
+            reply = read_message(sock)
+            if reply is None:
+                raise WorkerConnectionError(
+                    f"worker {addr} closed the connection mid-request")
+        except socket.timeout as e:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise RequestTimedOut(
+                f"deadline expired in flight to {addr}") from e
+        except (ProtocolError, OSError) as e:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise WorkerConnectionError(
+                f"worker {addr} connection failed: {e}") from e
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._checkin(addr, sock)
+        return reply
+
+    def close(self) -> None:
+        with self._lock:
+            socks = [s for pool in self._idle.values() for s in pool]
+            self._idle.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+@dataclasses.dataclass(frozen=True)
+class GatewayConfig:
+    """Knobs for one :class:`ServingGateway`.
+
+    Attributes:
+      pad_mode / factor: the padder parameters used to derive each
+        request's bucket key — MUST match the workers' engine config
+        so both sides compute the same ``"HxW"`` digest.
+      queue_timeout_ms: the client budget; stamped as an absolute
+        monotonic deadline at submit and enforced at every hop.
+        ``0``/``None`` disables deadlines.
+      lease_ttl_s: heartbeat freshness bound; an older lease is STALE
+        and its worker unroutable.
+      poll_interval_s: membership-refresh cadence of the background
+        poll thread (started by :meth:`ServingGateway.start`).
+      dispatch_threads: dispatcher thread count. ``0`` = no threads:
+        tests drive :meth:`ServingGateway._dispatch_next` manually
+        with a fake clock.
+      connect_timeout_s: TCP connect budget per hop.
+      expected_step: when set, only workers whose lease reports this
+        checkpoint step are routable (cross-process weight-sync gate).
+    """
+
+    pad_mode: str = "sintel"
+    factor: int = 8
+    queue_timeout_ms: int = 10_000
+    lease_ttl_s: float = 2.0
+    poll_interval_s: float = 0.25
+    dispatch_threads: int = 8
+    connect_timeout_s: float = 2.0
+    expected_step: Optional[int] = None
+
+
+class GatewayMetrics:
+    """Gateway counters + the reader surface ``loadgen.run_load``
+    expects (``latency_ms`` / ``batch_histogram`` / ``snapshot``).
+    Batching happens inside the workers, so ``batch_histogram`` is
+    empty here — per-batch truth lives in each worker's own metrics."""
+
+    def __init__(self, window: int = 10_000):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.responses = 0
+        self.errors = 0              # futures resolving with an error
+        self.timeouts = 0            # RequestTimedOut resolutions
+        self.timeouts_queued = 0     # expired before ANY dispatch
+        self.shed = 0                # no live lease-holder remained
+        self.routed: Counter = Counter()     # ok responses per worker
+        self.retries: Counter = Counter()    # failed hops per worker
+        self._latencies = deque(maxlen=window)
+
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_response(self, worker_id: str, latency_s: float) -> None:
+        with self._lock:
+            self.responses += 1
+            self.routed[worker_id] += 1
+            self._latencies.append(latency_s)
+
+    def record_retry(self, worker_id: str) -> None:
+        with self._lock:
+            self.retries[worker_id] += 1
+
+    def record_timeout(self, queued: bool = False) -> None:
+        with self._lock:
+            self.timeouts += 1
+            if queued:
+                self.timeouts_queued += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def latency_ms(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._latencies)
+        return {"p50": _percentile(vals, 50) * 1e3,
+                "p95": _percentile(vals, 95) * 1e3,
+                "p99": _percentile(vals, 99) * 1e3,
+                "mean": (sum(vals) / len(vals) * 1e3) if vals else 0.0}
+
+    def batch_histogram(self) -> Dict[int, int]:
+        return {}
+
+    def snapshot(self) -> Dict[str, float]:
+        lat = self.latency_ms()
+        with self._lock:
+            out = {
+                "gateway_requests": float(self.requests),
+                "gateway_responses": float(self.responses),
+                "gateway_errors": float(self.errors),
+                "gateway_timeouts": float(self.timeouts),
+                "gateway_timeouts_queued": float(self.timeouts_queued),
+                "gateway_shed": float(self.shed),
+                "gateway_retries": float(sum(self.retries.values())),
+            }
+        out.update({f"gateway_latency_{q}_ms": v
+                    for q, v in lat.items()})
+        return out
+
+
+@dataclasses.dataclass
+class _PendingRequest:
+    future: concurrent.futures.Future
+    key: str                        # rendezvous routing key
+    header: dict                    # the wire frame header
+    body: bytes
+    deadline: Optional[float]       # absolute monotonic
+    trace_id: Optional[int]
+    t_submit: float
+
+
+class ServingGateway:
+    """Route submits to live worker processes; duck-types the
+    ``submit`` + ``metrics`` surface of :class:`~raft_tpu.serving
+    .fleet.ServingFleet`, so ``loadgen.run_load`` (and any fleet
+    client) drives it unchanged.
+
+    ``clock`` (monotonic — deadlines) and ``wall`` (epoch — lease
+    freshness) are injectable so the deadline tests run on a fake
+    clock without sleeping.
+    """
+
+    def __init__(self, lease_store, config: Optional[GatewayConfig] = None,
+                 transport=None, registry=None,
+                 clock=time.monotonic, wall=time.time):
+        self.store = lease_store
+        self.config = config or GatewayConfig()
+        self.transport = transport or SocketTransport(
+            self.config.connect_timeout_s)
+        self.metrics = GatewayMetrics()
+        self.registry = registry or obs_registry.MetricsRegistry()
+        self._clock = clock
+        self._wall = wall
+        self._tracer = tracing.current()
+        self.router = BucketRouter([])
+        self._member_lock = threading.Lock()
+        self._leases: Dict[str, Lease] = {}
+        self._live: set = set()     # routable worker ids
+        self._queue: "queue_mod.Queue[_PendingRequest]" = queue_mod.Queue()
+        self._threads: list = []
+        self._closed = False
+        self._started = False
+        self._attach_registry()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ServingGateway":
+        """Start the membership poll thread and the dispatcher pool."""
+        if self._started:
+            raise RuntimeError("gateway already started")
+        self._started = True
+        self.refresh_membership()
+        if self.config.poll_interval_s:
+            t = threading.Thread(target=self._poll_loop,
+                                 name="gateway-poll", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for i in range(self.config.dispatch_threads):
+            t = threading.Thread(target=self._dispatch_loop,
+                                 name=f"gateway-dispatch-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        # Drain: anything still queued resolves with a clear error
+        # rather than hanging its client forever.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("gateway closed"))
+        self.transport.close()
+
+    def __enter__(self) -> "ServingGateway":
+        if not self._started:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- membership ------------------------------------------------------
+
+    def refresh_membership(self) -> Dict[str, str]:
+        """Re-read the lease store and rebuild the routable set;
+        returns ``{worker_id: effective state}`` (``stale`` overrides
+        the self-reported state of an expired lease). Called by the
+        poll thread each interval and directly by tests/the drill."""
+        leases = self.store.read_all()
+        now = self._wall()
+        ttl = self.config.lease_ttl_s
+        states: Dict[str, str] = {}
+        live: set = set()
+        for wid, lease in leases.items():
+            state = (lease.state if lease.fresh(ttl, now)
+                     else health_mod.STALE)
+            states[wid] = state
+            in_sync = (self.config.expected_step is None
+                       or lease.step == self.config.expected_step)
+            if is_routable(state) and in_sync:
+                live.add(wid)
+        with self._member_lock:
+            self._leases = leases
+            for wid in list(self.router.replica_ids):
+                if wid not in live:
+                    self.router.remove_replica(wid)
+            for wid in sorted(live):
+                self.router.add_replica(wid)
+            self._live = live
+        return states
+
+    def live_workers(self) -> List[str]:
+        with self._member_lock:
+            return sorted(self._live)
+
+    def worker_states(self) -> Dict[str, str]:
+        """Effective (TTL-adjusted) state per known worker."""
+        now = self._wall()
+        ttl = self.config.lease_ttl_s
+        with self._member_lock:
+            return {wid: (lease.state if lease.fresh(ttl, now)
+                          else health_mod.STALE)
+                    for wid, lease in self._leases.items()}
+
+    def _poll_loop(self) -> None:
+        while not self._closed:
+            try:
+                self.refresh_membership()
+            except Exception:
+                pass                # next interval retries
+            time.sleep(self.config.poll_interval_s)
+
+    # -- client API ------------------------------------------------------
+
+    def submit(self, image1: np.ndarray, image2: np.ndarray,
+               priority: str = PRIORITY_HIGH,
+               iters: Optional[int] = None,
+               trace_id: Optional[int] = None
+               ) -> concurrent.futures.Future:
+        """Enqueue one request; returns a future resolving to the
+        unpadded ``(H, W, 2)`` float32 flow, bit-identical to any
+        single worker's answer. Wire detection + serialization happen
+        here, in the caller's thread (the same cost split as the
+        engine's padding): uint8-eligible frames cross the socket at
+        1 byte/channel. Thread-safe."""
+        if self._closed:
+            raise RuntimeError("gateway is closed")
+        self.metrics.record_request()
+        wire_tag, a1, a2 = request_wire(image1, image2)
+        padded = InputPadder(a1.shape, mode=self.config.pad_mode,
+                             factor=self.config.factor).padded_shape
+        key = owners_key(padded, iters)
+        t_submit = self._clock()
+        timeout_ms = self.config.queue_timeout_ms
+        deadline = (t_submit + timeout_ms / 1e3) if timeout_ms else None
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        fut.replica_id = None
+        tr = self._tracer
+        tid = trace_id
+        if tr is not None:
+            tid = tr.mint() if tid is None else tid
+            tr.begin_async("gateway_request", tid,
+                           args={"priority": priority, "key": key})
+            fut.add_done_callback(
+                lambda f, t=tr, i=tid: t.end_async(
+                    "gateway_request", i,
+                    args={"status": ("ok" if f.exception() is None
+                                     else "error"),
+                          "worker": getattr(f, "replica_id", None)}))
+        a1c = np.ascontiguousarray(a1)
+        a2c = np.ascontiguousarray(a2)
+        header = {"op": "submit",
+                  "shape": list(a1c.shape),
+                  "dtype": str(a1c.dtype),
+                  "split": a1c.nbytes,
+                  "priority": priority,
+                  "iters": iters,
+                  "deadline": deadline,
+                  "trace_id": tid}
+        self._queue.put(_PendingRequest(
+            future=fut, key=key, header=header,
+            body=a1c.tobytes() + a2c.tobytes(),
+            deadline=deadline, trace_id=tid, t_submit=t_submit))
+        return fut
+
+    def predict(self, image1: np.ndarray, image2: np.ndarray,
+                timeout: Optional[float] = 120.0) -> np.ndarray:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(image1, image2).result(timeout)
+
+    # -- dispatch --------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._closed:
+            self._dispatch_next(timeout=0.1)
+
+    def _dispatch_next(self, timeout: Optional[float] = None) -> bool:
+        """Pull one queued request and route it; returns False when
+        the queue stayed empty for ``timeout``. The first deadline
+        hop: a request that expired while QUEUED resolves
+        ``RequestTimedOut`` here without ever being dispatched."""
+        try:
+            req = self._queue.get(timeout=timeout)
+        except queue_mod.Empty:
+            return False
+        if req.future.done():       # client gave up (cancelled)
+            return True
+        if req.deadline is not None and self._clock() >= req.deadline:
+            self.metrics.record_timeout(queued=True)
+            self._trace_instant(req, "expired_queued", {})
+            req.future.set_exception(RequestTimedOut(
+                "deadline expired while queued at the gateway "
+                "(never dispatched)"))
+            return True
+        try:
+            self._route(req)
+        except Exception as e:      # never lose a future to a bug
+            if not req.future.done():
+                req.future.set_exception(e)
+        return True
+
+    def _trace_instant(self, req: _PendingRequest, name: str,
+                       args: dict) -> None:
+        tr = self._tracer
+        if tr is not None and req.trace_id is not None:
+            tr.async_instant(name, req.trace_id, args=args)
+
+    def _route(self, req: _PendingRequest) -> None:
+        """Walk the key's owner-preference chain over live
+        lease-holders. The ``ServingFleet`` contract verbatim: each
+        worker tried at most once, post-acceptance failures walk on,
+        ``RequestTimedOut`` never retried, exhaustion sheds."""
+        tried: set = set()
+        last_exc: Optional[Exception] = None
+        hops = 0
+        if not self._threads:
+            # No poll thread (manual-drive mode): membership is
+            # whatever the last explicit refresh saw — refresh here so
+            # single-shot callers still route against current leases.
+            self.refresh_membership()
+        while True:
+            if req.deadline is not None \
+                    and self._clock() >= req.deadline:
+                # The budget died between hops: no further attempt —
+                # a retry now could only deliver a too-late answer.
+                self.metrics.record_timeout()
+                self._trace_instant(req, "expired_mid_retry",
+                                    {"hops": hops})
+                req.future.set_exception(RequestTimedOut(
+                    f"deadline expired after {hops} attempt(s); "
+                    "not retrying"))
+                return
+            with self._member_lock:
+                owners = [wid for wid in
+                          self.router.owners_for_key(req.key)
+                          if wid in self._live and wid not in tried]
+                lease = (self._leases.get(owners[0])
+                         if owners else None)
+            if not owners or lease is None:
+                self.metrics.record_shed()
+                with self._member_lock:
+                    known = sorted(self._leases)
+                req.future.set_exception(last_exc if isinstance(
+                    last_exc, EngineUnhealthy) else EngineUnhealthy(
+                    f"no live lease-holder for key {req.key!r} "
+                    f"(workers seen: {', '.join(known) or 'none'}"
+                    + (f"; last error: {type(last_exc).__name__}: "
+                       f"{last_exc}" if last_exc else "") + ")"))
+                return
+            wid, addr = owners[0], tuple(lease.addr)
+            tr = self._tracer
+            span = (tr.span("gateway_hop", req.trace_id,
+                            args={"worker": wid, "hops": hops})
+                    if tr is not None else None)
+            try:
+                if span is not None:
+                    span.__enter__()
+                try:
+                    rhdr, rbody = self.transport.request(
+                        addr, req.header, req.body,
+                        deadline=req.deadline, clock=self._clock)
+                finally:
+                    if span is not None:
+                        span.__exit__(None, None, None)
+            except RequestTimedOut as e:
+                # In-flight expiry: the budget is spent. Never retried.
+                self.metrics.record_timeout()
+                self._trace_instant(req, "expired_in_flight",
+                                    {"worker": wid, "hops": hops})
+                req.future.replica_id = wid
+                req.future.set_exception(e)
+                return
+            except (WorkerConnectionError, OSError) as e:
+                # Post-acceptance death (or refused connect): next
+                # healthy owner. The worker may have served the batch —
+                # resubmitting elsewhere is safe because requests are
+                # idempotent pure functions of their frames.
+                tried.add(wid)
+                hops += 1
+                last_exc = e
+                self.metrics.record_retry(wid)
+                self._trace_instant(req, "worker_failed",
+                                    {"worker": wid,
+                                     "error": type(e).__name__})
+                continue
+            status = rhdr.get("status")
+            if status == "ok":
+                shape = tuple(int(v) for v in rhdr["shape"])
+                flow = np.frombuffer(
+                    rbody, dtype=rhdr.get("dtype", "float32")
+                ).reshape(shape)
+                worker = rhdr.get("worker", wid)
+                self.metrics.record_response(
+                    worker, self._clock() - req.t_submit)
+                req.future.replica_id = worker
+                req.future.set_result(flow)
+                return
+            if status == "timeout":
+                # The worker's hop said the budget is gone (queued too
+                # long in its engine, or expired at admission). Same
+                # contract as the fleet: never retried.
+                self.metrics.record_timeout()
+                req.future.replica_id = wid
+                req.future.set_exception(RequestTimedOut(
+                    f"worker {wid}: {rhdr.get('error', 'timed out')}"))
+                return
+            # Typed post-acceptance error: walk the chain.
+            tried.add(wid)
+            hops += 1
+            last_exc = RuntimeError(
+                f"worker {wid} error "
+                f"({rhdr.get('error_type', 'unknown')}): "
+                f"{rhdr.get('error', '')}")
+            self.metrics.record_retry(wid)
+            self._trace_instant(req, "worker_failed",
+                                {"worker": wid,
+                                 "error": rhdr.get("error_type",
+                                                   "unknown")})
+
+    # -- observability ---------------------------------------------------
+
+    def _attach_registry(self) -> None:
+        """Per-worker liveness plus routed/retry streams and the
+        scalar totals, as live gauges on ``self.registry`` — the PR-14
+        export surface (``prometheus_text`` / ``start_http_server``)."""
+        m = self.metrics
+
+        def _scalar(read):
+            def fn():
+                try:
+                    return float(read())
+                except Exception:
+                    return 0.0
+            return fn
+
+        self.registry.gauge(
+            "gateway_workers_live", help="routable lease-holders",
+            fn=_scalar(lambda: len(self.live_workers())))
+        self.registry.gauge(
+            "gateway_shed", help="submits no live lease-holder served",
+            fn=_scalar(lambda: m.shed))
+        self.registry.gauge(
+            "gateway_timeouts", help="RequestTimedOut resolutions",
+            fn=_scalar(lambda: m.timeouts))
+
+        def _liveness():
+            states = self.worker_states()
+            return {(wid,): float(
+                health_mod.HEALTH_CODES.get(state, -1.0))
+                for wid, state in states.items()}
+
+        self.registry.gauge(
+            "gateway_worker_health",
+            help="per-worker TTL-adjusted health-state code "
+                 "(stale=7 when the lease expired)",
+            labelnames=("worker",), fn=_liveness)
+
+        def _live_flag():
+            live = set(self.live_workers())
+            with self._member_lock:
+                known = list(self._leases)
+            return {(wid,): (1.0 if wid in live else 0.0)
+                    for wid in known}
+
+        self.registry.gauge(
+            "gateway_worker_live",
+            help="1 while the worker is routable (fresh lease, "
+                 "routable state, step in sync)",
+            labelnames=("worker",), fn=_live_flag)
+
+        for name, table, help_ in (
+                ("gateway_routed", m.routed,
+                 "ok responses per worker"),
+                ("gateway_retries", m.retries,
+                 "failed hops (connection death / typed error) "
+                 "per worker")):
+            def _read(t=table):
+                with m._lock:
+                    return {(wid,): float(n) for wid, n in t.items()}
+            self.registry.gauge(name, help=help_,
+                                labelnames=("worker",), fn=_read)

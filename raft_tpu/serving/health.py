@@ -56,11 +56,18 @@ DEGRADED = "degraded"
 BROWNOUT = "brownout"
 OPEN = "open"
 CLOSED = "closed"
+# Membership-layer state, never self-reported by an engine: the
+# multi-process gateway assigns it to a worker whose heartbeat lease
+# has outlived its TTL. The process may be alive (a wedged heartbeat
+# thread, a stalled host) but the replica is unproven — not routable,
+# and the supervisor treats it like a death (kill + respawn).
+STALE = "stale"
 
 # Numeric encoding for the scalar stream (TrainLogger/JSONL want
 # floats): ordered roughly by "how routable is this replica".
 # BROWNOUT got the next free code (6) rather than a re-numbering —
-# the existing codes are pinned by dashboards and golden tests.
+# the existing codes are pinned by dashboards and golden tests; STALE
+# follows the same append-only rule (7).
 HEALTH_CODES: Dict[str, int] = {
     STARTING: 0,
     WARMING: 1,
@@ -69,6 +76,7 @@ HEALTH_CODES: Dict[str, int] = {
     OPEN: 4,
     CLOSED: 5,
     BROWNOUT: 6,
+    STALE: 7,
 }
 
 # The states a load balancer may send traffic to. DEGRADED is

@@ -1,0 +1,298 @@
+"""Multi-process serving plumbing: the length-prefixed socket protocol
+and heartbeat-lease membership store shared by the gateway and replica
+workers.
+
+Two small, deliberately stdlib-only layers:
+
+* **Framing** — every message on a worker socket is one frame::
+
+      [4-byte BE header length][JSON header][8-byte BE body length][body]
+
+  The header is small JSON (op, shape, dtype, priority, iters, trace
+  id, absolute deadline); the body is raw array bytes — the SAME uint8
+  wire bytes :func:`~raft_tpu.serving.engine.request_wire` produces, so
+  a request crosses the socket at 1 byte/channel and lands in the
+  worker engine's staging arena without a dtype round-trip (the PR
+  12/13 zero-copy path, now network-fed). Responses carry the float32
+  flow bytes back the same way.
+
+* **Leases** — membership and health ride the PR-3 coordination-KV
+  plumbing: each worker periodically publishes a :class:`Lease`
+  (address, health state, served checkpoint step, bucket config,
+  heartbeat timestamp) under a well-known key; the gateway reads the
+  set and treats any lease older than its TTL as
+  :data:`~raft_tpu.serving.health.STALE` — the worker may still be
+  alive, but an unproven replica takes no traffic. When a jax
+  distributed coordination client exists
+  (:func:`raft_tpu.resilience._coordination_client`) leases ride its
+  key-value store (:class:`CoordKVLeaseStore`); single-coordinator
+  hosts — the CPU drill, tests — use the same contract over atomic
+  file renames in a shared directory (:class:`FileLeaseStore`).
+
+Deadlines on the wire are **absolute** ``time.monotonic()`` values:
+on Linux ``CLOCK_MONOTONIC`` is system-wide, so a deadline stamped by
+the gateway means the same instant inside a worker on the same host —
+which is exactly the scope of this local-socket tier (cross-host
+serving would switch the wire to wall-clock deadlines plus a skew
+budget). Heartbeat timestamps use wall-clock ``time.time()`` so lease
+freshness also survives comparisons across processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import struct
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+_HDR_LEN = struct.Struct(">I")
+_BODY_LEN = struct.Struct(">Q")
+
+#: Upper bound on a frame's JSON header — a corrupt length prefix must
+#: fail fast, not allocate gigabytes.
+MAX_HEADER_BYTES = 1 << 20
+#: Upper bound on a frame body (two 8K uint8 frames fit comfortably).
+MAX_BODY_BYTES = 1 << 31
+
+
+class ProtocolError(RuntimeError):
+    """A malformed frame on a worker socket (bad length prefix, short
+    read mid-frame, unparseable header)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    """Read exactly ``n`` bytes; ``None`` on clean EOF at a frame
+    boundary (peer closed), :class:`ProtocolError` on EOF mid-frame."""
+    if n == 0:
+        return bytearray()
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            if got == 0:
+                return None
+            raise ProtocolError(
+                f"peer closed mid-frame ({got}/{n} bytes)")
+        got += r
+    return buf
+
+
+def write_message(sock: socket.socket, header: dict,
+                  body: bytes = b"") -> None:
+    """Send one frame. The header and both length prefixes coalesce
+    into one ``sendall``; a large body follows as a second (no
+    interleaving — the per-connection handler is single-threaded)."""
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    sock.sendall(_HDR_LEN.pack(len(hdr)) + hdr
+                 + _BODY_LEN.pack(len(body)))
+    if body:
+        sock.sendall(body)
+
+
+def read_message(sock: socket.socket
+                 ) -> Optional[Tuple[dict, bytearray]]:
+    """Read one frame; returns ``(header, body)`` or ``None`` on clean
+    EOF. The body is a fresh ``bytearray`` — ``np.frombuffer`` views
+    into it are zero-copy."""
+    raw = _recv_exact(sock, _HDR_LEN.size)
+    if raw is None:
+        return None
+    (hlen,) = _HDR_LEN.unpack(bytes(raw))
+    if hlen > MAX_HEADER_BYTES:
+        raise ProtocolError(f"header length {hlen} exceeds cap")
+    hdr_bytes = _recv_exact(sock, hlen)
+    if hdr_bytes is None:
+        raise ProtocolError("peer closed before header")
+    try:
+        header = json.loads(bytes(hdr_bytes))
+    except ValueError as e:
+        raise ProtocolError(f"unparseable frame header: {e}") from e
+    raw = _recv_exact(sock, _BODY_LEN.size)
+    if raw is None:
+        raise ProtocolError("peer closed before body length")
+    (blen,) = _BODY_LEN.unpack(bytes(raw))
+    if blen > MAX_BODY_BYTES:
+        raise ProtocolError(f"body length {blen} exceeds cap")
+    body = _recv_exact(sock, blen)
+    if body is None and blen:
+        raise ProtocolError("peer closed before body")
+    return header, body if body is not None else bytearray()
+
+
+# -- leases -------------------------------------------------------------
+
+@dataclasses.dataclass
+class Lease:
+    """One worker's membership heartbeat.
+
+    ``state`` is the worker engine's health state (the gateway routes
+    only :func:`~raft_tpu.serving.health.is_routable` states); ``step``
+    is the checkpoint step the worker currently serves (from the
+    reloader's :class:`~raft_tpu.serving.reload.ReloadSnapshot`, or the
+    statically configured step) — the gateway's cross-process weight-
+    sync gate keys on it. ``seq`` increments per heartbeat so a frozen
+    publisher is distinguishable from a frozen clock; ``t_heartbeat``
+    is wall-clock (comparable across processes)."""
+
+    worker_id: str
+    addr: Tuple[str, int]
+    state: str
+    step: Optional[int] = None
+    buckets: Tuple[Tuple[int, int], ...] = ()
+    pid: int = 0
+    seq: int = 0
+    t_heartbeat: float = 0.0
+    extra: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def fresh(self, ttl_s: float, now: Optional[float] = None) -> bool:
+        """Whether this lease was renewed within ``ttl_s`` of ``now``
+        (wall clock)."""
+        now = time.time() if now is None else now
+        return (now - self.t_heartbeat) <= ttl_s
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["addr"] = list(self.addr)
+        d["buckets"] = [list(b) for b in self.buckets]
+        return json.dumps(d, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(raw: str) -> "Lease":
+        d = json.loads(raw)
+        d["addr"] = tuple(d.get("addr", ("127.0.0.1", 0)))
+        d["buckets"] = tuple(tuple(b) for b in d.get("buckets", ()))
+        known = {f.name for f in dataclasses.fields(Lease)}
+        return Lease(**{k: v for k, v in d.items() if k in known})
+
+
+class FileLeaseStore:
+    """Lease store over a shared directory: one JSON file per worker,
+    written via ``os.replace`` so readers never see a torn lease. The
+    single-coordinator fallback for the coordination-KV contract —
+    exactly what the CPU kill-a-process drill and tests use (gateway,
+    workers and supervisor are separate processes on one host)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, worker_id: str) -> str:
+        return os.path.join(self.root, f"{worker_id}.lease.json")
+
+    def publish(self, lease: Lease) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.root,
+                                   prefix=f".{lease.worker_id}.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(lease.to_json())
+            os.replace(tmp, self._path(lease.worker_id))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def read_all(self) -> Dict[str, Lease]:
+        out: Dict[str, Lease] = {}
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.endswith(".lease.json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    lease = Lease.from_json(f.read())
+            except (OSError, ValueError, TypeError):
+                continue    # torn/corrupt lease: skip, next heartbeat wins
+            out[lease.worker_id] = lease
+        return out
+
+    def remove(self, worker_id: str) -> None:
+        try:
+            os.unlink(self._path(worker_id))
+        except OSError:
+            pass
+
+
+class CoordKVLeaseStore:
+    """Lease store over the jax distributed coordination service — the
+    same gRPC key-value channel the PR-3 commit votes ride
+    (:func:`raft_tpu.resilience._coordination_client`). Keys live under
+    ``prefix/<worker_id>``; ``read_all`` uses the client's
+    ``key_value_dir_get`` prefix scan. Multi-host deployments (workers
+    on other hosts of a pod) get membership with no shared filesystem;
+    construct via :func:`default_lease_store`, which falls back to
+    :class:`FileLeaseStore` when no coordination client exists."""
+
+    PREFIX = "raft_tpu/serving/lease"
+
+    def __init__(self, client, prefix: str = PREFIX):
+        self._client = client
+        self._prefix = prefix.rstrip("/")
+
+    def publish(self, lease: Lease) -> None:
+        self._client.key_value_set(
+            f"{self._prefix}/{lease.worker_id}", lease.to_json())
+
+    def read_all(self) -> Dict[str, Lease]:
+        out: Dict[str, Lease] = {}
+        try:
+            pairs = self._client.key_value_dir_get(self._prefix)
+        except Exception:
+            return out
+        for _key, val in pairs:
+            try:
+                lease = Lease.from_json(val)
+            except (ValueError, TypeError):
+                continue
+            out[lease.worker_id] = lease
+        return out
+
+    def remove(self, worker_id: str) -> None:
+        try:
+            self._client.key_value_delete(
+                f"{self._prefix}/{worker_id}")
+        except Exception:
+            pass
+
+
+def default_lease_store(root: str):
+    """The lease store for this process: coordination-KV when a jax
+    distributed client is up (multi-host pods), else the file store
+    rooted at ``root`` (single-coordinator hosts — the drill, tests).
+    Both sides of a deployment resolve the same way, so gateway and
+    workers agree without configuration."""
+    from raft_tpu.resilience import _coordination_client
+    client = _coordination_client()
+    if client is not None and hasattr(client, "key_value_dir_get"):
+        return CoordKVLeaseStore(client)
+    return FileLeaseStore(root)
+
+
+def owners_key(padded_shape: Tuple[int, int],
+               iters: Optional[int] = None) -> str:
+    """The rendezvous digest key for a padded bucket — the same
+    ``"HxW"`` / ``"HxW@I"`` namespaces
+    :class:`~raft_tpu.serving.fleet.BucketRouter` scores, so the
+    gateway's cross-process routing agrees with the in-process fleet's
+    golden-pinned assignments."""
+    key = f"{padded_shape[0]}x{padded_shape[1]}"
+    return key if iters is None else f"{key}@{int(iters)}"
+
+
+def live_addr_list(leases: Dict[str, Lease], ttl_s: float,
+                   now: Optional[float] = None
+                   ) -> List[Tuple[str, Tuple[str, int]]]:
+    """Convenience: ``[(worker_id, addr)]`` for fresh leases only."""
+    now = time.time() if now is None else now
+    return [(wid, lease.addr) for wid, lease in sorted(leases.items())
+            if lease.fresh(ttl_s, now)]

@@ -87,6 +87,43 @@ class CanaryResult:
     compiles: int
 
 
+@dataclasses.dataclass(frozen=True)
+class ReloadSnapshot:
+    """A point-in-time, serializable view of reloader state.
+
+    What a worker process needs to publish its served checkpoint step
+    in a membership lease (and what an operator endpoint would report)
+    without reaching into reloader internals: the currently served
+    step, the canary-rejected (pinned) steps, the in-flight wave target
+    if a fleet rollout is mid-wave, and — fleet-side only — the step
+    each replica serves. Frozen + JSON-roundtrippable so it can cross
+    a process boundary verbatim.
+    """
+
+    current_step: Optional[int] = None
+    pinned_steps: Tuple[int, ...] = ()
+    wave_step: Optional[int] = None
+    replica_steps: Dict[str, Optional[int]] = dataclasses.field(
+        default_factory=dict)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "current_step": self.current_step,
+            "pinned_steps": list(self.pinned_steps),
+            "wave_step": self.wave_step,
+            "replica_steps": dict(self.replica_steps),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "ReloadSnapshot":
+        return ReloadSnapshot(
+            current_step=d.get("current_step"),
+            pinned_steps=tuple(d.get("pinned_steps", ())),
+            wave_step=d.get("wave_step"),
+            replica_steps=dict(d.get("replica_steps", {})),
+        )
+
+
 def load_step_variables(ckpt_dir: str, step: int, current_variables):
     """Load ``step``'s params from ``ckpt_dir`` into a variables pytree
     shaped like ``current_variables`` (same top-level collections), with
@@ -162,6 +199,13 @@ class HotReloader:
         self.pinned_steps: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    def snapshot(self) -> ReloadSnapshot:
+        """Serializable point-in-time state (step published in a
+        worker's membership lease; see :class:`ReloadSnapshot`)."""
+        return ReloadSnapshot(
+            current_step=self.current_step,
+            pinned_steps=tuple(sorted(self.pinned_steps)))
 
     # -- canary ----------------------------------------------------------
 

@@ -1,0 +1,324 @@
+"""Worker supervision: detect dead or stale-lease worker processes and
+respawn them with exponential backoff and a crash-loop breaker.
+
+The third leg of the multi-process serving tier (gateway routes,
+workers serve, the supervisor keeps the fleet populated). Detection is
+two-signal:
+
+* **Process death** — ``proc.poll()`` returns an exit code: the OS
+  says the worker is gone (SIGKILL, OOM, ``os._exit`` via the fault
+  injector). Its lease is removed immediately so the gateway stops
+  routing to the corpse without waiting out the TTL.
+* **Stale lease on a live process** — the process runs but its
+  heartbeat stopped (wedged publisher thread, stalled host): past
+  ``lease_grace_s`` of uptime with no fresh lease, the supervisor
+  SIGKILLs it and treats it as a crash. An unprovable replica is a
+  dead replica — the same policy the gateway applies by refusing to
+  route :data:`~raft_tpu.serving.health.STALE` workers.
+
+Respawn policy reuses the existing resilience primitives:
+
+* **Exponential backoff** — the :func:`~raft_tpu.resilience
+  .retry_with_backoff` delay formula (``base * 2**(streak-1)``, capped)
+  expressed as an absolute ``respawn at t`` so :meth:`poll_once` never
+  sleeps — drills poll on a cadence, tests drive a fake clock.
+* **Crash-loop breaker** — a :class:`~raft_tpu.serving.health
+  .CircuitBreaker` per worker: ``breaker_threshold`` consecutive
+  *early* deaths (uptime under ``min_uptime_s`` — a worker that dies
+  before proving itself) trip it OPEN and respawning stops for
+  ``breaker_cooldown_s``; a stable run records success and closes it.
+  A worker crashing in a tight loop (bad spec, poisoned checkpoint)
+  burns a bounded number of spawns, not CPU forever.
+
+A respawned worker is NOT routable the moment it's spawned: it rejoins
+traffic only once its own lease reports a routable health state (its
+warmup finished) and — under the gateway's ``expected_step`` gate —
+the fleet's current checkpoint step. The supervisor only guarantees a
+process exists; the lease plane decides when it serves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from raft_tpu.serving.health import CircuitBreaker
+from raft_tpu.serving.worker import spawn_worker
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """What the supervisor needs to (re)spawn one worker: its id, the
+    :class:`~raft_tpu.serving.worker.WorkerConfig` dict passed to the
+    spawn function, and an optional environment override (fault
+    injection drills export ``RAFT_FAULT_WORKER_*`` to one worker)."""
+
+    worker_id: str
+    spec: Dict[str, object]
+    env: Optional[Dict[str, str]] = None
+
+
+class _WorkerState:
+    """Supervisor-side bookkeeping for one worker slot."""
+
+    def __init__(self, spec: WorkerSpec, breaker: CircuitBreaker):
+        self.spec = spec
+        self.proc = None                    # Popen-like (poll/kill)
+        self.spawned_at: float = 0.0        # monotonic clock
+        self.crash_streak = 0               # consecutive early deaths
+        self.crashes = 0                    # lifetime deaths
+        self.respawns = 0                   # spawns after the first
+        self.pending_until: Optional[float] = None
+        self.breaker = breaker
+
+
+class WorkerSupervisor:
+    """Keep a set of worker processes alive against the lease plane.
+
+    ``spawn_fn(spec_dict, env=...)`` must return a Popen-like object
+    (``poll()`` → exit code or None, ``kill()``); defaults to
+    :func:`~raft_tpu.serving.worker.spawn_worker`. ``clock``
+    (monotonic) / ``wall`` (epoch, lease freshness) are injectable so
+    backoff and staleness tests run on a fake clock.
+    """
+
+    def __init__(self, specs: List[WorkerSpec], lease_store,
+                 stale_after_s: float = 3.0,
+                 lease_grace_s: float = 60.0,
+                 poll_interval_s: float = 0.5,
+                 respawn_base_delay_s: float = 0.25,
+                 respawn_max_delay_s: float = 8.0,
+                 min_uptime_s: float = 5.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 spawn_fn: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.store = lease_store
+        self.stale_after_s = stale_after_s
+        self.lease_grace_s = lease_grace_s
+        self.poll_interval_s = poll_interval_s
+        self.respawn_base_delay_s = respawn_base_delay_s
+        self.respawn_max_delay_s = respawn_max_delay_s
+        self.min_uptime_s = min_uptime_s
+        self._spawn_fn = spawn_fn or spawn_worker
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _WorkerState] = {
+            s.worker_id: _WorkerState(s, CircuitBreaker(
+                threshold=breaker_threshold,
+                cooldown_s=breaker_cooldown_s, clock=clock))
+            for s in specs}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start_all(self) -> "WorkerSupervisor":
+        """Spawn every worker that isn't running yet (initial spawns
+        don't count as respawns)."""
+        with self._lock:
+            for st in self._workers.values():
+                if st.proc is None:
+                    self._do_spawn(st, respawn=False)
+        return self
+
+    def start(self) -> "WorkerSupervisor":
+        """Run :meth:`poll_once` on ``poll_interval_s`` in a
+        background thread."""
+        if self._thread is not None:
+            raise RuntimeError("supervisor already started")
+
+        def loop():
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("supervisor poll failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="worker-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, kill_workers: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if kill_workers:
+            with self._lock:
+                procs = [st.proc for st in self._workers.values()
+                         if st.proc is not None]
+            for proc in procs:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+
+    # -- the supervision loop --------------------------------------------
+
+    def poll_once(self) -> Dict[str, str]:
+        """One supervision pass; returns ``{worker_id: action}`` with
+        actions ``ok`` / ``dead`` / ``stale-killed`` / ``respawned`` /
+        ``backoff`` / ``breaker-open``. Non-blocking (backoff is an
+        absolute respawn time, never a sleep)."""
+        leases = self.store.read_all()
+        now = self._clock()
+        wall_now = self._wall()
+        actions: Dict[str, str] = {}
+        with self._lock:
+            for wid, st in self._workers.items():
+                if st.proc is None:
+                    actions[wid] = self._maybe_respawn(st, now)
+                    continue
+                rc = st.proc.poll()
+                if rc is not None:
+                    self._on_death(st, now, f"exit code {rc}")
+                    actions[wid] = "dead"
+                    continue
+                lease = leases.get(wid)
+                fresh = (lease is not None
+                         and lease.fresh(self.stale_after_s, wall_now))
+                uptime = now - st.spawned_at
+                if not fresh and uptime >= self.lease_grace_s:
+                    # Alive but unprovable: heartbeat wedged/stalled
+                    # past any warmup allowance. Kill and recycle —
+                    # same policy as the gateway's STALE routing ban.
+                    logger.warning(
+                        "worker %s lease stale at uptime %.1fs: "
+                        "killing", wid, uptime)
+                    try:
+                        st.proc.kill()
+                    except OSError:
+                        pass
+                    self._on_death(st, now, "stale lease")
+                    actions[wid] = "stale-killed"
+                    continue
+                if fresh and uptime >= self.min_uptime_s:
+                    # Proven stable: reset the crash-loop accounting.
+                    if st.crash_streak:
+                        st.crash_streak = 0
+                    st.breaker.record_success()
+                actions[wid] = "ok"
+        return actions
+
+    def _on_death(self, st: _WorkerState, now: float,
+                  why: str) -> None:
+        """Caller holds the lock. Record one death, arm the backoff,
+        and drop the dead worker's lease so the gateway stops routing
+        to it immediately instead of waiting out the TTL."""
+        uptime = now - st.spawned_at
+        st.proc = None
+        st.crashes += 1
+        if uptime < self.min_uptime_s:
+            st.crash_streak += 1
+            st.breaker.record_failure()
+        else:
+            st.crash_streak = 1     # fresh streak, not a crash loop
+        # retry_with_backoff's delay formula, expressed as an absolute
+        # "respawn at t" so the poll loop never sleeps.
+        delay = min(self.respawn_base_delay_s
+                    * (2 ** (st.crash_streak - 1)),
+                    self.respawn_max_delay_s)
+        st.pending_until = now + delay
+        logger.warning(
+            "worker %s died (%s) after %.1fs uptime; respawn in %.2fs "
+            "(streak %d, breaker %s)", st.spec.worker_id, why, uptime,
+            delay, st.crash_streak, st.breaker.state)
+        try:
+            self.store.remove(st.spec.worker_id)
+        except Exception:
+            pass
+
+    def _maybe_respawn(self, st: _WorkerState, now: float) -> str:
+        """Caller holds the lock."""
+        if st.pending_until is None:
+            return "ok"             # never spawned; start_all's job
+        if now < st.pending_until:
+            return "backoff"
+        if not st.breaker.admits():
+            # Crash-looping: stop burning spawns until the cooldown
+            # half-opens the breaker (the next spawn is the probe).
+            return "breaker-open"
+        self._do_spawn(st, respawn=True)
+        return "respawned"
+
+    def _do_spawn(self, st: _WorkerState, respawn: bool) -> None:
+        """Caller holds the lock."""
+        st.proc = self._spawn_fn(st.spec.spec, env=st.spec.env)
+        st.spawned_at = self._clock()
+        st.pending_until = None
+        if respawn:
+            st.respawns += 1
+        logger.info("worker %s %sspawned", st.spec.worker_id,
+                    "re" if respawn else "")
+
+    # -- readouts --------------------------------------------------------
+
+    def status(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            return {wid: {
+                "up": st.proc is not None and st.proc.poll() is None,
+                "respawns": st.respawns,
+                "crashes": st.crashes,
+                "crash_streak": st.crash_streak,
+                "breaker": st.breaker.state,
+                "pending_until": st.pending_until,
+            } for wid, st in self._workers.items()}
+
+    def respawns(self, worker_id: str) -> int:
+        with self._lock:
+            return self._workers[worker_id].respawns
+
+    def attach_registry(self, registry) -> None:
+        """Per-worker supervision gauges on a PR-14 registry: process
+        up/down, lifetime respawns, the crash streak, and the
+        crash-loop breaker state code (0 closed / 1 half-open / 2
+        open)."""
+        codes = {CircuitBreaker.CLOSED: 0.0,
+                 CircuitBreaker.HALF_OPEN: 1.0,
+                 CircuitBreaker.OPEN: 2.0}
+
+        def _per_worker(read):
+            def fn():
+                out = {}
+                with self._lock:
+                    for wid, st in self._workers.items():
+                        try:
+                            out[(wid,)] = float(read(st))
+                        except Exception:
+                            out[(wid,)] = 0.0
+                return out
+            return fn
+
+        registry.gauge(
+            "gateway_worker_up",
+            help="1 while the worker process is alive",
+            labelnames=("worker",),
+            fn=_per_worker(lambda st: 1.0 if st.proc is not None
+                           and st.proc.poll() is None else 0.0))
+        registry.gauge(
+            "gateway_worker_respawns",
+            help="supervised respawns per worker (first spawn "
+                 "excluded)",
+            labelnames=("worker",),
+            fn=_per_worker(lambda st: st.respawns))
+        registry.gauge(
+            "gateway_worker_crash_streak",
+            help="consecutive early deaths (uptime < min_uptime_s)",
+            labelnames=("worker",),
+            fn=_per_worker(lambda st: st.crash_streak))
+        registry.gauge(
+            "gateway_worker_breaker",
+            help="crash-loop breaker state (0 closed, 1 half-open, "
+                 "2 open)",
+            labelnames=("worker",),
+            fn=_per_worker(lambda st: codes.get(st.breaker.state,
+                                                -1.0)))

@@ -1,0 +1,458 @@
+"""Replica worker process: one :class:`~raft_tpu.serving.engine
+.ServingEngine` behind a local socket, with a heartbeat lease.
+
+The multi-process serving tier's fault-isolation unit. Each worker is
+its own OS process (its own Python heap, its own XLA client) so a
+crash, deadlock, or OOM takes out exactly one replica — the failure
+mode the in-process :class:`~raft_tpu.serving.fleet.ServingFleet` can
+only simulate. The gateway never holds a reference into a worker; the
+entire contract is:
+
+* **The socket** — length-prefixed frames (:mod:`netproto`): a
+  ``submit`` frame carries the request's wire bytes (the SAME uint8
+  1-byte/channel payload :func:`~raft_tpu.serving.engine.request_wire`
+  produces — ``np.frombuffer`` views of the received body feed the
+  engine's staging arena with zero copies) plus ``priority``,
+  ``iters``, ``trace_id`` and the absolute monotonic ``deadline``. The
+  worker re-enforces the deadline at its hop: an already-expired
+  request is answered ``timeout`` without ever touching the engine,
+  and an accepted one carries the deadline into
+  ``ServingEngine.submit(deadline_s=...)`` so the in-engine queue gate
+  honors the client's remaining budget too.
+
+* **The lease** — a :class:`~raft_tpu.serving.netproto.Lease`
+  republished every ``heartbeat_interval_s`` with the worker's
+  address, engine health state, bucket config, served checkpoint step
+  (from the reloader's serializable
+  :class:`~raft_tpu.serving.reload.ReloadSnapshot`, or the statically
+  configured ``step``) and post-warmup compile count. The heartbeat
+  thread starts BEFORE warmup (publishing ``warming``) so the
+  supervisor sees a fresh lease while executables compile — a slow
+  warmup must read as "alive, not routable", never as a death.
+
+Fault injection (:class:`~raft_tpu.resilience.FaultInjector`
+``RAFT_FAULT_WORKER_*`` knobs) hooks three seams: kill the process on
+the Nth received request (``os._exit`` mid-request — after acceptance,
+before any reply: the exact window the gateway's post-acceptance retry
+covers), stall the heartbeat once so the lease expires under a live
+process, and drop a connection after serving instead of replying.
+
+``python -m raft_tpu.serving.worker --spec spec.json`` runs one worker
+until SIGTERM; :func:`spawn_worker` is the supervisor-side launcher
+(plain ``subprocess.Popen`` with the parent's environment —
+``JAX_PLATFORMS`` and the fault-injection env vars inherit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import dataclasses
+import json
+import logging
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import resilience
+from raft_tpu.serving import netproto
+from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
+from raft_tpu.serving.metrics import CompileWatch
+from raft_tpu.serving.netproto import (Lease, ProtocolError, read_message,
+                                       write_message)
+
+logger = logging.getLogger(__name__)
+
+#: Exit code of an injected mid-request kill (distinguishable from a
+#: clean exit in supervisor logs).
+KILLED_BY_INJECTION = 17
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    """One worker process's spec — everything needed to build its
+    engine and join the membership plane. JSON-roundtrippable
+    (:meth:`to_dict` / :meth:`from_dict`) because it crosses the
+    supervisor→worker process boundary as a spec file."""
+
+    worker_id: str
+    lease_dir: str
+    host: str = "127.0.0.1"
+    port: int = 0                   # 0 = ephemeral; published via lease
+    heartbeat_interval_s: float = 0.5
+    buckets: Tuple[Tuple[int, int], ...] = ()
+    max_batch: int = 4
+    max_wait_ms: float = 3.0
+    queue_timeout_ms: int = 10_000
+    model_path: str = "random"
+    small: bool = True
+    iters: int = 2
+    step: Optional[int] = None      # static served step (no reloader)
+    persistent_cache: object = False
+
+    def to_dict(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d["buckets"] = [list(b) for b in self.buckets]
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "WorkerConfig":
+        d = dict(d)
+        d["buckets"] = tuple(tuple(b) for b in d.get("buckets", ()))
+        known = {f.name for f in dataclasses.fields(WorkerConfig)}
+        return WorkerConfig(**{k: v for k, v in d.items() if k in known})
+
+
+class WorkerServer:
+    """The socket front-end + heartbeat publisher around one engine.
+
+    Usable in-process (tests and the gateway-overhead bench run real
+    sockets without real processes) or as the body of the worker
+    ``main``. The engine is injected so tests control its predictor;
+    ``reloader`` (optional) supplies the served checkpoint step via
+    its serializable snapshot.
+    """
+
+    def __init__(self, engine, config: WorkerConfig,
+                 lease_store=None, reloader=None):
+        self.engine = engine
+        self.config = config
+        self.store = (lease_store if lease_store is not None
+                      else netproto.default_lease_store(config.lease_dir))
+        self.reloader = reloader
+        self.addr: Optional[Tuple[str, int]] = None
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        self._recv_lock = threading.Lock()
+        self._recv_seq = 0          # requests RECEIVED, 1-based
+        self._serving = False
+        self._hb_seq = 0
+        self._compile_watch: Optional[CompileWatch] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "WorkerServer":
+        """Bind the listener, start heartbeating (``warming``), warm
+        the engine, then open for traffic. Ordering matters: the lease
+        must be fresh DURING warmup (slow compile != death) but the
+        state stays unroutable until the engine is actually ready —
+        the supervisor's rejoin gate reads exactly this sequence."""
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.config.host, self.config.port))
+        ls.listen(64)
+        self._listener = ls
+        self.addr = ls.getsockname()[:2]
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name=f"{self.config.worker_id}-heartbeat",
+                              daemon=True)
+        hb.start()
+        self._threads.append(hb)
+        if warmup:
+            self.engine.start(warmup=True)
+        else:
+            self.engine.start(warmup=False)
+        # Post-warmup baseline: every compile from here on is a
+        # contract violation, published per heartbeat so the drill can
+        # assert zero-post-warmup-compiles ACROSS process boundaries.
+        self._compile_watch = CompileWatch().__enter__()
+        self._serving = True
+        self._publish_lease()       # don't wait an interval to go live
+        acc = threading.Thread(target=self._accept_loop,
+                               name=f"{self.config.worker_id}-accept",
+                               daemon=True)
+        acc.start()
+        self._threads.append(acc)
+        return self
+
+    def stop(self, remove_lease: bool = True) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.engine.close()
+        if remove_lease:
+            self.store.remove(self.config.worker_id)
+
+    # -- membership ------------------------------------------------------
+
+    def _served_step(self) -> Optional[int]:
+        if self.reloader is not None:
+            return self.reloader.snapshot().current_step
+        return self.config.step
+
+    def _lease_state(self) -> str:
+        if not self._serving:
+            return "warming"
+        try:
+            return self.engine.health_state()
+        except Exception:
+            return "warming"
+
+    def _publish_lease(self) -> None:
+        self._hb_seq += 1
+        extra: Dict[str, object] = {}
+        if self._compile_watch is not None:
+            extra["post_warmup_compiles"] = self._compile_watch.so_far
+        lease = Lease(
+            worker_id=self.config.worker_id,
+            addr=tuple(self.addr) if self.addr else ("", 0),
+            state=self._lease_state(),
+            step=self._served_step(),
+            buckets=tuple(tuple(b) for b in self.config.buckets),
+            pid=os.getpid(),
+            seq=self._hb_seq,
+            t_heartbeat=time.time(),
+            extra=extra)
+        try:
+            self.store.publish(lease)
+        except Exception:
+            logger.exception("lease publish failed (will retry)")
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            inj = resilience.active_injector()
+            if inj is not None:
+                stall = inj.take_heartbeat_stall()
+                if stall > 0:
+                    logger.warning("injected heartbeat stall: %.1fs",
+                                   stall)
+                    # A wedged publisher, not a dead process: the
+                    # process keeps serving while its lease expires.
+                    if self._stop.wait(stall):
+                        return
+            self._publish_lease()
+            if self._stop.wait(self.config.heartbeat_interval_s):
+                return
+
+    # -- the socket protocol ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return              # listener closed = shutdown
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name=f"{self.config.worker_id}-conn",
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = read_message(conn)
+                if msg is None:
+                    return          # peer closed cleanly
+                if not self._handle(conn, *msg):
+                    return          # injected drop: connection is gone
+        except (ProtocolError, OSError):
+            pass                    # torn peer: drop the connection
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn: socket.socket, header: dict,
+                body: bytearray) -> bool:
+        """Serve one frame; False = the connection was dropped."""
+        op = header.get("op")
+        if op == "ping":
+            write_message(conn, {"status": "ok",
+                                 "state": self._lease_state(),
+                                 "step": self._served_step()})
+            return True
+        if op != "submit":
+            write_message(conn, {"status": "error",
+                                 "error_type": "ProtocolError",
+                                 "error": f"unknown op {op!r}"})
+            return True
+        with self._recv_lock:
+            self._recv_seq += 1
+            seq = self._recv_seq
+        inj = resilience.active_injector()
+        if inj is not None and inj.kills_worker_request(seq):
+            # Mid-request SIGKILL-equivalent: the request was accepted
+            # (bytes read off the socket) but no reply will ever come —
+            # the gateway must retry it on the next owner. os._exit
+            # skips atexit/finally exactly like a real kill.
+            logger.error("injected kill on request %d", seq)
+            os._exit(KILLED_BY_INJECTION)
+        deadline = header.get("deadline")
+        if deadline is not None and time.monotonic() >= deadline:
+            # Expired before we touched the engine: the budget was
+            # spent upstream (queues, retries). Answer fast — serving
+            # it would hand back a too-late result the client already
+            # gave up on.
+            write_message(conn, {"status": "timeout",
+                                 "error": "deadline expired at worker "
+                                          "admission"})
+            return True
+        try:
+            fut = self._submit_from_wire(header, body)
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.001))
+            flow = fut.result(timeout=remaining)
+        except RequestTimedOut as e:
+            write_message(conn, {"status": "timeout", "error": str(e)})
+            return True
+        except (concurrent.futures.TimeoutError, TimeoutError):
+            # fut.result() outlived the wire deadline.
+            write_message(conn, {"status": "timeout",
+                                 "error": "deadline expired in flight"})
+            return True
+        except Exception as e:     # engine-side failure: typed reply
+            write_message(conn, {"status": "error",
+                                 "error_type": type(e).__name__,
+                                 "error": str(e)})
+            return True
+        if inj is not None and inj.maybe_drop_worker_socket():
+            # Post-acceptance, post-serve drop: the reply bytes are
+            # the only casualty. The gateway sees a dead connection
+            # after acceptance and must retry on the next owner.
+            logger.warning("injected socket drop (request %d)", seq)
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+        flow = np.ascontiguousarray(flow, dtype=np.float32)
+        write_message(conn, {"status": "ok",
+                             "shape": list(flow.shape),
+                             "dtype": "float32",
+                             "worker": self.config.worker_id},
+                      flow.tobytes())
+        return True
+
+    def _submit_from_wire(self, header: dict, body: bytearray):
+        """Reconstruct the frame pair as zero-copy views of the
+        received body and enqueue it. The body holds image1 then
+        image2 back to back in the wire dtype (uint8 when both frames
+        qualified — the PR 12/13 1-byte/channel path — else float32);
+        ``np.frombuffer`` views go straight into the engine's staging
+        arena without a dtype round-trip or a copy."""
+        shape = tuple(int(v) for v in header["shape"])
+        dtype = np.dtype(header.get("dtype", "float32"))
+        split = int(header["split"])
+        n = int(np.prod(shape))
+        im1 = np.frombuffer(body, dtype=dtype, count=n,
+                            offset=0).reshape(shape)
+        im2 = np.frombuffer(body, dtype=dtype, count=n,
+                            offset=split).reshape(shape)
+        return self.engine.submit(
+            im1, im2,
+            priority=header.get("priority", PRIORITY_HIGH),
+            iters=header.get("iters"),
+            trace_id=header.get("trace_id"),
+            deadline_s=header.get("deadline"))
+
+
+# -- process entry points -----------------------------------------------
+
+def spawn_worker(spec: Dict[str, object],
+                 env: Optional[Dict[str, str]] = None
+                 ) -> subprocess.Popen:
+    """Launch one worker process from a :class:`WorkerConfig` dict.
+
+    The spec is written to ``<lease_dir>/<worker_id>.spec.json`` and
+    the child runs ``python -m raft_tpu.serving.worker --spec <path>``
+    with the parent's environment (``JAX_PLATFORMS`` — CPU in tests,
+    TPU in production — and any ``RAFT_FAULT_*`` knobs inherit; pass
+    ``env`` to override). stdout/stderr land in
+    ``<lease_dir>/<worker_id>.log`` for post-mortems."""
+    cfg = WorkerConfig.from_dict(spec)
+    os.makedirs(cfg.lease_dir, exist_ok=True)
+    spec_path = os.path.join(cfg.lease_dir, f"{cfg.worker_id}.spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+    child_env = dict(os.environ if env is None else env)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    child_env["PYTHONPATH"] = (
+        repo_root + os.pathsep + child_env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    log_path = os.path.join(cfg.lease_dir, f"{cfg.worker_id}.log")
+    log_f = open(log_path, "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m", "raft_tpu.serving.worker",
+             "--spec", spec_path],
+            env=child_env, stdout=log_f, stderr=subprocess.STDOUT)
+    finally:
+        log_f.close()               # the child holds its own fd
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--spec", required=True,
+                   help="path to a WorkerConfig JSON spec")
+    args = p.parse_args(argv)
+    with open(args.spec) as f:
+        cfg = WorkerConfig.from_dict(json.load(f))
+    # Env-driven fault injection scopes to this process like the PR-3
+    # checkpoint knobs: the supervisor exports RAFT_FAULT_WORKER_* and
+    # each worker resolves its own injector.
+    resilience.set_injector(resilience.FaultInjector.from_env())
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving.engine import ServingConfig, ServingEngine
+
+    predictor = load_predictor(cfg.model_path, small=cfg.small,
+                               iters=cfg.iters)
+    engine = ServingEngine(predictor, ServingConfig(
+        max_batch=cfg.max_batch,
+        max_wait_ms=cfg.max_wait_ms,
+        buckets=tuple(tuple(b) for b in cfg.buckets),
+        queue_timeout_ms=cfg.queue_timeout_ms,
+        replica_id=cfg.worker_id,
+        persistent_cache=cfg.persistent_cache))
+    server = WorkerServer(engine, cfg)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    server.start(warmup=True)
+    logger.info("worker %s serving on %s (pid %d)",
+                cfg.worker_id, server.addr, os.getpid())
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,11 +43,20 @@ from typing import List
 
 SMOKE_HONESTY_KEYS = ("smoke_operating_point", "criterion_note")
 
-# The round-9 contbatch artifact is an A/B claim: a speedup ratio only
-# means something if BOTH arms were measured in the same run. A payload
-# carrying this metric (without an error) must ship both arms' numbers.
+# A/B artifacts: a ratio/overhead only means something if BOTH arms
+# were measured in the same run. A payload carrying one of these
+# metrics (without an error) must ship both arms' numbers in
+# ``per_arm``. contbatch is the round-9 speedup claim; gateway is the
+# multi-process tier's hop-overhead claim (in-process fleet submit vs
+# the same load through the socket gateway).
 CONTBATCH_METRIC = "contbatch_vs_bucketed_mixed_iters_throughput_speedup"
 CONTBATCH_ARMS = ("continuous", "bucketed")
+GATEWAY_METRIC = "gateway_vs_inprocess_p50_latency_overhead_ms"
+GATEWAY_ARMS = ("in_process", "gateway")
+AB_METRICS = {
+    CONTBATCH_METRIC: ("contbatch", CONTBATCH_ARMS),
+    GATEWAY_METRIC: ("gateway", GATEWAY_ARMS),
+}
 
 
 def _check_trace_artifact(path) -> List[str]:
@@ -99,15 +108,16 @@ def check_payload(name: str, payload: dict) -> List[str]:
         problems.append(
             f"off-TPU measurement (platform={platform!r}) carries none "
             f"of the smoke-honesty keys {SMOKE_HONESTY_KEYS}")
-    if payload.get("metric") == CONTBATCH_METRIC:
+    if payload.get("metric") in AB_METRICS:
+        label, required_arms = AB_METRICS[payload["metric"]]
         arms = payload.get("per_arm")
-        missing = [a for a in CONTBATCH_ARMS
+        missing = [a for a in required_arms
                    if not isinstance(arms, dict)
                    or not isinstance(arms.get(a), dict)]
         if missing:
             problems.append(
-                f"contbatch A/B artifact missing arm(s) {missing} in "
-                "'per_arm' — a speedup ratio needs both measurements")
+                f"{label} A/B artifact missing arm(s) {missing} in "
+                "'per_arm' — an A/B claim needs both measurements")
     return [f"{name}: {p}" for p in problems]
 
 

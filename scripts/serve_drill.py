@@ -74,6 +74,18 @@
     ZERO post-warmup XLA compiles with tracing enabled (tracing must
     not perturb the executable cache).
 
+11. gateway (``--drill gateway``) — the multi-process kill-a-process
+    proof: 3 replica worker PROCESSES (own heaps, own XLA clients)
+    publish heartbeat leases; the gateway routes 50-client load over
+    live lease-holders; one worker is SIGKILLed mid-load. Gate: 0
+    dropped, 0 bit-incorrect (post-acceptance failures retry on the
+    next live owner; the dead worker's lease drops immediately), the
+    supervisor respawns the victim with backoff, and the respawn
+    rejoins routing only after its warmup completes and its lease
+    reports the fleet's checkpoint step — with 0 post-warmup compiles
+    reported by every worker's lease, and per-worker liveness/respawn/
+    retry gauges live in the registry's Prometheus export.
+
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
 bit-identical; under a forced multi-device topology
@@ -1421,6 +1433,158 @@ def drill_contbatch(root):
         f"{watch.compiles} fresh XLA compile(s) during the episode"
 
 
+def drill_gateway(root):
+    """3 worker PROCESSES behind the gateway: SIGKILL one under load ->
+    0 dropped / 0 bit-incorrect, supervised respawn with backoff,
+    rejoin only after warmup + step sync, 0 post-warmup compiles."""
+    import signal as signal_mod
+
+    import numpy as np
+
+    from raft_tpu.serving import loadgen
+    from raft_tpu.serving.gateway import (GatewayConfig, ServingGateway,
+                                          SocketTransport)
+    from raft_tpu.serving.health import is_routable
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+    from raft_tpu.serving.worker import WorkerConfig
+
+    STEP = 0
+    lease_dir = os.path.join(root, "leases")
+    store = FileLeaseStore(lease_dir)
+    # Every worker serves every bucket so each rendezvous chain has two
+    # live failover targets behind its owner.
+    specs = [WorkerSpec(f"w{i}", WorkerConfig(
+        worker_id=f"w{i}", lease_dir=lease_dir, buckets=BUCKETS,
+        max_batch=4, max_wait_ms=3.0, queue_timeout_ms=60_000,
+        step=STEP).to_dict()) for i in range(3)]
+    sup = WorkerSupervisor(
+        specs, store, stale_after_s=3.0,
+        lease_grace_s=300.0,        # child startup = imports + warmup
+        poll_interval_s=0.25, respawn_base_delay_s=0.25,
+        respawn_max_delay_s=2.0, min_uptime_s=2.0)
+    gw = ServingGateway(store, GatewayConfig(
+        queue_timeout_ms=120_000, lease_ttl_s=2.0,
+        poll_interval_s=0.1, dispatch_threads=CONCURRENCY,
+        expected_step=STEP))
+    sup.attach_registry(gw.registry)
+    sup.start_all()
+    sup.start()
+    gw.start()
+    try:
+        _await_metric(lambda: len(gw.live_workers()), 3, 300.0,
+                      "routable worker processes")
+        print(f"  3 workers routable: {gw.live_workers()}")
+
+        # Parent-side ground truth: load_predictor("random") is
+        # deterministic (PRNGKey(0)), so parent and workers hold
+        # bit-identical weights; same topology (env-inherited) + same
+        # executable shapes => bit-identical flow across processes.
+        predictor = _make_predictor()
+        frames = loadgen.make_frames(SHAPES, per_shape=2, seed=23)
+        refs, ref_kind = _references(predictor, frames, max_batch=4)
+
+        killed = {}
+
+        def killer():
+            # Mid-load: wait for real traffic, then SIGKILL whichever
+            # worker has served the most (maximizing in-flight damage).
+            _await_metric(lambda: gw.metrics.responses, 5, 120.0,
+                          "responses before kill")
+            victim = gw.metrics.routed.most_common(1)[0][0]
+            pid = store.read_all()[victim].pid
+            os.kill(pid, signal_mod.SIGKILL)
+            killed["victim"], killed["pid"] = victim, pid
+            print(f"  SIGKILLed {victim} (pid {pid}) mid-load",
+                  flush=True)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        res = loadgen.run_load(gw, frames, n_requests=N_REQUESTS,
+                               concurrency=CONCURRENCY,
+                               references=refs, timeout=600.0)
+        kt.join(timeout=120.0)
+        assert "victim" in killed, "kill thread never fired"
+        victim, old_pid = killed["victim"], killed["pid"]
+
+        print(f"  {res['completed']}/{N_REQUESTS} responses through "
+              f"the kill; reference = {ref_kind}")
+        print(f"  gateway: {gw.metrics.snapshot()}")
+        assert res["completed"] == N_REQUESTS, \
+            f"completed {res['completed']}/{N_REQUESTS}"
+        assert not res["dropped"], f"dropped: {res['dropped']}"
+        assert not res["mismatched"], \
+            f"bit-incorrect responses: {res['mismatched']}"
+
+        # Supervised respawn with backoff...
+        _await_metric(lambda: sup.respawns(victim), 1, 120.0,
+                      f"supervised respawn of {victim}")
+        # ...and rejoin ONLY through warming -> routable + step sync
+        # (the gateway refuses 'warming' and wrong-step leases, so
+        # appearing in live_workers proves both gates passed).
+        seen_states = set()
+
+        def victim_live():
+            lease = store.read_all().get(victim)
+            if lease is not None:
+                seen_states.add(lease.state)
+            return 1 if victim in gw.live_workers() else 0
+
+        _await_metric(victim_live, 1, 300.0,
+                      f"{victim} rejoining the routable set")
+        lease = store.read_all()[victim]
+        assert lease.pid != old_pid, "victim lease not from respawn"
+        assert lease.step == STEP, \
+            f"rejoined at step {lease.step}, fleet at {STEP}"
+        assert is_routable(lease.state), lease.state
+        assert "warming" in seen_states, \
+            "victim never showed 'warming' before rejoining " \
+            f"(saw {seen_states})"
+        # The respawned process answers at the right step on the wire.
+        ping = SocketTransport().request(tuple(lease.addr),
+                                         {"op": "ping"})[0]
+        assert ping["status"] == "ok" and ping["step"] == STEP, ping
+        print(f"  {victim} respawned (pid {lease.pid}), rejoined "
+              f"routable at step {lease.step}; states seen: "
+              f"{sorted(seen_states)}")
+
+        # Zero post-warmup compiles — asserted CROSS-PROCESS via each
+        # worker's own lease-published compile counter.
+        for wid, l in sorted(store.read_all().items()):
+            compiles = l.extra.get("post_warmup_compiles")
+            assert compiles == 0, \
+                f"{wid} reports {compiles} post-warmup compile(s)"
+
+        # The kill must have surfaced as post-acceptance retries (the
+        # victim had pooled connections and in-flight requests).
+        assert sum(gw.metrics.retries.values()) >= 1, \
+            "SIGKILL produced no gateway retries"
+
+        # A second wave with the respawned worker in rotation.
+        res2 = loadgen.run_load(gw, frames, n_requests=20,
+                                concurrency=4, references=refs,
+                                timeout=300.0)
+        assert res2["completed"] == 20 and not res2["dropped"] \
+            and not res2["mismatched"], res2
+        print(f"  post-respawn wave: {res2['completed']}/20 clean; "
+              f"served by {sorted(res2['per_replica'])}")
+
+        # Per-worker liveness/respawn/retry gauges in the Prometheus
+        # export (the PR-14 registry surface).
+        txt = gw.registry.prometheus_text()
+        for needle in (f'gateway_worker_live{{worker="{victim}"}}',
+                       f'gateway_worker_respawns{{worker="{victim}"}}',
+                       f'gateway_worker_up{{worker="{victim}"}}',
+                       f'gateway_retries{{worker="{victim}"}}',
+                       "gateway_workers_live"):
+            assert needle in txt, f"{needle!r} missing from export"
+        print("  prometheus export carries per-worker liveness/"
+              "respawn/retry gauges")
+    finally:
+        gw.close()
+        sup.stop(kill_workers=True)
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1433,6 +1597,7 @@ DRILLS = [
     drill_wire,
     drill_trace,
     drill_contbatch,
+    drill_gateway,
 ]
 
 

@@ -9,8 +9,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
-from check_bench_schema import (CONTBATCH_METRIC, check_file,  # noqa: E402
-                                check_payload, main)
+from check_bench_schema import (CONTBATCH_METRIC, GATEWAY_METRIC,  # noqa: E402
+                                check_file, check_payload, main)
 
 
 def test_committed_artifacts_honor_schema(capsys):
@@ -72,6 +72,23 @@ def test_checker_requires_both_contbatch_arms():
     # An honest error record is exempt — there is no ratio to back.
     assert not check_payload("err", {
         "metric": CONTBATCH_METRIC, "value": None, "error": "boom"})
+
+
+def test_checker_requires_both_gateway_arms():
+    base = {"metric": GATEWAY_METRIC, "value": 0.8, "unit": "ms",
+            "platform": "cpu", "smoke_operating_point": True}
+    ok = dict(base, per_arm={"in_process": {"p50_ms": 5.0},
+                             "gateway": {"p50_ms": 5.8}})
+    assert not check_payload("ok", ok)
+    # The overhead claim needs both the in-process baseline and the
+    # gateway arm from the same run.
+    assert check_payload("none", base)
+    assert check_payload("half", dict(
+        base, per_arm={"gateway": {"p50_ms": 5.8}}))
+    assert check_payload("shape", dict(
+        base, per_arm={"gateway": {"p50_ms": 5.8}, "in_process": 5.0}))
+    assert not check_payload("err", {
+        "metric": GATEWAY_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_rejects_silent_empty_wrapper(tmp_path):

@@ -1,0 +1,719 @@
+"""Multi-process serving tier: framing, leases, gateway routing with
+deadline propagation, and worker supervision.
+
+Deadline and failover semantics run on FAKE clocks and a FAKE
+transport (no sockets, no sleeps) — the contract under test is the
+fleet's, verbatim: each worker tried at most once, post-acceptance
+failures walk the owner chain, ``RequestTimedOut`` NEVER retried, a
+request that expires while queued is never dispatched at all. The
+end-to-end test runs a real :class:`WorkerServer` (real sockets,
+in-process engine) and pins bit-exactness + zero post-warmup compiles
+across the gateway path; the actual multi-PROCESS kill drill is the
+slow-marked subprocess test at the bottom.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.serving.batcher import RequestTimedOut
+from raft_tpu.serving.gateway import (GatewayConfig, GatewayMetrics,
+                                      ServingGateway, SocketTransport,
+                                      WorkerConnectionError)
+from raft_tpu.serving.health import STALE, EngineUnhealthy
+from raft_tpu.serving.netproto import (FileLeaseStore, Lease,
+                                       ProtocolError, owners_key,
+                                       read_message, write_message)
+from raft_tpu.serving.reload import ReloadSnapshot
+from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeTransport:
+    """Scripted transport: ``script`` is a list of callables, one per
+    hop, each receiving ``(addr, header, body)`` and returning a
+    ``(header, body)`` reply or raising. Every hop is recorded."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.sent = []
+
+    def request(self, addr, header, body=b"", deadline=None,
+                clock=time.monotonic):
+        self.sent.append((tuple(addr), dict(header), bytes(body)))
+        if not self.script:
+            raise AssertionError("transport called more times than "
+                                 "scripted")
+        return self.script.pop(0)(addr, header, body)
+
+    def close(self):
+        pass
+
+
+def _ok_reply(worker="w"):
+    flow = np.zeros((4, 4, 2), np.float32)
+
+    def reply(addr, header, body):
+        return ({"status": "ok", "shape": [4, 4, 2],
+                 "dtype": "float32", "worker": worker},
+                bytearray(flow.tobytes()))
+    return reply
+
+
+def _fresh_store(tmp_path, workers, wall, step=None, state="ready"):
+    store = FileLeaseStore(str(tmp_path / "leases"))
+    for i, wid in enumerate(workers):
+        store.publish(Lease(worker_id=wid, addr=("127.0.0.1", 9000 + i),
+                            state=state, step=step,
+                            t_heartbeat=wall()))
+    return store
+
+
+def _gateway(store, transport, clock, wall, **cfg):
+    cfg.setdefault("queue_timeout_ms", 5_000)
+    cfg.setdefault("dispatch_threads", 0)   # manual drive
+    cfg.setdefault("poll_interval_s", 0.0)
+    gw = ServingGateway(store, GatewayConfig(**cfg),
+                        transport=transport, clock=clock, wall=wall)
+    gw.refresh_membership()
+    return gw
+
+
+FRAME = np.zeros((8, 8, 3), np.uint8)
+
+
+# -- framing ------------------------------------------------------------
+
+class TestFraming:
+    def test_roundtrip_header_and_body(self):
+        a, b = socket.socketpair()
+        try:
+            body = os.urandom(1024)
+            write_message(a, {"op": "submit", "x": 1}, body)
+            hdr, got = read_message(b)
+            assert hdr == {"op": "submit", "x": 1}
+            assert bytes(got) == body
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_returns_none(self):
+        a, b = socket.socketpair()
+        a.close()
+        try:
+            assert read_message(b) is None
+        finally:
+            b.close()
+
+    def test_mid_frame_eof_is_protocol_error(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"\x00\x00\x00\x10partial")   # promises 16 bytes
+            a.close()
+            with pytest.raises(ProtocolError):
+                read_message(b)
+        finally:
+            b.close()
+
+    def test_oversized_header_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"\xff\xff\xff\xff")
+            with pytest.raises(ProtocolError):
+                read_message(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# -- leases -------------------------------------------------------------
+
+class TestLeases:
+    def test_roundtrip_and_ttl(self, tmp_path):
+        store = FileLeaseStore(str(tmp_path))
+        lease = Lease(worker_id="w0", addr=("127.0.0.1", 7000),
+                      state="ready", step=5, buckets=((36, 60),),
+                      pid=42, seq=3, t_heartbeat=100.0,
+                      extra={"post_warmup_compiles": 0})
+        store.publish(lease)
+        back = store.read_all()["w0"]
+        assert back.addr == ("127.0.0.1", 7000)
+        assert back.buckets == ((36, 60),)
+        assert back.step == 5 and back.pid == 42
+        assert back.extra == {"post_warmup_compiles": 0}
+        assert back.fresh(ttl_s=2.0, now=101.0)
+        assert not back.fresh(ttl_s=2.0, now=103.0)
+        store.remove("w0")
+        assert store.read_all() == {}
+
+    def test_corrupt_lease_skipped(self, tmp_path):
+        store = FileLeaseStore(str(tmp_path))
+        store.publish(Lease("w0", ("h", 1), "ready",
+                            t_heartbeat=1.0))
+        (tmp_path / "bad.lease.json").write_text("{torn")
+        assert list(store.read_all()) == ["w0"]
+
+    def test_owners_key_matches_router_namespaces(self):
+        assert owners_key((40, 64)) == "40x64"
+        assert owners_key((40, 64), iters=6) == "40x64@6"
+
+    def test_reload_snapshot_roundtrip(self):
+        snap = ReloadSnapshot(current_step=7, pinned_steps=(3, 5),
+                              wave_step=9,
+                              replica_steps={"r0": 7, "r1": None})
+        assert ReloadSnapshot.from_dict(snap.to_dict()) == snap
+
+
+# -- membership ---------------------------------------------------------
+
+class TestMembership:
+    def test_stale_lease_unroutable(self, tmp_path):
+        wall = FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+        gw = _gateway(store, FakeTransport([]), FakeClock(), wall,
+                      lease_ttl_s=2.0)
+        assert gw.live_workers() == ["w0", "w1"]
+        wall.advance(5.0)           # both leases now past the TTL
+        states = gw.refresh_membership()
+        assert states == {"w0": STALE, "w1": STALE}
+        assert gw.live_workers() == []
+        assert gw.worker_states()["w0"] == STALE
+
+    def test_unroutable_self_reported_state(self, tmp_path):
+        wall = FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall, state="warming")
+        gw = _gateway(store, FakeTransport([]), FakeClock(), wall)
+        assert gw.live_workers() == []
+
+    def test_expected_step_gate(self, tmp_path):
+        wall = FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall, step=3)
+        gw = _gateway(store, FakeTransport([]), FakeClock(), wall,
+                      expected_step=4)
+        assert gw.live_workers() == []
+        gw2 = _gateway(store, FakeTransport([]), FakeClock(), wall,
+                       expected_step=3)
+        assert gw2.live_workers() == ["w0"]
+
+
+# -- deadline propagation ----------------------------------------------
+
+class TestDeadlines:
+    def test_queued_expiry_never_dispatched(self, tmp_path):
+        """A request whose deadline expires while QUEUED resolves
+        RequestTimedOut with zero transport calls — the satellite-3
+        first hop."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall)
+        transport = FakeTransport([_ok_reply()])
+        gw = _gateway(store, transport, clock, wall,
+                      queue_timeout_ms=5_000)
+        fut = gw.submit(FRAME, FRAME)
+        clock.advance(6.0)          # budget was 5s
+        assert gw._dispatch_next(timeout=0)
+        with pytest.raises(RequestTimedOut, match="never dispatched"):
+            fut.result(0)
+        assert transport.sent == []
+        assert gw.metrics.timeouts_queued == 1
+
+    def test_mid_retry_expiry_not_retried(self, tmp_path):
+        """A deadline that expires while a failed hop is being retried
+        stops the walk: exactly one dispatch, then RequestTimedOut —
+        not a second attempt on the remaining live owner."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+
+        def die_and_burn_budget(addr, header, body):
+            clock.advance(6.0)      # the hop consumed the whole budget
+            raise WorkerConnectionError("worker died mid-request")
+
+        transport = FakeTransport([die_and_burn_budget, _ok_reply()])
+        gw = _gateway(store, transport, clock, wall,
+                      queue_timeout_ms=5_000)
+        fut = gw.submit(FRAME, FRAME)
+        assert gw._dispatch_next(timeout=0)
+        with pytest.raises(RequestTimedOut, match="not retrying"):
+            fut.result(0)
+        assert len(transport.sent) == 1
+        assert gw.metrics.timeouts == 1
+
+    def test_worker_timeout_reply_never_retried(self, tmp_path):
+        """A worker's 'timeout' status is the client's budget dying at
+        that hop — same contract as the fleet: never retried, even
+        with healthy owners remaining."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1", "w2"], wall)
+        transport = FakeTransport([
+            lambda a, h, b: ({"status": "timeout",
+                              "error": "queued too long"}, bytearray()),
+            _ok_reply(), _ok_reply()])
+        gw = _gateway(store, transport, clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        assert gw._dispatch_next(timeout=0)
+        with pytest.raises(RequestTimedOut):
+            fut.result(0)
+        assert len(transport.sent) == 1
+        assert gw.metrics.retries == {}
+
+    def test_absolute_deadline_on_the_wire(self, tmp_path):
+        """The frame header carries submit-time + queue_timeout_ms as
+        an ABSOLUTE monotonic deadline (the worker re-enforces it)."""
+        clock, wall = FakeClock(500.0), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall)
+        transport = FakeTransport([_ok_reply()])
+        gw = _gateway(store, transport, clock, wall,
+                      queue_timeout_ms=5_000)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        fut.result(0)
+        (_, header, _), = transport.sent
+        assert header["deadline"] == pytest.approx(505.0)
+        assert header["op"] == "submit"
+
+
+# -- routing / failover -------------------------------------------------
+
+class TestRouting:
+    def test_post_acceptance_failure_walks_chain(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1", "w2"], wall)
+
+        def dead(addr, header, body):
+            raise WorkerConnectionError("connection reset")
+
+        gw = _gateway(store, FakeTransport([dead, _ok_reply("w-ok")]),
+                      clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        flow = fut.result(0)
+        assert flow.shape == (4, 4, 2)
+        assert fut.replica_id == "w-ok"
+        assert sum(gw.metrics.retries.values()) == 1
+        assert len(gw.transport.sent) == 2
+        # Two different workers were tried.
+        assert gw.transport.sent[0][0] != gw.transport.sent[1][0]
+
+    def test_typed_error_reply_walks_chain(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+        gw = _gateway(store, FakeTransport([
+            lambda a, h, b: ({"status": "error",
+                              "error_type": "RuntimeError",
+                              "error": "dispatch failed"}, bytearray()),
+            _ok_reply("w-ok")]), clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(0).shape == (4, 4, 2)
+        assert sum(gw.metrics.retries.values()) == 1
+
+    def test_exhaustion_sheds_with_clear_error(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+
+        def dead(addr, header, body):
+            raise WorkerConnectionError("connection reset")
+
+        gw = _gateway(store, FakeTransport([dead, dead]), clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        with pytest.raises(EngineUnhealthy) as ei:
+            fut.result(0)
+        # Each worker tried at most once, then shed naming the fleet.
+        assert len(gw.transport.sent) == 2
+        assert "w0" in str(ei.value) and "w1" in str(ei.value)
+        assert gw.metrics.shed == 1
+
+    def test_no_lease_holder_sheds(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path / "leases"))
+        gw = _gateway(store, FakeTransport([]), clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        with pytest.raises(EngineUnhealthy, match="no live "
+                                                  "lease-holder"):
+            fut.result(0)
+
+    def test_rendezvous_agrees_with_fleet_router(self, tmp_path):
+        """The gateway scores the same digests as the in-process
+        BucketRouter, so both tiers route a bucket identically."""
+        from raft_tpu.serving.fleet import BucketRouter
+
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        workers = ["w0", "w1", "w2"]
+        store = _fresh_store(tmp_path, workers, wall)
+        expected = BucketRouter(workers).owners((16, 16))
+        got = {}
+
+        def record(addr, header, body):
+            got["addr"] = tuple(addr)
+            return _ok_reply()(addr, header, body)
+
+        gw = _gateway(store, FakeTransport([record]), clock, wall)
+        fut = gw.submit(np.zeros((16, 16, 3), np.uint8),
+                        np.zeros((16, 16, 3), np.uint8))
+        gw._dispatch_next(timeout=0)
+        fut.result(0)
+        # w{i} listens on port 9000+i in _fresh_store.
+        owner_port = 9000 + workers.index(expected[0])
+        assert got["addr"][1] == owner_port
+
+
+# -- gateway metrics -----------------------------------------------------
+
+class TestGatewayMetrics:
+    def test_loadgen_reader_surface(self):
+        m = GatewayMetrics()
+        m.record_request()
+        m.record_response("w0", 0.010)
+        assert m.latency_ms()["p50"] == pytest.approx(10.0)
+        assert m.batch_histogram() == {}
+        snap = m.snapshot()
+        assert snap["gateway_responses"] == 1.0
+
+    def test_registry_export(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall)
+        gw = _gateway(store, FakeTransport([_ok_reply("w0")]),
+                      clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        fut.result(0)
+        txt = gw.registry.prometheus_text()
+        assert 'gateway_worker_live{worker="w0"} 1' in txt
+        assert 'gateway_routed{worker="w0"} 1' in txt
+        assert "gateway_workers_live 1" in txt
+
+
+# -- supervisor ---------------------------------------------------------
+
+class FakeProc:
+    def __init__(self):
+        self.rc = None
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+
+class TestSupervisor:
+    def _sup(self, store, clock, wall, **kw):
+        procs = []
+
+        def spawn(spec, env=None):
+            p = FakeProc()
+            procs.append(p)
+            return p
+
+        kw.setdefault("stale_after_s", 2.0)
+        kw.setdefault("lease_grace_s", 10.0)
+        kw.setdefault("respawn_base_delay_s", 1.0)
+        kw.setdefault("respawn_max_delay_s", 8.0)
+        kw.setdefault("min_uptime_s", 5.0)
+        kw.setdefault("breaker_threshold", 3)
+        kw.setdefault("breaker_cooldown_s", 60.0)
+        sup = WorkerSupervisor(
+            [WorkerSpec("w0", {"worker_id": "w0"})], store,
+            spawn_fn=spawn, clock=clock, wall=wall, **kw)
+        return sup, procs
+
+    def _heartbeat(self, store, wall):
+        store.publish(Lease("w0", ("h", 1), "ready",
+                            t_heartbeat=wall()))
+
+    def test_respawn_with_exponential_backoff(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        assert len(procs) == 1
+
+        # Early death #1: backoff = base * 2^0 = 1s.
+        procs[0].rc = -9
+        assert sup.poll_once()["w0"] == "dead"
+        assert store.read_all() == {}   # corpse's lease dropped
+        assert sup.poll_once()["w0"] == "backoff"
+        clock.advance(1.0)
+        assert sup.poll_once()["w0"] == "respawned"
+        assert sup.respawns("w0") == 1 and len(procs) == 2
+
+        # Early death #2: streak 2 -> backoff doubles to 2s.
+        procs[1].rc = 1
+        sup.poll_once()
+        clock.advance(1.0)
+        assert sup.poll_once()["w0"] == "backoff"
+        clock.advance(1.0)
+        assert sup.poll_once()["w0"] == "respawned"
+
+        # A stable run (uptime past min_uptime_s, fresh lease) resets
+        # the streak: the NEXT death backs off from base again.
+        clock.advance(6.0)
+        wall.advance(6.0)
+        self._heartbeat(store, wall)
+        assert sup.poll_once()["w0"] == "ok"
+        procs[2].rc = -9
+        sup.poll_once()
+        clock.advance(1.0)
+        assert sup.poll_once()["w0"] == "respawned"
+
+    def test_crash_loop_breaker_opens_and_probes(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall,
+                               breaker_threshold=3,
+                               breaker_cooldown_s=60.0)
+        sup.start_all()
+        # Three consecutive early deaths trip the crash-loop breaker.
+        for _ in range(3):
+            procs[-1].rc = -9
+            sup.poll_once()
+            clock.advance(8.0)      # past any backoff
+            sup.poll_once()
+        # Breaker OPEN: the slot stays down, no spawn burn.
+        assert sup.status()["w0"]["breaker"] == "open"
+        n = len(procs)
+        assert sup.poll_once()["w0"] == "breaker-open"
+        assert len(procs) == n
+        # Cooldown elapses -> half-open -> ONE probe spawn.
+        clock.advance(61.0)
+        assert sup.poll_once()["w0"] == "respawned"
+        assert len(procs) == n + 1
+        # The probe surviving past min_uptime closes the breaker.
+        clock.advance(6.0)
+        wall.advance(6.0)
+        self._heartbeat(store, wall)
+        assert sup.poll_once()["w0"] == "ok"
+        assert sup.status()["w0"]["breaker"] == "closed"
+
+    def test_stale_lease_live_process_killed(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall, lease_grace_s=10.0)
+        sup.start_all()
+        self._heartbeat(store, wall)
+        clock.advance(5.0)
+        assert sup.poll_once()["w0"] == "ok"    # within grace, fresh
+        # Heartbeat stops; process stays alive past the grace window.
+        clock.advance(6.0)
+        wall.advance(11.0)
+        assert sup.poll_once()["w0"] == "stale-killed"
+        assert procs[0].killed
+        assert store.read_all() == {}
+
+    def test_registry_gauges(self, tmp_path):
+        from raft_tpu.observability.registry import MetricsRegistry
+
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        reg = MetricsRegistry()
+        sup.attach_registry(reg)
+        sup.start_all()
+        procs[0].rc = -9
+        sup.poll_once()
+        clock.advance(1.0)
+        sup.poll_once()
+        txt = reg.prometheus_text()
+        assert 'gateway_worker_up{worker="w0"} 1' in txt
+        assert 'gateway_worker_respawns{worker="w0"} 1' in txt
+        assert 'gateway_worker_crash_streak{worker="w0"} 1' in txt
+        assert 'gateway_worker_breaker{worker="w0"} 0' in txt
+
+
+# -- worker protocol (stub engine, real sockets) -------------------------
+
+class _StubFuture:
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class _StubEngine:
+    """Just enough engine for protocol-level WorkerServer tests."""
+
+    def __init__(self):
+        self.submits = []
+
+    def start(self, warmup=True):
+        return self
+
+    def close(self):
+        pass
+
+    def health_state(self):
+        return "ready"
+
+    def submit(self, im1, im2, priority="high", iters=None,
+               trace_id=None, deadline_s=None):
+        self.submits.append({"shape": im1.shape, "dtype": im1.dtype,
+                             "priority": priority,
+                             "deadline_s": deadline_s})
+        flow = np.zeros((*im1.shape[:2], 2), np.float32)
+        return _StubFuture(flow)
+
+
+@pytest.fixture
+def stub_worker(tmp_path):
+    from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+    engine = _StubEngine()
+    cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                       heartbeat_interval_s=0.05, step=3)
+    server = WorkerServer(engine, cfg).start(warmup=False)
+    yield server, engine
+    server.stop()
+
+
+class TestWorkerProtocol:
+    def _submit_header(self, frame, deadline=None):
+        return {"op": "submit", "shape": list(frame.shape),
+                "dtype": str(frame.dtype), "split": frame.nbytes,
+                "priority": "high", "iters": None,
+                "deadline": deadline, "trace_id": None}
+
+    def test_ping_reports_state_and_step(self, stub_worker):
+        server, _ = stub_worker
+        hdr, _ = SocketTransport().request(server.addr, {"op": "ping"})
+        assert hdr["status"] == "ok"
+        assert hdr["state"] == "ready" and hdr["step"] == 3
+
+    def test_submit_roundtrip_uint8_wire(self, stub_worker):
+        server, engine = stub_worker
+        frame = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+        hdr, body = SocketTransport().request(
+            server.addr, self._submit_header(frame),
+            frame.tobytes() + frame.tobytes())
+        assert hdr["status"] == "ok" and hdr["worker"] == "w0"
+        flow = np.frombuffer(body, np.float32).reshape(hdr["shape"])
+        assert flow.shape == (8, 8, 2)
+        # The uint8 wire bytes reached the engine as uint8 views.
+        assert engine.submits[0]["dtype"] == np.uint8
+        assert engine.submits[0]["shape"] == (8, 8, 3)
+
+    def test_expired_deadline_rejected_at_admission(self, stub_worker):
+        """The worker hop re-enforces the absolute deadline: an
+        expired request is answered 'timeout' without ever touching
+        the engine."""
+        server, engine = stub_worker
+        frame = np.zeros((8, 8, 3), np.uint8)
+        hdr, _ = SocketTransport().request(
+            server.addr,
+            self._submit_header(frame,
+                                deadline=time.monotonic() - 1.0),
+            frame.tobytes() + frame.tobytes())
+        assert hdr["status"] == "timeout"
+        assert engine.submits == []
+
+    def test_deadline_propagates_into_engine_submit(self, stub_worker):
+        server, engine = stub_worker
+        frame = np.zeros((8, 8, 3), np.uint8)
+        deadline = time.monotonic() + 30.0
+        hdr, _ = SocketTransport().request(
+            server.addr, self._submit_header(frame, deadline=deadline),
+            frame.tobytes() + frame.tobytes())
+        assert hdr["status"] == "ok"
+        assert engine.submits[0]["deadline_s"] == pytest.approx(
+            deadline)
+
+    def test_lease_published_with_heartbeats(self, stub_worker):
+        server, _ = stub_worker
+        store = server.store
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            lease = store.read_all().get("w0")
+            if lease is not None and lease.seq >= 2:
+                break
+            time.sleep(0.02)
+        assert lease is not None and lease.seq >= 2
+        assert lease.state == "ready" and lease.step == 3
+        assert tuple(lease.addr) == tuple(server.addr)
+        assert lease.extra.get("post_warmup_compiles") == 0
+
+
+# -- end to end (real engine, real sockets, one process) -----------------
+
+class TestGatewayEndToEnd:
+    def test_bit_exact_zero_compiles_through_gateway(self, tmp_path):
+        from raft_tpu.evaluate import load_predictor
+        from raft_tpu.serving.engine import ServingConfig, ServingEngine
+        from raft_tpu.serving.metrics import CompileWatch
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        store = FileLeaseStore(str(tmp_path / "leases"))
+        predictor = load_predictor("random", small=True, iters=2)
+        engine = ServingEngine(predictor, ServingConfig(
+            max_batch=2, max_wait_ms=1.0, buckets=((36, 60),),
+            queue_timeout_ms=30_000, replica_id="w0"))
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           buckets=((36, 60),), max_batch=2, step=0)
+        server = WorkerServer(engine, cfg, lease_store=store)
+        server.start(warmup=True)
+        gw = ServingGateway(store, GatewayConfig(
+            queue_timeout_ms=30_000, dispatch_threads=2,
+            poll_interval_s=0.05, expected_step=0)).start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not gw.live_workers():
+                assert time.monotonic() < deadline, "worker never live"
+                time.sleep(0.02)
+            rng = np.random.RandomState(3)
+            im1 = rng.randint(0, 255, (36, 60, 3)).astype(np.uint8)
+            im2 = rng.randint(0, 255, (36, 60, 3)).astype(np.uint8)
+            ref = engine.submit(im1, im2).result(60)
+            with CompileWatch() as watch:
+                flows = [gw.submit(im1, im2) for _ in range(4)]
+                flows = [f.result(60) for f in flows]
+            for flow in flows:
+                assert np.array_equal(flow, ref), \
+                    "gateway response not bit-exact"
+            assert watch.compiles == 0, \
+                f"{watch.compiles} post-warmup compiles via gateway"
+            lease = store.read_all()["w0"]
+            assert lease.extra["post_warmup_compiles"] == 0
+            txt = gw.registry.prometheus_text()
+            assert 'gateway_worker_live{worker="w0"} 1' in txt
+            assert 'gateway_routed{worker="w0"} 4' in txt
+        finally:
+            gw.close()
+            server.stop()
+
+
+# -- the multi-process drill (slow tier) ---------------------------------
+
+@pytest.mark.slow
+def test_gateway_drill_subprocess():
+    """The full kill-a-process proof: 3 worker processes, SIGKILL one
+    under 50-client load, supervised respawn + rejoin. Slow-marked —
+    spawns real interpreters and warms three engines."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "serve_drill.py"),
+         "--drill", "gateway"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"drill failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "PASS drill_gateway" in proc.stdout

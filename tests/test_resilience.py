@@ -143,6 +143,58 @@ def test_fault_injector_commit_errors_and_process_targeting(monkeypatch):
     on_me.maybe_fail_ckpt_commit()  # budget exhausted: silent
 
 
+def test_fault_injector_worker_knobs_from_env(monkeypatch):
+    monkeypatch.setenv("RAFT_FAULT_WORKER_KILL_NTH", "3")
+    monkeypatch.setenv("RAFT_FAULT_WORKER_HEARTBEAT_STALL_S", "4.5")
+    monkeypatch.setenv("RAFT_FAULT_WORKER_SOCKET_DROP", "2")
+    inj = FaultInjector.from_env()
+    assert inj.worker_kill_nth == 3
+    assert inj.worker_heartbeat_stall_s == 4.5
+    assert inj.worker_socket_drop == 2
+    assert inj.active
+    # Each knob flips `active` on its own.
+    assert FaultInjector(worker_kill_nth=1).active
+    assert FaultInjector(worker_heartbeat_stall_s=1.0).active
+    assert FaultInjector(worker_socket_drop=1).active
+
+
+def test_fault_injector_worker_kill_nth_matches_receive_seq():
+    inj = FaultInjector(worker_kill_nth=3)
+    # Deterministic by receive order: exactly the nth request fires
+    # (the WorkerServer does the actual os._exit).
+    assert [inj.kills_worker_request(i) for i in (1, 2, 3, 4)] == \
+        [False, False, True, False]
+    # Disabled and off-target injectors never fire.
+    assert not FaultInjector().kills_worker_request(3)
+    off = FaultInjector(worker_kill_nth=3,
+                        target_process=jax.process_index() + 1)
+    assert not off.kills_worker_request(3)
+
+
+def test_fault_injector_heartbeat_stall_is_one_shot():
+    inj = FaultInjector(worker_heartbeat_stall_s=2.5)
+    assert inj.take_heartbeat_stall() == 2.5
+    assert inj.take_heartbeat_stall() == 0.0   # consumed
+    assert inj.worker_heartbeat_stall_s == 0.0
+    # Off-target: never taken, budget intact.
+    off = FaultInjector(worker_heartbeat_stall_s=2.5,
+                        target_process=jax.process_index() + 1)
+    assert off.take_heartbeat_stall() == 0.0
+    assert off.worker_heartbeat_stall_s == 2.5
+
+
+def test_fault_injector_socket_drop_burns_budget():
+    inj = FaultInjector(worker_socket_drop=2)
+    assert inj.maybe_drop_worker_socket() is True
+    assert inj.maybe_drop_worker_socket() is True
+    assert inj.maybe_drop_worker_socket() is False  # budget exhausted
+    assert inj.worker_socket_drop == 0
+    off = FaultInjector(worker_socket_drop=1,
+                        target_process=jax.process_index() + 1)
+    assert off.maybe_drop_worker_socket() is False
+    assert off.worker_socket_drop == 1
+
+
 # -- checkpoint hardening -----------------------------------------------
 
 
