@@ -362,6 +362,41 @@ def _wait_for_backend(watchdog: _Watchdog) -> bool:
     return dev.platform == "cpu" and cpu_explicit
 
 
+def kernel_ab_arm(payload: dict, key: str, arms, measure, platform: str):
+    """Shared fused-kernel A/B arm (knee-provenance discipline like the
+    banded-vs-all-pairs arms): run ``measure()`` once per arm with that
+    arm's trace-time env flags forced, recording each reading as
+    ``value_{key}_{label}``. ``arms`` is ``((label, {FLAG: val, ...}),
+    ...)`` — each arm's flags are forced together via ``forced_flag``
+    (one ExitStack per arm) so the arm traces a fresh executable, and
+    the surrounding env is restored afterwards so later sections run
+    the ambient dispatch. ``measure`` must build a FRESH ``jax.jit``
+    per call: the flags are trace-time, so reusing a jitted callable
+    across arms would silently serve the first arm's executable. A
+    failed arm records ``{key}_{label}_error`` and its siblings
+    survive. On CPU the forced-pallas arms run kernels under the
+    Pallas interpreter — a parity tool, not a fast path — so a
+    pallas<xla reading on a cpu-labelled artifact is expected and
+    honest (kernel_ab_note says so in-band)."""
+    import contextlib
+
+    from raft_tpu.utils.envflags import forced_flag
+    for label, env in arms:
+        with contextlib.ExitStack() as stack:
+            for flag, val in env.items():
+                stack.enter_context(forced_flag(flag, val))
+            try:
+                payload[f"value_{key}_{label}"] = round(measure(), 3)
+            except Exception as e:   # the sibling arm must survive
+                payload[f"{key}_{label}_error"] = (
+                    f"{type(e).__name__}: {e}")
+    if platform == "cpu":
+        payload["kernel_ab_note"] = (
+            "cpu capture: forced-pallas arms run under the Pallas "
+            "interpreter — interpret-mode parity evidence, not a "
+            "fast path; speed deltas are TPU measurements")
+
+
 def main(gru: str = "ab", motion: str = "ab"):
     watchdog = _Watchdog()
     cpu_smoke = _wait_for_backend(watchdog)
@@ -542,44 +577,31 @@ def main(gru: str = "ab", motion: str = "ab"):
         payload["early_exit_error"] = f"{type(e).__name__}: {e}"
     _HEADLINE = dict(payload)
 
-    def kernel_ab_arm(key: str, flag: str):
-        # Fused-kernel A/B arm (knee-provenance discipline like the
-        # banded-vs-all-pairs arms): re-trace the headline engine with
-        # the named Pallas kernel forced ON ('1') and OFF ('0') and
-        # record both readings as value_{key}_{pallas,xla}. Trace-time
-        # env flag, so each arm builds a fresh jit; forced_flag restores
-        # the surrounding env afterwards so the remaining sections run
-        # the headline's own dispatch. On CPU the forced-pallas arm runs
-        # the kernel under the Pallas interpreter — a parity tool, not a
-        # fast path — so a pallas<xla reading on a cpu-labelled artifact
-        # is expected and honest (kernel_ab_note says so in-band).
-        from raft_tpu.utils.envflags import forced_flag
-        for kmode, env_val in (("pallas", "1"), ("xla", "0")):
-            with forced_flag(flag, env_val):
-                try:
-                    def fwdk(i1, i2, m=headline_model):
-                        flow_up = m.apply(variables, i1, i2,
-                                          test_mode=True)[1]
-                        return flow_up, jnp.sum(flow_up)
+    def headline_ab(key: str, flag: str):
+        # Headline-engine A/B pass through the module-level
+        # kernel_ab_arm helper: re-trace the headline model with the
+        # named Pallas kernel forced ON ('1') and OFF ('0') and record
+        # both readings as value_{key}_{pallas,xla}. measure() builds a
+        # fresh jit per arm (trace-time flag — see the helper).
+        def measure():
+            def fwdk(i1, i2, m=headline_model):
+                flow_up = m.apply(variables, i1, i2,
+                                  test_mode=True)[1]
+                return flow_up, jnp.sum(flow_up)
 
-                    payload[f"value_{key}_{kmode}"] = round(
-                        throughput(payload["batch"], jax.jit(fwdk)), 3)
-                except Exception as e:   # the sibling arm must survive
-                    payload[f"{key}_{kmode}_error"] = (
-                        f"{type(e).__name__}: {e}")
-        if platform == "cpu":
-            payload["kernel_ab_note"] = (
-                "cpu capture: forced-pallas arms run under the Pallas "
-                "interpreter — interpret-mode parity evidence, not a "
-                "fast path; speed deltas are TPU measurements")
+            return throughput(payload["batch"], jax.jit(fwdk))
+
+        kernel_ab_arm(payload, key,
+                      (("pallas", {flag: "1"}), ("xla", {flag: "0"})),
+                      measure, platform)
 
     if gru == "ab":
-        kernel_ab_arm("gru", "RAFT_GRU_PALLAS")
+        headline_ab("gru", "RAFT_GRU_PALLAS")
         _HEADLINE = dict(payload)
 
     if motion == "ab":
         # Round-7 motion-encoder arm, same contract as the GRU arm.
-        kernel_ab_arm("motion", "RAFT_MOTION_PALLAS")
+        headline_ab("motion", "RAFT_MOTION_PALLAS")
         _HEADLINE = dict(payload)
 
     if platform == "cpu":
@@ -650,6 +672,149 @@ def _sparse_metrics() -> dict:
     rate = REPS * batch / (time.perf_counter() - t0)
     return {"sparse_forward_pairs_per_sec": round(rate, 3),
             "sparse_batch": batch, "sparse_resolution": [h, w]}
+
+
+STEP_METRIC = "fused_step_vs_chained_pairs_per_sec_speedup"
+
+# Trace-time env for each refine-step arm. 'fused' forces the
+# one-launch chained motion-encoder→GRU(→flow-head) kernel
+# (ops/step_pallas.py); 'chained' forces the two per-kernel launches it
+# replaces — the packed [motion‖flow] handoff buffer round-trips HBM
+# between them every refine iteration; 'xla' turns all three off (the
+# pure XLA conv path both kernels are tested bit-compatible against).
+STEP_ARM_ENVS = (
+    ("fused", {"RAFT_STEP_PALLAS": "1"}),
+    ("chained", {"RAFT_STEP_PALLAS": "0",
+                 "RAFT_MOTION_PALLAS": "1",
+                 "RAFT_GRU_PALLAS": "1"}),
+    ("xla", {"RAFT_STEP_PALLAS": "0",
+             "RAFT_MOTION_PALLAS": "0",
+             "RAFT_GRU_PALLAS": "0"}),
+)
+
+
+def step_main(arm: str = "ab"):
+    """``python bench.py --step {ab,fused,chained,xla}`` — one-launch
+    refine-iteration benchmark (round 10, BENCH_r10).
+
+    ``ab`` (the committed-artifact arm) measures the SAME headline
+    forward (RAFT-large, test_mode, headline operating point) under all
+    three ``STEP_ARM_ENVS`` dispatches and publishes the fused/chained
+    throughput ratio as the headline value, with every arm's reading in
+    ``per_arm``. ``fused``/``chained``/``xla`` run a single arm for
+    debugging (value stays null — a ratio needs both measurements).
+
+    Alongside wall-clock, each Pallas arm carries the host-independent
+    claim the fusion actually makes: ``handoff_hbm_bytes_per_iter``,
+    the per-refine-iteration HBM traffic of the motion→GRU handoff.
+    The chained arm writes the packed ``[motion‖flow]`` buffer
+    (``B·(H/8)·(W/8)·128`` values) out of the motion launch and reads
+    it back into the GRU launch — one write + one read per iteration;
+    the fused arm keeps it VMEM-resident (0 bytes). The xla arm's
+    traffic is left null: XLA's own fusion decisions are not modeled
+    here, and a guessed number would impersonate a measurement."""
+    watchdog = _Watchdog()
+    cpu_smoke = _wait_for_backend(watchdog)
+    if cpu_smoke:
+        watchdog.lift()
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    platform = jax.devices()[0].platform
+    cfg = RAFTConfig(iters=ITERS, mixed_precision=(platform == "tpu"))
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+
+    def throughput(batch: int, fwd_fn) -> float:
+        # Same dispatch/sync discipline as the headline metric: WARMUP
+        # synced runs, then REPS back-to-back dispatches, one readback.
+        img = jnp.broadcast_to(img1, (batch, H, W, 3))
+        for _ in range(WARMUP):
+            float(fwd_fn(img, img)[1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fwd_fn(img, img)
+        float(out[1])
+        return REPS * batch / (time.perf_counter() - t0)
+
+    def measure():
+        # Fresh jit per arm — the step/motion/gru flags are trace-time,
+        # so each arm must build its own executable (see kernel_ab_arm).
+        def fwdk(i1, i2):
+            flow_up = model.apply(variables, i1, i2, test_mode=True)[1]
+            return flow_up, jnp.sum(flow_up)
+
+        return throughput(BATCH, jax.jit(fwdk))
+
+    # Handoff arithmetic (see docstring). The packed buffer is 128
+    # channels (126 motion + 2 flow, ops/layout.py invariant 6) in the
+    # refine chain's compute dtype: bf16 under mixed precision (TPU),
+    # f32 on the smoke hosts.
+    dtype_bytes = 2 if platform == "tpu" else 4
+    handoff_bytes = 2 * BATCH * (H // 8) * (W // 8) * 128 * dtype_bytes
+
+    arms = (STEP_ARM_ENVS if arm == "ab"
+            else tuple(a for a in STEP_ARM_ENVS if a[0] == arm))
+    payload = {
+        "metric": STEP_METRIC,
+        "value": None,
+        "unit": "x",
+        "batch": BATCH,
+        "platform": platform,
+        "resolution": f"{H}x{W}",
+        "iters": ITERS,
+        "reps": REPS,
+        "step_arm": arm,
+        "handoff_channels": 128,
+        "handoff_dtype_bytes": dtype_bytes,
+    }
+    kernel_ab_arm(payload, "step", arms, measure, platform)
+
+    per_arm = {}
+    for label, _env in arms:
+        rec = {}
+        rate = payload.pop(f"value_step_{label}", None)
+        err = payload.pop(f"step_{label}_error", None)
+        if rate is not None:
+            rec["pairs_per_sec"] = rate
+        if err is not None:
+            rec["error"] = err
+        if label == "fused":
+            rec["handoff_hbm_bytes_per_iter"] = 0
+        elif label == "chained":
+            rec["handoff_hbm_bytes_per_iter"] = handoff_bytes
+        else:               # xla: not modeled — see docstring
+            rec["handoff_hbm_bytes_per_iter"] = None
+        per_arm[label] = rec
+    payload["per_arm"] = per_arm
+
+    fused = per_arm.get("fused", {}).get("pairs_per_sec")
+    chained = per_arm.get("chained", {}).get("pairs_per_sec")
+    if fused and chained:
+        payload["value"] = round(fused / chained, 3)
+    if platform != "tpu":
+        payload["smoke_operating_point"] = True
+        payload["criterion_note"] = (
+            "cpu capture: both Pallas arms run under the Pallas "
+            "interpreter, so the wall-clock ratio is plumbing/parity "
+            "evidence (three distinct executables, same numbers), not "
+            "the TPU speedup. The host-independent claim is the "
+            "handoff arithmetic: the chained arm round-trips the "
+            "packed [motion‖flow] buffer through HBM every refine "
+            "iteration (handoff_hbm_bytes_per_iter) while the fused "
+            "arm keeps it VMEM-resident; the on-TPU capture is "
+            "tracked as ROADMAP debt")
+    _emit(payload)
+
+
+def _step_failure(msg: str) -> None:
+    _emit({"metric": STEP_METRIC, "value": None, "unit": "x",
+           "error": msg})
 
 
 SERVING_METRIC = "serving_vs_sequential_batch1_speedup"
@@ -1847,7 +2012,27 @@ if __name__ == "__main__":
                              "adds a forced pallas-vs-xla A/B pass; "
                              "'pallas'/'xla' force one dispatch for the "
                              "whole run")
+        ap.add_argument("--step", choices=("ab", "fused", "chained",
+                                           "xla"),
+                        default=None,
+                        help="one-launch refine-iteration benchmark "
+                             "instead of the headline: 'ab' measures "
+                             "the fused single-launch step kernel "
+                             "(RAFT_STEP_PALLAS) against the chained "
+                             "motion+GRU launches and the pure-XLA "
+                             "path and records the fused/chained "
+                             "speedup plus each arm's handoff HBM "
+                             "bytes (the BENCH_r10 artifact); "
+                             "'fused'/'chained'/'xla' run one arm")
         args = ap.parse_args()
+        if args.step is not None:
+            try:
+                step_main(arm=args.step)
+            except SystemExit:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                _step_failure(f"{type(e).__name__}: {e}")
+            sys.exit(0)
         if args.gru == "pallas":
             os.environ["RAFT_GRU_PALLAS"] = "1"
         elif args.gru == "xla":

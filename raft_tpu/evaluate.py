@@ -58,13 +58,17 @@ class FlowPredictor:
 
     The scan body's fused-kernel dispatches are trace-time env flags,
     not constructor knobs: ``RAFT_GRU_PALLAS`` (auto = fused Pallas
-    SepConvGRU cell on TPU when eligible; see ``ops/gru_pallas.py``) and
+    SepConvGRU cell on TPU when eligible; see ``ops/gru_pallas.py``),
     ``RAFT_MOTION_PALLAS`` (same contract for the fused BasicMotion-
-    Encoder chain; ``ops/motion_pallas.py``) are read when each
-    per-shape executable is traced, and the resolved modes are recorded
-    on the predictor as ``gru_impl``/``motion_impl`` at construction —
-    both for observability and so a misspelled value fails at predictor
-    build time, before the serving engine warms buckets against it.
+    Encoder chain; ``ops/motion_pallas.py``) and ``RAFT_STEP_PALLAS``
+    (the fused ONE-launch iteration chaining both, plus the flow head
+    where admissible; ``ops/step_pallas.py`` — where it applies it
+    subsumes the two per-kernel flags) are read when each per-shape
+    executable is traced, and the resolved modes are recorded on the
+    predictor as ``gru_impl``/``motion_impl``/``step_impl`` at
+    construction — both for observability and so a misspelled value
+    fails at predictor build time, before the serving engine warms
+    buckets against it.
     Flipping an env var after warmup would retrace (a compile the
     serving zero-compile contract forbids); set it before construction.
     """
@@ -129,14 +133,16 @@ class FlowPredictor:
                     f"early_exit patience must be >= 1, got {patience}")
             early_exit = (float(tol), int(patience))
         self.early_exit = early_exit
-        # Resolved RAFT_GRU_PALLAS / RAFT_MOTION_PALLAS modes
-        # ('auto'/'0'/'1') — validated here so bad values fail at build
-        # time, recorded for observability (bench/serving annotate
-        # payloads with them). The actual dispatches happen at trace
-        # time inside SepConvGRU/BasicUpdateBlock.__call__.
-        from raft_tpu.ops import gru_pallas, motion_pallas
+        # Resolved RAFT_GRU_PALLAS / RAFT_MOTION_PALLAS /
+        # RAFT_STEP_PALLAS modes ('auto'/'0'/'1') — validated here so
+        # bad values fail at build time, recorded for observability
+        # (bench/serving annotate payloads with them). The actual
+        # dispatches happen at trace time inside
+        # SepConvGRU/BasicUpdateBlock.__call__.
+        from raft_tpu.ops import gru_pallas, motion_pallas, step_pallas
         self.gru_impl = gru_pallas.resolve_mode()
         self.motion_impl = motion_pallas.resolve_mode()
+        self.step_impl = step_pallas.resolve_mode()
         # Optional sequence(spatial)-parallel execution: with a mesh the
         # forward runs through parallel.spatial.spatial_jit — image rows
         # sharded over the mesh's spatial axis, each device holding 1/d

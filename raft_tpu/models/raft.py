@@ -18,7 +18,12 @@ cell (``RAFT_GRU_PALLAS``, ``ops/gru_pallas.py``), which fuses both GRU
 steps into one launch so gate activations never round-trip HBM, and the
 BasicMotionEncoder chain (``RAFT_MOTION_PALLAS``,
 ``ops/motion_pallas.py``), which fuses its five convs the same way and
-hands the GRU its x input un-concatenated. The
+hands the GRU its x input un-concatenated. ``RAFT_STEP_PALLAS``
+(``ops/step_pallas.py``) goes one further and chains motion encoder →
+SepConvGRU (→ flow head where admissible) into a SINGLE launch per
+iteration with the [motion‖flow] handoff VMEM-resident — it subsumes
+the two per-kernel flags where it admits, and falls back loudly to the
+two-launch chain where it doesn't. The
 flags are read when the scan body is traced, so a jitted executable bakes
 one dispatch for all iterations (the serving warmup contract depends on
 this — see ``serving/engine.py``); the hidden-state carry crosses the

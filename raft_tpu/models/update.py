@@ -15,7 +15,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.ops import gru_pallas, motion_pallas
+from raft_tpu.ops import gru_pallas, motion_pallas, step_pallas
 
 # Convex-upsampling mask channels: 9 neighbors x (8x8) subpixels
 # (reference core/update.py:121, core/raft.py:74-85).
@@ -270,6 +270,14 @@ class BasicUpdateBlock(nn.Module):
             pair("convc1"), pair("convc2"), pair("convf1"),
             pair("convf2"), pair("conv"))
 
+    def _packed_flow_head_weights(self):
+        def pair(conv):
+            p = conv.variables["params"]
+            return (p["kernel"], p["bias"])
+
+        return step_pallas.pack_flow_head(
+            pair(self.flow_head.conv1), pair(self.flow_head.conv2))
+
     def __call__(self, net, inp, corr, flow, compute_mask=True):
         """``compute_mask``: Python ``True`` computes the mask head
         statically (training, and the final test_mode iteration);
@@ -277,26 +285,54 @@ class BasicUpdateBlock(nn.Module):
         zero mask-head ops, no cond; the round-5 two-call scan
         structure); a traced scalar bool still runs it under ``nn.cond``
         (legacy path, kept for np.bool_ flags)."""
-        # Fused motion-encoder dispatch (RAFT_MOTION_PALLAS, trace-time):
-        # the encoder's five convs in one Pallas launch emitting
-        # [out‖flow] directly, handed to the GRU as an x *part* so
-        # concat([inp, motion_features]) is never materialized (the GRU
-        # kernel consumes the parts via per-part weight slices; its conv
-        # path concatenates internally). auto = TPU only when the shape
-        # is VMEM-admissible (the fallback is logged); '1' forces
-        # (interpret mode off-TPU, the CPU parity tests); '0' restores
-        # the conv path below bit-for-bit. SmallUpdateBlock's encoder
-        # has a different conv chain and always keeps the conv path.
-        if not self.is_initializing() and motion_pallas.should_fuse(
-                flow, corr):
-            motion_features = motion_pallas.motion_encoder(
-                flow, corr, self._packed_motion_weights(),
+        # One-launch scan-body dispatch (RAFT_STEP_PALLAS, trace-time):
+        # motion encoder → SepConvGRU (→ flow head where admissible) as
+        # a single fused Pallas kernel with the [motion‖flow] handoff
+        # and all intermediates VMEM-resident — the round-10 tentpole.
+        # plan None falls through to the two-launch chain below (whose
+        # own flags then apply); 'mg' fuses through the GRU and leaves
+        # the heads to the XLA section; 'mgf' also emits delta_flow
+        # in-kernel (only when the mask head is statically skipped).
+        plan = None
+        if not self.is_initializing():
+            plan = step_pallas.plan_fusion(
+                net, inp, corr, flow,
+                want_flow_head=compute_mask is None)
+        if plan is not None:
+            fused = step_pallas.fused_step(
+                net, inp, corr, flow,
+                self._packed_motion_weights(),
+                self.gru._packed_weights(),
+                self._packed_flow_head_weights() if plan == "mgf"
+                else None,
                 dtype=self.dtype)
-            gru_x = (inp, motion_features)
+            if plan == "mgf":
+                net, delta_flow = fused
+                return net, None, delta_flow
+            net = fused
         else:
-            motion_features = self.encoder(flow, corr)
-            gru_x = jnp.concatenate([inp, motion_features], axis=-1)
-        net = self.gru(net, gru_x)
+            # Fused motion-encoder dispatch (RAFT_MOTION_PALLAS,
+            # trace-time): the encoder's five convs in one Pallas launch
+            # emitting [out‖flow] directly, handed to the GRU as an x
+            # *part* so concat([inp, motion_features]) is never
+            # materialized (the GRU kernel consumes the parts via
+            # per-part weight slices; its conv path concatenates
+            # internally). auto = TPU only when the shape is
+            # VMEM-admissible (the fallback is logged); '1' forces
+            # (interpret mode off-TPU, the CPU parity tests); '0'
+            # restores the conv path below bit-for-bit.
+            # SmallUpdateBlock's encoder has a different conv chain and
+            # always keeps the conv path.
+            if not self.is_initializing() and motion_pallas.should_fuse(
+                    flow, corr):
+                motion_features = motion_pallas.motion_encoder(
+                    flow, corr, self._packed_motion_weights(),
+                    dtype=self.dtype)
+                gru_x = (inp, motion_features)
+            else:
+                motion_features = self.encoder(flow, corr)
+                gru_x = jnp.concatenate([inp, motion_features], axis=-1)
+            net = self.gru(net, gru_x)
 
         # 0.25 balances gradients into the mask head (core/update.py:133).
         def _mask(mdl, n):

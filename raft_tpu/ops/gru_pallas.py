@@ -220,6 +220,28 @@ def _shift_rows(v, s: int):
     return jnp.concatenate([pad, v[:s]], axis=0)
 
 
+def halo_assemble(blocks, g: int, hw: int):
+    """Concatenate ``2n+1`` consecutive ``(g, C)`` tile blocks into one
+    ``(g + 2*hw,  C)`` working span with ``hw`` halo rows per side.
+
+    ``blocks`` are the neighbor block values in tile order
+    ``[cur-n, ..., cur, ..., cur+n]`` where ``n = ceil(hw/g)`` — the
+    generalization of the one-neighbor ``[prev[g-hw:], cur, next[:hw]]``
+    assembly to halos DEEPER than the tile itself (the fused step
+    kernel's combined receptive field, or motion's TH=4 rung where
+    halo=5 > th=4).  Inner neighbors contribute whole blocks; only the
+    outermost pair is sliced.  At grid edges the clamped index maps
+    make outer blocks garbage, which the callers' global-row validity
+    masks zero — exactly as in the n=1 case.
+    """
+    n = (len(blocks) - 1) // 2
+    lead = hw - (n - 1) * g            # rows taken from the outermost pair
+    parts = [blocks[0][g - lead:]]
+    parts += list(blocks[1:n]) + [blocks[n]] + list(blocks[n + 1:2 * n])
+    parts.append(blocks[2 * n][:lead])
+    return jnp.concatenate(parts, axis=0)
+
+
 def _gru_kernel(*refs, w: int, h_img: int, th: int, nparts: int):
     """One fused SepConvGRU step for a TH-row tile (+4 halo rows/side).
 
@@ -255,11 +277,10 @@ def _gru_kernel(*refs, w: int, h_img: int, th: int, nparts: int):
     # Working span: cur tile plus _HALO rows from each neighbor. At the
     # grid edges the neighbor index maps clamp to cur, so these halo rows
     # are garbage — the global-row masks below zero their contributions.
-    ha = jnp.concatenate(
-        [hp_ref[0][g - hw:], hc_ref[0], hn_ref[0][:hw]], axis=0)
+    ha = halo_assemble([hp_ref[0], hc_ref[0], hn_ref[0]], g, hw)
     xas = tuple(
-        jnp.concatenate([xrefs[3 * i][0][g - hw:], xrefs[3 * i + 1][0],
-                         xrefs[3 * i + 2][0][:hw]], axis=0)
+        halo_assemble([xrefs[3 * i][0], xrefs[3 * i + 1][0],
+                       xrefs[3 * i + 2][0]], g, hw)
         for i in range(p))
 
     # Flattened-index geometry: column (for horizontal tap validity) and

@@ -40,14 +40,30 @@ The contract (invariants for kernel authors)
 5. **Gradients are float32 at the boundary.**  ``out_dtype`` shapes only
    the forward value; custom-VJP backward outputs are emitted float32 and
    cast to the primal dtype by the wrapper (the corr kernel's contract).
+6. **Producer→consumer handoff between chained kernels.**  When one
+   kernel's output is the next kernel's input inside the same scan body
+   (motion encoder → GRU), the producer must emit the exact tensor the
+   consumer's input BlockSpec will window: the consumer's dtype
+   (invariant 1), the consumer's axis order (invariant 2), tiled over
+   the axis the consumer's grid iterates (invariant 3), with the packed
+   channel layout the consumer's weight slices expect (the motion
+   kernel's ``[out‖flow]`` concat is the GRU's x-part channel order).
+   Declared with ``handoff_tiled_out`` so the intent is visible at the
+   producer's ``out_specs``; the payoff is that the buffer between the
+   two custom calls is a plain HBM array XLA can alias — zero
+   relayout/convert ops at either boundary — and, for the fused
+   single-launch step kernel (``step_pallas.py``), that the SAME packed
+   value can stay VMEM-resident and never touch HBM at all: a handoff
+   that honors this invariant is *fusable by construction*.
 
-``corr_pallas.py`` (RAFT_CORR_TOUT), ``gru_pallas.py`` and
-``motion_pallas.py`` all build on these helpers; the VMEM-budget side of
-kernel admission lives in ``raft_tpu.ops.vmem``.  The motion kernel is
-the reason invariant 4 now matters *between* kernels too: it emits
-``[out‖flow]`` in the layout and dtype the fused GRU consumes as an
-x part, so no concat/relayout sits between the two custom calls inside
-the scan body.
+``corr_pallas.py`` (RAFT_CORR_TOUT), ``gru_pallas.py``,
+``motion_pallas.py`` and ``step_pallas.py`` all build on these helpers;
+the VMEM-budget side of kernel admission lives in ``raft_tpu.ops.vmem``.
+The motion kernel is the reason invariant 4 grew into invariant 6: it
+emits ``[out‖flow]`` in the layout and dtype the fused GRU consumes as
+an x part, so no concat/relayout sits between the two custom calls
+inside the scan body — and the round-10 fused step kernel collapses
+that handoff into VMEM entirely.
 """
 
 from __future__ import annotations
@@ -93,3 +109,21 @@ def query_tiled_out(b: int, n: int, feat: int, tile: int, dtype, *,
         spec = pl.BlockSpec((1, feat, tile), lambda bi, ti: (bi, 0, ti))
         shape = jax.ShapeDtypeStruct((b, feat, n), dtype)
     return spec, shape
+
+
+def handoff_tiled_out(b: int, n: int, feat: int, tile: int, dtype):
+    """Invariant 6's producer-side declaration: the out-spec of a kernel
+    whose output IS the next kernel's input inside the same scan body
+    (motion encoder → GRU).
+
+    Mechanically this is ``query_tiled_out(..., consumer_major=True)``
+    — the consumer-major order is not optional for a handoff — but the
+    distinct name makes the producer→consumer contract greppable at the
+    producer's ``out_specs``: dtype, axis order, tiling axis and packed
+    channel layout all match what the consumer's input BlockSpec will
+    window, so the interposed buffer is alias-able (two-launch chain)
+    or elidable entirely (the fused ``step_pallas`` kernel).
+
+    Returns ``(block_spec, shape_struct)``.
+    """
+    return query_tiled_out(b, n, feat, tile, dtype, consumer_major=True)

@@ -37,10 +37,12 @@ Design (the gru_pallas playbook, full-2-D edition)
 * **Clamped halos sized for the 3-conv receptive-field depth.** The
   flow branch needs ±5 rows (7x7 → ±3, then two 3x3 → ±1 each); the
   corr branch ±2 (1x1 contributes nothing). Each launch assembles
-  ``TH + 10`` rows from prev/cur/next block index maps (clamped at the
-  grid edges; clamp garbage is neutralized by the row masks). The
-  window is *exact*: the deepest tap chain of a cur-tile output lands
-  on the assembly's first/last row.
+  ``TH + 10`` rows from ``ceil(5/TH)`` neighbor blocks per side under
+  clamped index maps (``gru_pallas.halo_assemble`` — one neighbor at
+  TH≥8, two at the TH=4 rung, where the halo is deeper than the tile;
+  clamp garbage is neutralized by the row masks). The window is
+  *exact*: the deepest tap chain of a cur-tile output lands on the
+  assembly's first/last row.
 
 Numerics
 --------
@@ -60,9 +62,11 @@ is on-hardware perf debt, as for the GRU cell.
 
 ``RAFT_MOTION_PALLAS`` (trace-time, parsed by
 ``raft_tpu.utils.envflags``): ``auto``/unset — kernel on TPU when the
-shape is admissible (f32 at Sintel shapes is not; the fallback is
-logged loudly via ``vmem.log_fallback``, never silent); ``1`` — force
-(interpret mode off-TPU; raises if ineligible); ``0`` — conv path.
+shape is admissible (since round 10's TH=4 rung + phase-peak liveness
+accounting that includes Sintel f32; shapes the ladder still rejects
+fall back with a loud ``vmem.log_fallback``, never silently); ``1`` —
+force (interpret mode off-TPU; raises if ineligible); ``0`` — conv
+path.
 Only ``BasicUpdateBlock`` dispatches here; ``SmallUpdateBlock``'s
 encoder has a different conv chain and always keeps the conv path.
 """
@@ -77,17 +81,21 @@ from jax.experimental import pallas as pl
 
 from raft_tpu.ops import layout as klayout
 from raft_tpu.ops import vmem
-from raft_tpu.ops.gru_pallas import _bshift, _round_up, _shift_rows
+from raft_tpu.ops.gru_pallas import (_bshift, _round_up, _shift_rows,
+                                     halo_assemble)
 from raft_tpu.utils.envflags import env_enum
 
 # Vertical halo rows on each side of a row tile: the flow branch's
 # receptive-field depth (convf1 7x7 → ±3, convf2 → ±1, conv → ±1). The
-# corr branch needs only ±2 and shares the same assembly. Row tiles must
-# be at least this tall (halo comes from ONE neighboring block).
+# corr branch needs only ±2 and shares the same assembly. Tiles shorter
+# than the halo draw it from ceil(_HALO/TH) neighbor blocks per side
+# (halo_assemble).
 _HALO = 5
 
-# Row-tile ladder for real launches; every rung is >= _HALO.
-_ROW_LADDER = (16, 8)
+# Row-tile ladder for real launches. The TH=4 rung (round 10) is what
+# admits Sintel f32: halo deeper than the tile, paid for by smaller
+# assemblies under the phase-peak liveness estimate.
+_ROW_LADDER = (16, 8, 4)
 
 # Canonical BasicMotionEncoder channel widths (convc1/convc2/convf1/
 # convf2/conv outputs) — fixed by the architecture; the admission table
@@ -154,31 +162,37 @@ def pack_weights(convc1, convc2, convf1, convf2, conv):
 # Kernel
 # ---------------------------------------------------------------------------
 
-def _motion_kernel(cp_ref, cc_ref, cn_ref, fp_ref, fc_ref, fn_ref,
-                   wc1_ref, bc1_ref, wc2_ref, bc2_ref, wf1_ref, bf1_ref,
-                   wf2_ref, bf2_ref, woc_ref, wof_ref, bo_ref, out_ref, *,
-                   w: int, h_img: int, th: int):
+def _motion_kernel(*refs, w: int, h_img: int, th: int):
     """The whole motion-encoder chain for one TH-row tile (+5 halo
-    rows/side). ``c*``/``f*`` are the SAME flattened corr/flow arrays
-    under prev/cur/next block index maps (clamped at the grid edges);
-    the four intermediate feature maps live entirely in VMEM and the
-    final store emits ``[out ‖ flow]`` in the consumer's dtype."""
+    rows/side). ``refs`` is ``(<2nb+1 corr refs>, <2nb+1 flow refs>,
+    <11 weight refs>, out)`` where ``nb = ceil(_HALO/th)``; the corr/
+    flow neighbor refs are the SAME flattened arrays under clamped
+    block index maps; the four intermediate feature maps live entirely
+    in VMEM and the final store emits ``[out ‖ flow]`` in the
+    consumer's dtype."""
+    out_ref = refs[-1]
+    nb = -(-_HALO // th)           # neighbor blocks per side
+    ncorr = 2 * nb + 1
+    corr_refs = refs[:ncorr]
+    flow_refs = refs[ncorr:2 * ncorr]
+    (wc1_ref, bc1_ref, wc2_ref, bc2_ref, wf1_ref, bf1_ref,
+     wf2_ref, bf2_ref, woc_ref, wof_ref, bo_ref) = refs[2 * ncorr:-1]
+
     g = th * w                     # rows per tile (flattened)
     hw = _HALO * w                 # halo rows (flattened)
     m = th + 2 * _HALO             # assembly height
     rows = m * w
-    cdt = cc_ref.dtype
+    cdt = corr_refs[nb].dtype
     ti = pl.program_id(1)
 
-    # Working span: cur tile plus _HALO rows from each neighbor. Clamped
-    # edge garbage is neutralized by the global-row masks below. The
-    # window is exact for the 3-conv receptive-field depth: conv needs
-    # flo2 on rows [4, th+6), flo2 needs flo1 on [3, th+7), and flo1's
-    # ±3 taps there read flow rows [0, th+10) — the full assembly.
-    ca = jnp.concatenate(
-        [cp_ref[0][g - hw:], cc_ref[0], cn_ref[0][:hw]], axis=0)
-    fa = jnp.concatenate(
-        [fp_ref[0][g - hw:], fc_ref[0], fn_ref[0][:hw]], axis=0)
+    # Working span: cur tile plus _HALO rows from each side's neighbor
+    # blocks. Clamped edge garbage is neutralized by the global-row
+    # masks below. The window is exact for the 3-conv receptive-field
+    # depth: conv needs flo2 on rows [4, th+6), flo2 needs flo1 on
+    # [3, th+7), and flo1's ±3 taps there read flow rows [0, th+10) —
+    # the full assembly.
+    ca = halo_assemble([r[0] for r in corr_refs], g, hw)
+    fa = halo_assemble([r[0] for r in flow_refs], g, hw)
 
     ri = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
     col = ri - (ri // w) * w
@@ -252,19 +266,22 @@ def _pallas_motion(static, flow2d, corr2d, mats):
     last = grid[1] - 1
 
     kernel = functools.partial(_motion_kernel, w=w, h_img=h_img, th=th)
+    nb = -(-_HALO // th)
 
-    def spec_of(channels, idx_fn):
-        return pl.BlockSpec((1, g, channels), idx_fn)
+    def neighbor_specs(channels):
+        return [pl.BlockSpec(
+                    (1, g, channels),
+                    lambda bi, ti, k=k: (bi, jnp.clip(ti + k, 0, last), 0))
+                for k in range(-nb, nb + 1)]
 
-    prev = lambda bi, ti: (bi, jnp.maximum(ti - 1, 0), 0)
-    cur = lambda bi, ti: (bi, ti, 0)
-    nxt = lambda bi, ti: (bi, jnp.minimum(ti + 1, last), 0)
-
-    in_specs = ([spec_of(cc, prev), spec_of(cc, cur), spec_of(cc, nxt),
-                 spec_of(cf, prev), spec_of(cf, cur), spec_of(cf, nxt)]
+    in_specs = (neighbor_specs(cc) + neighbor_specs(cf)
                 + [_full_spec(m) for m in mats])
-    out_specs, out_shape = klayout.query_tiled_out(b, n, co + cf, g,
-                                                   out_dt)
+    operands = ([corr2d] * (2 * nb + 1) + [flow2d] * (2 * nb + 1)
+                + list(mats))
+    # Layout-contract invariant 6: the [out ‖ flow] emission is the
+    # GRU's packed x part, declared as a handoff.
+    out_specs, out_shape = klayout.handoff_tiled_out(b, n, co + cf, g,
+                                                     out_dt)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -272,7 +289,7 @@ def _pallas_motion(static, flow2d, corr2d, mats):
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(corr2d, corr2d, corr2d, flow2d, flow2d, flow2d, *mats)
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------
@@ -363,39 +380,54 @@ _motion.defvjp(_motion_fwd, _motion_bwd)
 def motion_vmem_parts(h_img: int, w: int, cc: int, th: int,
                       dtype_bytes: int, widths=_WIDTHS) -> dict:
     """Named scoped-VMEM estimate for one launch (see raft_tpu.ops.vmem).
-    Conservative: counts the double-buffered input blocks, the resident
-    weights, the assembly + largest shifted-operand copy, the four
-    compute-dtype intermediate feature maps and the widest live f32
-    accumulator."""
+
+    Round 10 refined this from sum-of-all-intermediates to *phase-peak*
+    liveness: the five convs run sequentially, so the working set is
+    the largest single phase's live values — the phase's input
+    operand(s), one shifted copy, the f32 accumulator, and the
+    across-phase residents (``fa`` for the passthrough, ``cor`` across
+    the flow branch) — not every feature map at once. The peak phase is
+    ``convc2`` (c1→c2 with c1-wide input + shift). Input windows are
+    charged per neighbor block (``ceil(_HALO/th)`` per side), which is
+    what lets the TH=4 rung admit shapes TH=8 cannot."""
     c1, c2, f1, f2, co = widths
+    d = dtype_bytes
     g = th * w
+    nb = -(-_HALO // th)
     rows = (th + 2 * _HALO) * w
     weight_elems = (cc * c1 + 9 * c1 * c2 + 49 * 2 * f1 + 9 * f1 * f2
                     + 9 * (c2 + f2) * co + c1 + c2 + f1 + f2 + co)
+    # Per-row live bytes of each sequential phase: held-across operands
+    # + the phase's input + shifted copy + f32 accumulator.
+    phases = (
+        cc * d + 2 * d + c1 * 4,                            # convc1 (1x1)
+        2 * d + 2 * c1 * d + c2 * 4,                        # convc2 (peak)
+        2 * d + c2 * d + 2 * 2 * d + f1 * 4,                # convf1 (7x7)
+        2 * d + c2 * d + 2 * f1 * d + f2 * 4,               # convf2
+        2 * d + c2 * d + f2 * d + max(c2, f2) * d + co * 4,  # conv (cat)
+    )
     return {
-        "corr_blocks": 3 * g * cc * dtype_bytes,
-        "flow_blocks": 3 * g * 2 * dtype_bytes,
-        "out_block": g * (co + 2) * dtype_bytes,
-        "weights": weight_elems * dtype_bytes,
-        "assembly_and_shift": rows * (cc + 2 + max(c1, cc)) * dtype_bytes,
-        "intermediates": rows * (c1 + c2 + f1 + f2) * dtype_bytes,
-        "f32_accumulators": rows * max(c1, c2, f1, f2, co) * 4,
+        "corr_blocks": (2 * nb + 1) * g * cc * d,
+        "flow_blocks": (2 * nb + 1) * g * 2 * d,
+        "out_block": g * (co + 2) * d,
+        "weights": weight_elems * d,
+        "intermediates_phase_peak": rows * max(phases),
     }
 
 
 def choose_rows(h_img: int, w: int, cc: int,
                 dtype_bytes: int) -> int | None:
-    """Largest row-tile TH in {16, 8} whose VMEM estimate fits the
-    admission budget and whose flattened tile is sublane-aligned.
-    None → no admissible tile (auto falls back to the conv path). At
-    Sintel eval shapes (H=55, W=128, Ccorr=324) bf16 admits th=8; f32
-    admits nothing — asserted in tests/test_motion_pallas.py."""
-    for th in _ROW_LADDER:
-        if (th * w) % 8:
-            continue
-        if vmem.fits(motion_vmem_parts(h_img, w, cc, th, dtype_bytes)):
-            return th
-    return None
+    """Largest row-tile TH in the {16, 8, 4} ladder whose VMEM estimate
+    fits the admission budget and whose flattened tile is
+    sublane-aligned (vmem.choose_rows). None → no admissible tile
+    (auto falls back to the conv path). At Sintel eval shapes (H=55,
+    W=128, Ccorr=324) bf16 admits th=16 and f32 admits th=4 — round
+    10's phase-peak accounting plus the multi-neighbor TH=4 rung;
+    before it, f32 fit no tile at all — asserted in
+    tests/test_motion_pallas.py."""
+    return vmem.choose_rows(
+        _ROW_LADDER, w,
+        lambda th: motion_vmem_parts(h_img, w, cc, th, dtype_bytes))
 
 
 def motion_eligible(h_img: int, w: int, cc: int, dtype,
@@ -491,14 +523,13 @@ def motion_encoder(flow, corr, mats, *, dtype=None,
 
     if th is None:
         if interpret:
-            # No VMEM to budget; the smallest legal tile minimizes the
-            # H padding on the tiny shapes parity tests use.
+            # No VMEM to budget; a small tile minimizes the H padding
+            # on the tiny shapes parity tests use.
             th = _HALO
         else:
-            # None → _HALO so an inadmissible forced launch fails in the
-            # preflight below with the itemized breakdown.
-            th = choose_rows(hh, ww, cc, cdt.itemsize) or _HALO
-    th = max(th, _HALO)
+            # None → the smallest rung so an inadmissible forced launch
+            # fails in the preflight below with the itemized breakdown.
+            th = choose_rows(hh, ww, cc, cdt.itemsize) or _ROW_LADDER[-1]
     if not interpret:
         vmem.preflight(
             motion_vmem_parts(hh, ww, cc, th, cdt.itemsize, widths),

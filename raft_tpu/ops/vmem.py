@@ -1,4 +1,5 @@
-"""Scoped-VMEM budget shared by the Pallas kernels (corr + GRU).
+"""Scoped-VMEM budget shared by the Pallas kernels (corr, GRU, motion,
+and the fused one-launch step kernel).
 
 A TPU core has ~16 MB of VMEM; Mosaic additionally needs headroom for
 compiler-managed temporaries (matmul operand staging, double-buffered
@@ -78,6 +79,105 @@ def preflight(parts: Mapping[str, int], where: str) -> None:
         f"Shrink the tile or shard the input instead of letting Mosaic "
         f"hit a raw scoped-VMEM OOM (BASELINE.md 'Query tile 512')."
     )
+
+
+def choose_rows(ladder, w: int, parts_fn) -> int | None:
+    """Generic row-tile admission ladder shared by the scan-body kernels.
+
+    Walks ``ladder`` (descending TH candidates) and returns the first
+    tile height that is sublane-aligned for the flattened ``(th*w, C)``
+    view (``(th * w) % 8 == 0``) and whose ``parts_fn(th)`` estimate
+    ``fits`` the admission budget; ``None`` if no rung admits (caller
+    falls back to the XLA path via ``log_fallback``).  Larger tiles
+    amortize weight-stationary reuse across more rows, so the ladder is
+    ordered biggest-first and the *first* admitted rung wins.
+    """
+    for th in ladder:
+        if (th * w) % 8:
+            continue
+        if fits(parts_fn(th)):
+            return th
+    return None
+
+
+def step_vmem_parts(h_img: int, w: int, cc: int, th: int,
+                    dtype_bytes: int, *,
+                    flow_head: bool = False,
+                    c: int = 128, cinp: int = 128,
+                    motion_widths=(256, 192, 128, 64, 126),
+                    fh_hidden: int = 256,
+                    halo_motion: int = 5, halo_gru: int = 4,
+                    halo_flow_head: int = 2) -> dict:
+    """Named VMEM estimate for the fused one-launch scan-body kernel
+    (``step_pallas``: motion encoder → SepConvGRU, optionally + flow
+    head) at row tile ``th``.
+
+    Unlike the single-kernel estimates, this models *phase-peak*
+    liveness: the chain's conv phases run sequentially over the same
+    row span, so the working set is the LARGEST single phase (its
+    input operand(s), one shifted copy, and its f32 accumulator), not
+    the sum of every intermediate — summing all of them would reject
+    every flagship shape and make the fused kernel pointless.  What
+    stays resident *across* phases (the packed ``[motion‖flow]`` x
+    part, and ``h2`` into the flow head) is charged separately in
+    ``cross_phase_residents``.
+
+    Input windows are charged per neighbor block: the combined
+    receptive field needs ``ceil(halo/th)`` neighbor blocks per side,
+    so small tiles pay for more blocks but far smaller assemblies —
+    which is why TH=4 admits Sintel bf16 while TH=8 does not.
+    """
+    d = dtype_bytes
+    c1, c2, f1, f2, co = motion_widths
+    hg = halo_gru + (halo_flow_head if flow_head else 0)
+    hm = hg + halo_motion
+    g = th * w
+    nm = -(-hm // th)                    # neighbor blocks/side, motion span
+    ng = -(-hg // th)                    # neighbor blocks/side, GRU span
+    rows_m = (th + 2 * hm) * w
+    rows_g = (th + 2 * hg) * w
+    cxm = co + 2                         # the [motion‖flow] packed x part
+    taps = 5                             # SepConv 1x5/5x1 tap count
+    weight_elems = (
+        # motion chain (matches motion_pallas.pack_weights)
+        cc * c1 + 9 * c1 * c2 + 49 * 2 * f1 + 9 * f1 * f2
+        + 9 * (c2 + f2) * co + c1 + c2 + f1 + f2 + co
+        # GRU: 2 sepconv steps x 5 taps x (c+cinp+cxm) in x 3c out + biases
+        + 2 * taps * (c + cinp + cxm) * 3 * c + 2 * 3 * c)
+    if flow_head:
+        weight_elems += 9 * c * fh_hidden + 9 * fh_hidden * 2 + fh_hidden + 2
+    # Per-row live bytes of each sequential phase (operands + shifted
+    # copy + f32 accumulator); the peak phase is motion's convc2.
+    m_phases = (
+        cc * d + 2 * d + c1 * 4,                            # convc1 (1x1)
+        2 * d + 2 * c1 * d + c2 * 4,                        # convc2 (peak)
+        2 * d + c2 * d + 2 * 2 * d + f1 * 4,                # convf1 (7x7)
+        2 * d + c2 * d + 2 * f1 * d + f2 * 4,               # convf2
+        2 * d + c2 * d + f2 * d + max(c2, f2) * d + co * 4,  # conv (cat)
+    )
+    ops_b = (c + cinp + cxm) * d
+    shift_b = max(c, cinp, cxm) * d
+    g_phases = (
+        ops_b + shift_b + 2 * c * 4,                        # zr1 / zr2
+        ops_b + 3 * c * d + shift_b + c * 4,                # q1 / q2
+    )
+    peaks = [rows_m * max(m_phases), rows_g * max(g_phases)]
+    cross = rows_g * cxm * d             # [motion‖flow] held through GRU
+    out_bytes = g * c * d
+    if flow_head:
+        peaks.append(rows_g * (2 * c * d + fh_hidden * 4))
+        cross += rows_g * c * d          # h2 held into the flow head
+        out_bytes += g * 2 * d
+    return {
+        "corr_blocks": (2 * nm + 1) * g * cc * d,
+        "flow_blocks": (2 * nm + 1) * g * 2 * d,
+        "net_blocks": (2 * ng + 1) * g * c * d,
+        "inp_blocks": (2 * ng + 1) * g * cinp * d,
+        "out_blocks": out_bytes,
+        "weights": weight_elems * d,
+        "intermediates_phase_peak": max(peaks),
+        "cross_phase_residents": cross,
+    }
 
 
 def log_fallback(flag: str, shape: str, parts: Mapping[str, int]) -> None:

@@ -122,6 +122,26 @@ def resolve_contbatch() -> str:
     return env_enum(CONTBATCH_FLAG, ("auto", "0", "1"), "auto")
 
 
+# Fused one-launch scan-body kernel (motion encoder → SepConvGRU
+# [+ flow head], ops/step_pallas.py). Read at TRACE time like the
+# per-kernel flags it subsumes: 'auto' (default) fuses on TPU where
+# the VMEM admission ladder admits the shape and otherwise falls back
+# loudly to the two-launch chain / XLA path; '0' pins the fused step
+# off (today's behavior, byte-identical); '1' forces it — interpret
+# mode off-TPU (parity tooling), and on TPU raises if no tile admits
+# instead of silently degrading a forced A/B arm.
+STEP_FLAG = "RAFT_STEP_PALLAS"
+
+
+def resolve_step_pallas() -> str:
+    """Resolved ``RAFT_STEP_PALLAS`` mode, one of ``'auto'/'0'/'1'`` —
+    the loud-parse gate for the fused scan-body kernel dispatch
+    (:mod:`raft_tpu.ops.step_pallas`); read at trace time so the choice
+    bakes into each compiled executable (the serving zero-compile
+    contract)."""
+    return env_enum(STEP_FLAG, ("auto", "0", "1"), "auto")
+
+
 @contextlib.contextmanager
 def forced_flag(name: str, value: str | None):
     """Set (or, with ``value=None``, unset) an environment flag for the

@@ -48,14 +48,19 @@ SMOKE_HONESTY_KEYS = ("smoke_operating_point", "criterion_note")
 # metrics (without an error) must ship both arms' numbers in
 # ``per_arm``. contbatch is the round-9 speedup claim; gateway is the
 # multi-process tier's hop-overhead claim (in-process fleet submit vs
-# the same load through the socket gateway).
+# the same load through the socket gateway); step is the round-10
+# one-launch refine-iteration claim (fused motion→GRU kernel vs the
+# chained two-launch path — the xla arm is informative, not required).
 CONTBATCH_METRIC = "contbatch_vs_bucketed_mixed_iters_throughput_speedup"
 CONTBATCH_ARMS = ("continuous", "bucketed")
 GATEWAY_METRIC = "gateway_vs_inprocess_p50_latency_overhead_ms"
 GATEWAY_ARMS = ("in_process", "gateway")
+STEP_METRIC = "fused_step_vs_chained_pairs_per_sec_speedup"
+STEP_ARMS = ("fused", "chained")
 AB_METRICS = {
     CONTBATCH_METRIC: ("contbatch", CONTBATCH_ARMS),
     GATEWAY_METRIC: ("gateway", GATEWAY_ARMS),
+    STEP_METRIC: ("step", STEP_ARMS),
 }
 
 

@@ -12,6 +12,11 @@ Sections:
   (``RAFT_MOTION_PALLAS`` forced on then off), with an op-group MFU
   summary splitting the scan body into motion-encoder / GRU / custom-
   call slices so the two kernels' shares are separable per arm.
+* ``step``     — the round-10 one-launch refine-iteration A/B across
+  three arms (fused single kernel / chained motion+GRU kernels / pure
+  XLA), with an op-group summary that collapses the whole scan body —
+  the fused arm's win shows up as the step_pallas slice absorbing the
+  motion_pallas + gru_pallas + update-conv slices of the chained arm.
 
 Every breakdown now carries per-op achieved TFLOP/s + MFU when the
 trace has ``flops`` stats (see ``raft_tpu/utils/profiling.py``), and a
@@ -21,6 +26,7 @@ nameable from this artifact alone, no TensorBoard round-trip.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 import time
@@ -180,6 +186,55 @@ def motion():
             _run(fwd, img, img, groups=_MOTION_GROUPS)
 
 
+# Scan-body collapse for the step A/B: the fused kernel first (its HLO
+# name carries _step_kernel), then the component kernels it subsumes,
+# then the XLA conv names of the unfused update block (first match
+# wins, so the fused arm's single custom call never double-counts).
+_STEP_GROUPS = {
+    "step_pallas": ("_step_kernel", "step_pallas"),
+    "motion_pallas": ("_motion_kernel", "motion_pallas"),
+    "gru_pallas": ("_gru_kernel", "gru_pallas"),
+    "update_convs": ("convc1", "convc2", "convf1", "convf2",
+                     "convz", "convr", "convq", "flow_head",
+                     "BasicMotionEncoder"),
+}
+
+
+def step():
+    """Round-10 tentpole A/B: per-op breakdown + scan-body op-group
+    summary of the non-small headline forward under the three step
+    dispatches — fused one-launch kernel, chained motion+GRU kernels,
+    pure XLA (the same arms as ``bench.py --step``). Flags are read at
+    trace time — each arm builds a fresh jit."""
+    from raft_tpu.config import RAFTConfig
+    from raft_tpu.models.raft import RAFT
+
+    H, W = 440, 1024
+    batch = int(os.environ.get("RAFT_PROBE_BATCH", "24"))
+    cfg = RAFTConfig(iters=12, mixed_precision=True)
+    model = RAFT(cfg)
+    rng = jax.random.PRNGKey(0)
+    img1 = jax.random.uniform(rng, (1, H, W, 3), jnp.float32) * 255.0
+    variables = model.init({"params": rng, "dropout": rng}, img1, img1,
+                           iters=1)
+    img = jnp.broadcast_to(img1, (batch, H, W, 3))
+    arms = (("fused", {"RAFT_STEP_PALLAS": "1"}),
+            ("chained", {"RAFT_STEP_PALLAS": "0",
+                         "RAFT_MOTION_PALLAS": "1",
+                         "RAFT_GRU_PALLAS": "1"}),
+            ("xla", {"RAFT_STEP_PALLAS": "0",
+                     "RAFT_MOTION_PALLAS": "0",
+                     "RAFT_GRU_PALLAS": "0"}))
+    for label, env in arms:
+        with contextlib.ExitStack() as stack:
+            for flag, val in env.items():
+                stack.enter_context(forced_flag(flag, val))
+            fwd = jax.jit(lambda a, b: model.apply(variables, a, b,
+                                                   test_mode=True)[1])
+            print(f"=== step {batch}x{H}x{W} iters=12 step={label}")
+            _run(fwd, img, img, groups=_STEP_GROUPS)
+
+
 def sparse_b8():
     """VERDICT r2 #6: sparse_train b4->b8 doubles step time with flat
     samples/s and non-monotonic peak HBM. Per-op breakdown of one train
@@ -214,4 +269,4 @@ if __name__ == "__main__":
     print("devices:", jax.devices(), flush=True)
     for n in names:
         {"msda": msda, "headline": headline, "gru": gru,
-         "motion": motion, "sparse_b8": sparse_b8}[n]()
+         "motion": motion, "step": step, "sparse_b8": sparse_b8}[n]()

@@ -37,11 +37,13 @@
    dropped before ladder exhaustion, recovery to full quality with
    hysteresis, and 0 fresh XLA compiles across the episode.
 7. pallas-kernels (``--drill pallas-kernels``) — the fused-kernel warm
-   path: a NON-small engine with ``RAFT_MOTION_PALLAS=1`` +
-   ``RAFT_GRU_PALLAS=1`` (both trace-time flags baked into the bucket
-   executables) warms up, serves a concurrent load bit-exactly, and
-   triggers ZERO post-warmup XLA compiles — proving the round-6/7
-   kernels ride the serving zero-compile contract.
+   path: a NON-small banded-correlation engine with the whole Pallas
+   chain forced (``RAFT_CORR_BACKEND=pallas`` + ``RAFT_STEP_PALLAS=1``
+   + ``RAFT_MOTION_PALLAS=1`` + ``RAFT_GRU_PALLAS=1``, all trace-time
+   flags baked into the bucket executables) warms up, serves a
+   concurrent load bit-exactly, and triggers ZERO post-warmup XLA
+   compiles — proving the round-5/6/7/10 kernels ride the serving
+   zero-compile contract.
 8. highres (``--drill highres``) — the spatially-sharded serving path
    (forces ``--xla_force_host_platform_device_count=8`` before jax
    initializes). Part A: one engine serves mixed highres+batch-1
@@ -823,28 +825,38 @@ def drill_brownout(root):
 
 
 def drill_pallas_kernels(root):
-    """RAFT_MOTION_PALLAS=1 + RAFT_GRU_PALLAS=1 engines warm up and
-    serve bit-exactly with zero post-warmup compiles (the round-7
-    acceptance probe). Non-small model — the small model's encoder/GRU
-    have no fused path — one bucket, small load: the subject is the
-    trace-time flags riding the warmup contract, not throughput."""
+    """The whole fused-kernel chain forced at once — banded correlation
+    (RAFT_CORR_BACKEND=pallas), the one-launch refine step
+    (RAFT_STEP_PALLAS=1), and the component motion/GRU kernels it
+    subsumes where it admits — warms up and serves bit-exactly with
+    zero post-warmup compiles (the round-7 acceptance probe, extended
+    round 10). Non-small model — the small model's encoder/GRU have no
+    fused path — one bucket, small load: the subject is the trace-time
+    flags riding the warmup contract, not throughput."""
     from raft_tpu.evaluate import load_predictor
     from raft_tpu.serving import (CompileWatch, ServingConfig,
                                   ServingEngine, loadgen)
     from raft_tpu.utils.envflags import forced_flag
 
     n_requests, concurrency = 12, 4
-    with forced_flag("RAFT_MOTION_PALLAS", "1"), \
+    with forced_flag("RAFT_CORR_BACKEND", "pallas"), \
+            forced_flag("RAFT_STEP_PALLAS", "1"), \
+            forced_flag("RAFT_MOTION_PALLAS", "1"), \
             forced_flag("RAFT_GRU_PALLAS", "1"):
-        predictor = load_predictor("random", iters=2)
+        predictor = load_predictor("random", iters=2,
+                                   alternate_corr=True)
+        assert predictor.step_impl == "1", predictor.step_impl
         assert predictor.motion_impl == "1", predictor.motion_impl
         assert predictor.gru_impl == "1", predictor.gru_impl
-        frames = loadgen.make_frames([(36, 60), (33, 57)], per_shape=2,
+        # (64, 96) bucket — the smallest smoke shape whose 4-level
+        # pooled pyramid keeps every level nonzero, which the banded
+        # corr kernel's VMEM-resident layout requires.
+        frames = loadgen.make_frames([(64, 96), (61, 93)], per_shape=2,
                                      seed=23)
         refs, ref_kind = _references(predictor, frames, max_batch=2)
 
         engine = ServingEngine(predictor, ServingConfig(
-            max_batch=2, max_wait_ms=3.0, buckets=((36, 60),)))
+            max_batch=2, max_wait_ms=3.0, buckets=((64, 96),)))
         warm = engine.warmup()
         engine.start(warmup=False)
         try:
@@ -856,8 +868,8 @@ def drill_pallas_kernels(root):
         finally:
             engine.close()
 
-    print(f"  {res['completed']}/{n_requests} responses with both fused "
-          f"kernels forced; reference = {ref_kind}")
+    print(f"  {res['completed']}/{n_requests} responses with the full "
+          f"fused-kernel chain forced; reference = {ref_kind}")
     warm_desc = ", ".join(f"{k}: {int(v['compiles'])}"
                           for k, v in warm.items())
     print(f"  warmup: {{bucket: compiles}} = {{{warm_desc}}}")

@@ -10,7 +10,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from check_bench_schema import (CONTBATCH_METRIC, GATEWAY_METRIC,  # noqa: E402
-                                check_file, check_payload, main)
+                                STEP_METRIC, check_file, check_payload,
+                                main)
 
 
 def test_committed_artifacts_honor_schema(capsys):
@@ -89,6 +90,32 @@ def test_checker_requires_both_gateway_arms():
         base, per_arm={"gateway": {"p50_ms": 5.8}, "in_process": 5.0}))
     assert not check_payload("err", {
         "metric": GATEWAY_METRIC, "value": None, "error": "boom"})
+
+
+def test_checker_requires_both_step_arms():
+    base = {"metric": STEP_METRIC, "value": 1.4, "unit": "x",
+            "platform": "cpu", "smoke_operating_point": True}
+    # The round-10 speedup claim needs BOTH the fused and chained
+    # measurements from the same run; the xla arm is informative only.
+    ok = dict(base, per_arm={
+        "fused": {"pairs_per_sec": 4.2,
+                  "handoff_hbm_bytes_per_iter": 0},
+        "chained": {"pairs_per_sec": 3.0,
+                    "handoff_hbm_bytes_per_iter": 32768}})
+    assert not check_payload("ok", ok)
+    assert not check_payload("ok+xla", dict(
+        ok, per_arm=dict(ok["per_arm"],
+                         xla={"pairs_per_sec": 2.5,
+                              "handoff_hbm_bytes_per_iter": None})))
+    assert check_payload("none", base)
+    assert check_payload("half", dict(
+        base, per_arm={"fused": {"pairs_per_sec": 4.2}}))
+    assert check_payload("shape", dict(
+        base, per_arm={"fused": {"pairs_per_sec": 4.2},
+                       "chained": 3.0}))
+    # An honest error record is exempt — there is no ratio to back.
+    assert not check_payload("err", {
+        "metric": STEP_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_rejects_silent_empty_wrapper(tmp_path):

@@ -175,6 +175,23 @@ def test_golden_motion_pallas(monkeypatch):
     assert results["golden_parity_epe"] < 2e-3, results
 
 
+def test_golden_step_pallas(monkeypatch):
+    """Round-10 one-launch refine iteration end-to-end (the tentpole):
+    RAFT_STEP_PALLAS=1 forces every refinement iteration through the
+    single fused motion→GRU(→flow head) Pallas kernel (interpret mode
+    on CPU; 'mgf' on non-final iterations, 'mg' + XLA heads on the
+    final mask iteration) — and must still reproduce the
+    canonical-torch goldens through the whole predictor chain."""
+    from raft_tpu.evaluate import load_predictor, validate_golden
+
+    monkeypatch.setenv("RAFT_STEP_PALLAS", "1")
+    predictor = load_predictor(
+        os.path.join(ASSETS, "golden", "weights.npz"), iters=12)
+    assert predictor.step_impl == "1"
+    results = validate_golden(predictor)
+    assert results["golden_parity_epe"] < 2e-3, results
+
+
 def test_spatial_shards_rejects_other_families():
     from raft_tpu.evaluate import load_predictor
 

@@ -13,6 +13,12 @@ tight-tolerance (measured ~1e-6 max abs at these shapes; asserted at
 bit-exact. The flow passthrough channels ARE bit-exact (pure copy).
 ``RAFT_MOTION_PALLAS=0`` restores the conv path bit-for-bit; the
 golden-fixture flag-off EPE identity lives in tests/test_golden.py.
+
+Round 10 re-modeled the VMEM estimate as phase-peak liveness (the conv
+phases run sequentially and reuse buffers, so the working set is the
+largest phase plus cross-phase residents, not the sum) — the admission
+table pinned below moved accordingly: Sintel bf16 now rides TH=16 and
+f32 honestly admits a TH=4 tile.
 """
 
 import logging
@@ -90,12 +96,14 @@ class TestForwardParity:
         np.testing.assert_allclose(got2d.reshape(B, H, W, CO + 2), want,
                                    atol=1e-5, rtol=0)
 
-    @pytest.mark.parametrize("th", [5, 8])
+    @pytest.mark.parametrize("th", [4, 5, 8])
     def test_kernel_matches_flax_f32(self, motion_setup, monkeypatch,
                                      th):
-        """Interpret-mode kernel vs flax at f32 across row tiles: th=5
-        pads H 9→10 (2 tiles, both halo directions live through the
-        3-conv receptive-field depth), th=8 pads to 16 (heavy padded-row
+        """Interpret-mode kernel vs flax at f32 across row tiles: th=4
+        (the rung f32 Sintel now rides — halo 5 > th, so each side
+        assembles ceil(5/4)=2 neighbor blocks), th=5 pads H 9→10
+        (2 tiles, both halo directions live through the 3-conv
+        receptive-field depth), th=8 pads to 16 (heavy padded-row
         masking)."""
         monkeypatch.delenv("RAFT_MOTION_PALLAS", raising=False)
         model, vs, flow, corr, mats = motion_setup
@@ -247,16 +255,22 @@ class TestEligibility:
         assert not motion_pallas.motion_eligible(0, 5, 7, jnp.float32,
                                                  True)
 
-    def test_sintel_bf16_fits_f32_does_not(self):
-        """The honest envelope at Sintel-eval feature shapes (H=55,
-        W=128, Ccorr=4*81=324): bf16 admits a th=8 tile; f32 fits no
-        tile, so auto falls back to the conv path (logged) rather than
-        OOM Mosaic."""
-        assert motion_pallas.choose_rows(55, 128, 324, 2) == 8
-        assert motion_pallas.choose_rows(55, 128, 324, 4) is None
+    def test_sintel_admission_table(self):
+        """The pinned envelope at Sintel-eval feature shapes (H=55,
+        W=128, Ccorr=4*81=324) under the round-10 phase-peak liveness
+        model: bf16 rides the TH=16 rung; f32 — which the old
+        sum-of-intermediates estimate rejected outright — honestly
+        admits TH=4 (the multi-neighbor halo assembly this round added
+        makes halo 5 > th legal). A wider f32 shape still fits no tile
+        and falls back loudly (see the fallback-log test)."""
+        assert motion_pallas.choose_rows(55, 128, 324, 2) == 16
+        assert motion_pallas.choose_rows(55, 128, 324, 4) == 4
+        assert motion_pallas.choose_rows(55, 256, 324, 4) is None
         assert motion_pallas.motion_eligible(55, 128, 324, jnp.bfloat16,
                                              False)
-        assert not motion_pallas.motion_eligible(55, 128, 324,
+        assert motion_pallas.motion_eligible(55, 128, 324,
+                                             jnp.float32, False)
+        assert not motion_pallas.motion_eligible(55, 256, 324,
                                                  jnp.float32, False)
 
     def test_preflight_raises_itemized(self):
@@ -288,13 +302,15 @@ class TestEligibility:
         the flag, shape and budget — never a silent conv fallback."""
         monkeypatch.delenv("RAFT_MOTION_PALLAS", raising=False)
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-        flow = jax.ShapeDtypeStruct((1, 55, 128, 2), jnp.float32)
-        corr = jax.ShapeDtypeStruct((1, 55, 128, 324), jnp.float32)
+        # Sintel f32 now admits a TH=4 tile (phase-peak model), so the
+        # rejection shape is a wider f32 map that genuinely overflows.
+        flow = jax.ShapeDtypeStruct((1, 55, 256, 2), jnp.float32)
+        corr = jax.ShapeDtypeStruct((1, 55, 256, 324), jnp.float32)
         with caplog.at_level(logging.WARNING, logger="raft_tpu.ops.vmem"):
             assert not motion_pallas.should_fuse(flow, corr)
         assert "RAFT_MOTION_PALLAS=auto" in caplog.text
         assert "falling back to the XLA path" in caplog.text
-        assert "H=55, W=128, Ccorr=324" in caplog.text
+        assert "H=55, W=256, Ccorr=324" in caplog.text
         assert "admission budget" in caplog.text
 
     def test_auto_fallback_is_logged_gru(self, monkeypatch, caplog):
