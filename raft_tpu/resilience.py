@@ -319,6 +319,20 @@ class FaultInjector:
       the request was accepted and served — the post-acceptance
       network fault the gateway's retry-on-next-owner contract is
       proven against.
+    * ``RAFT_FAULT_WORKER_PARTITION_S=S`` — ONE S-second network
+      partition of a serving worker, armed by the first request it
+      receives: the worker accepts connections and reads requests but
+      neither serves nor replies (blackhole) while its heartbeat keeps
+      publishing — so the lease stays routable and only the gateway's
+      per-hop stall deadline (``hop_timeout_s``) can detect it and
+      fail the request over to the next owner. The
+      alive-to-membership, dead-to-traffic failure mode.
+    * ``RAFT_FAULT_GATEWAY_STALE_POOL=N`` — the gateway's next N
+      pooled-connection checkouts hand back a socket that was just
+      shut down under the checkout probe's nose, simulating a worker
+      that died after the probe and before the write. Exercises the
+      transport's one transparent reconnect (the request must succeed
+      without burning a failover retry).
     * ``RAFT_FAULT_TARGET_PROCESS=K`` — restrict EVERY host-side fault
       above to the host with ``jax.process_index() == K`` (multi-host
       drills: exactly one simulated host fails while the others
@@ -340,6 +354,8 @@ class FaultInjector:
     worker_kill_nth: int = 0
     worker_heartbeat_stall_s: float = 0.0
     worker_socket_drop: int = 0
+    worker_partition_s: float = 0.0
+    gateway_stale_pool: int = 0
     target_process: Optional[int] = None
 
     @staticmethod
@@ -368,6 +384,10 @@ class FaultInjector:
                                "0")),
             worker_socket_drop=int(
                 os.environ.get("RAFT_FAULT_WORKER_SOCKET_DROP", "0")),
+            worker_partition_s=float(
+                os.environ.get("RAFT_FAULT_WORKER_PARTITION_S", "0")),
+            gateway_stale_pool=int(
+                os.environ.get("RAFT_FAULT_GATEWAY_STALE_POOL", "0")),
             target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
@@ -453,6 +473,32 @@ class FaultInjector:
             return True
         return False
 
+    def take_worker_partition(self) -> float:
+        """One-shot: the first call on the target process returns the
+        configured partition window in seconds (the worker blackholes
+        every request it reads for that long while its heartbeat keeps
+        the lease fresh); later calls return 0. Mirrors
+        :meth:`take_heartbeat_stall` — the two knobs are the two halves
+        of the same split-brain: stalled membership with live traffic
+        vs live membership with dead traffic."""
+        if self.worker_partition_s > 0 and self._on_target():
+            window = self.worker_partition_s
+            self.worker_partition_s = 0.0
+            return window
+        return 0.0
+
+    def maybe_stale_pool(self) -> bool:
+        """Whether the gateway transport should sabotage this pooled
+        checkout (shut the socket down after the liveness probe passed
+        it); burns one unit of the budget per True. The injected
+        staleness MUST be absorbed by the transport's transparent
+        reconnect — the drill asserts zero failover retries were
+        spent on it."""
+        if self.gateway_stale_pool > 0 and self._on_target():
+            self.gateway_stale_pool -= 1
+            return True
+        return False
+
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
@@ -468,7 +514,9 @@ class FaultInjector:
                     or self.serving_poison_nth
                     or self.worker_kill_nth
                     or self.worker_heartbeat_stall_s
-                    or self.worker_socket_drop)
+                    or self.worker_socket_drop
+                    or self.worker_partition_s
+                    or self.gateway_stale_pool)
 
 
 _ACTIVE: Optional[FaultInjector] = None

@@ -51,7 +51,19 @@ forward itself:
   lease membership over the coordination KV (file-store fallback),
   rendezvous routing over live lease-holders with the fleet's
   failover-not-timeout retry contract, and supervised respawn with
-  exponential backoff + a crash-loop breaker.
+  exponential backoff + a crash-loop breaker. The transport is
+  hardened for long-lived fleets: TCP keepalive, a bounded idle pool
+  with age eviction, and one transparent reconnect when a pooled
+  socket proves dead before any bytes are written.
+* :mod:`~raft_tpu.serving.autoscaler` — metrics-driven capacity: a
+  clock-injectable control loop reads the gateway's registry gauges
+  (queue depth, slot occupancy, SLO violation ratio) and converges
+  the fleet between ``min_workers``/``max_workers`` with two-watermark
+  hysteresis, dwell and directional cooldowns. Scale-up spawns through
+  the supervisor (unroutable until the lease proves warmup, brownout
+  covering the gap); scale-down drains the least-loaded worker
+  gracefully (finish in-flight, remove lease, exit 0 — a departure,
+  not a crash).
 * :mod:`~raft_tpu.serving.session` — stateful streaming sessions
   (``open_stream``): warm-start ``flow_init`` from the previous pair's
   flow at reduced ``warm_iters``, plus encoder feature-map reuse (one
@@ -60,6 +72,7 @@ forward itself:
   (:class:`~raft_tpu.serving.fleet.FleetStreamSession`).
 """
 
+from raft_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
 from raft_tpu.serving.batcher import (PRIORITIES, PRIORITY_HIGH,
                                       PRIORITY_LOW, BacklogFull,
                                       QueuedRequest, RequestTimedOut,
@@ -94,6 +107,8 @@ from raft_tpu.serving.worker import (WorkerConfig, WorkerServer,
                                      spawn_worker)
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
     "BacklogFull",
     "BrownoutController",
     "BucketRouter",

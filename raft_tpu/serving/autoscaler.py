@@ -1,0 +1,444 @@
+"""Metrics-driven fleet autoscaling: converge worker count to load.
+
+The capacity half of the overload story. Brownout
+(:mod:`~raft_tpu.serving.brownout`) degrades *quality* within seconds
+of a pressure spike; this module changes *capacity* on the tens-of-
+seconds scale a worker warmup takes — the standard pairing in serving
+systems (degrade now, scale for later). The control loop is
+deliberately the same shape as :class:`~raft_tpu.serving.brownout
+.BrownoutController`'s: two watermarks for hysteresis, a per-decision
+dwell so one decision's effect is observed before the next, and it
+never sleeps — ``poll_once`` is driven on a cadence (or by a fake
+clock in tests).
+
+**Signals.** The controller reads the gateway's PR-14 registry gauges
+by name, not gateway internals — any registry exposing the same
+surface drives it:
+
+* ``gateway_queue_depth`` — requests parked at the gateway waiting
+  for a dispatcher;
+* ``gateway_fleet_occupancy`` — mean per-routable-worker engine load
+  (queue depth + in-flight batches, as heartbeat leases report it);
+* ``gateway_workers_live`` — current routable worker count;
+* ``slo_violation_ratio`` — rolling fraction of completions over
+  their class objective (max across classes); a fleet can look idle
+  by queue depth and still be missing its SLO.
+
+Per-worker *pressure* is ``queue_depth / routable + occupancy``; at or
+above ``high_water`` (or with the SLO violation ratio at or above
+``slo_high_water``) the controller wants capacity, at or below
+``low_water`` (with the SLO healthy) it wants to give some back, and
+the band between is hysteresis — no decision, no flapping.
+
+**Actuation.** Scale-up mints a fresh :class:`~raft_tpu.serving
+.supervisor.WorkerSpec` via ``spec_factory`` and pushes it through
+:meth:`~raft_tpu.serving.supervisor.WorkerSupervisor.add_worker`. The
+new worker is NOT routable until its own lease proves warmup — the
+gateway's membership gate, not the autoscaler, decides when it serves;
+brownout remains the fast-path valve while capacity warms. Scale-down
+picks the least-loaded routable worker (by the lease's ``load``
+figure, worker id as tiebreak), marks it with
+:meth:`~raft_tpu.serving.supervisor.WorkerSupervisor.expect_drain`
+(its exit 0 must read as a departure, not a crash), and sends the
+:data:`~raft_tpu.serving.netproto.OP_DRAIN` directive: the worker
+finishes in-flight work, removes its lease, exits 0. Directional
+cooldowns (``scale_up_cooldown_s`` / ``scale_down_cooldown_s``) pace
+the loop asymmetrically — growing is cheap and urgent, shrinking is
+neither; any change re-arms the (longer) down cooldown so capacity
+added under burst is not drained back the moment the queue dips.
+
+Decisions land as registry gauges (``autoscaler_target_workers``,
+``autoscaler_scale_ups`` / ``_scale_downs`` / ``_drains``) and tracer
+instants, so a capacity change is attributable on the same dashboard
+and trace timeline as the latency it answers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from raft_tpu.observability import tracer as tracing
+from raft_tpu.serving import netproto
+from raft_tpu.serving.health import is_routable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Knobs for one :class:`Autoscaler`.
+
+    Attributes:
+      min_workers / max_workers: hard fleet-size clamps. The
+        controller never drains below ``min_workers`` and never spawns
+        above ``max_workers``, whatever the signals say.
+      high_water: per-worker pressure (gateway queue depth per
+        routable worker plus mean engine occupancy) at or above which
+        the controller wants one more worker.
+      low_water: pressure at or below which it wants one fewer. Must
+        sit strictly below ``high_water`` — the gap is the hysteresis
+        band where no decision fires.
+      slo_high_water: SLO violation ratio (max across classes) that
+        forces scale-up pressure regardless of queue depth, and vetoes
+        scale-down while elevated.
+      dwell_s: minimum seconds between ANY two decisions — each
+        decision's effect must be observable before the next.
+      scale_up_cooldown_s: minimum seconds between scale-ups (one
+        warmup at a time, not a spawn storm).
+      scale_down_cooldown_s: minimum seconds after the LAST fleet
+        change (either direction) before a scale-down may fire —
+        deliberately the longer of the two, so burst capacity is not
+        returned the moment the queue dips.
+      drain_timeout_s: transport budget for delivering one drain
+        directive.
+      lease_ttl_s: heartbeat freshness bound used when picking a
+        drain victim from the lease store.
+      poll_interval_s: cadence of the background loop started by
+        :meth:`Autoscaler.start`.
+    """
+
+    min_workers: int = 1
+    max_workers: int = 4
+    high_water: float = 8.0
+    low_water: float = 1.0
+    slo_high_water: float = 0.05
+    dwell_s: float = 5.0
+    scale_up_cooldown_s: float = 10.0
+    scale_down_cooldown_s: float = 60.0
+    drain_timeout_s: float = 5.0
+    lease_ttl_s: float = 2.0
+    poll_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.min_workers < 0:
+            raise ValueError(
+                f"min_workers must be >= 0, got {self.min_workers}")
+        if self.max_workers < max(self.min_workers, 1):
+            raise ValueError(
+                f"max_workers ({self.max_workers}) must be >= "
+                f"max(min_workers, 1) ({max(self.min_workers, 1)})")
+        if self.low_water >= self.high_water:
+            raise ValueError(
+                f"low_water ({self.low_water}) must sit strictly "
+                f"below high_water ({self.high_water}) — the gap is "
+                "the hysteresis band")
+
+
+class Autoscaler:
+    """The clock-injectable capacity control loop.
+
+    Args:
+      supervisor: the :class:`~raft_tpu.serving.supervisor
+        .WorkerSupervisor` holding the fleet (``add_worker`` /
+        ``expect_drain`` / ``managed_count``).
+      lease_store: the membership plane, for drain-victim selection
+        (routable fresh leases and their ``load`` figures).
+      registry: the gateway's :class:`~raft_tpu.observability.registry
+        .MetricsRegistry` — signals are read from its gauges by name,
+        and the autoscaler's own gauges land on it.
+      spec_factory: zero-arg callable minting a fresh
+        :class:`~raft_tpu.serving.supervisor.WorkerSpec` (unique
+        worker id included) per scale-up.
+      config: :class:`AutoscalerConfig`.
+      transport: request/reply transport for the drain directive
+        (anything with ``SocketTransport.request``'s signature);
+        default constructs a
+        :class:`~raft_tpu.serving.gateway.SocketTransport`.
+      clock / wall: injectable monotonic/epoch clocks — every decision
+        time (dwell, cooldowns) is absolute, ``poll_once`` never
+        sleeps, and the whole unit suite drives a fake clock.
+    """
+
+    def __init__(self, supervisor, lease_store, registry,
+                 spec_factory: Callable[[], object],
+                 config: Optional[AutoscalerConfig] = None,
+                 transport=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.supervisor = supervisor
+        self.store = lease_store
+        self.registry = registry
+        self.spec_factory = spec_factory
+        self.config = config or AutoscalerConfig()
+        if transport is None:
+            from raft_tpu.serving.gateway import SocketTransport
+            transport = SocketTransport(clock=clock)
+        self.transport = transport
+        self._clock = clock
+        self._wall = wall
+        self._tracer = tracing.current()
+        self._lock = threading.Lock()
+        self._target: Optional[int] = None      # set on first poll
+        self._last_decision_at: Optional[float] = None
+        self._last_up_at: Optional[float] = None
+        self._last_change_at: Optional[float] = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.drains = 0             # drain directives delivered (acked)
+        self.decisions: list = []   # (t, action, detail) audit trail
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._attach_registry()
+
+    # -- signals ---------------------------------------------------------
+
+    def _read_gauge(self, name: str, agg=max) -> float:
+        """Read one registry gauge by name; labeled gauges reduce with
+        ``agg`` over their series. Missing instrument or a collect
+        error reads 0.0 — a torn metrics plane must stall the
+        controller at 'no evidence', never crash it."""
+        inst = self.registry.instruments().get(name)
+        if inst is None:
+            return 0.0
+        try:
+            values = inst.collect()
+        except Exception:
+            return 0.0
+        if not values:
+            return 0.0
+        return float(agg(values.values()))
+
+    def signals(self) -> Dict[str, float]:
+        """The controller's current inputs, one coherent read."""
+        queue_depth = self._read_gauge("gateway_queue_depth")
+        occupancy = self._read_gauge("gateway_fleet_occupancy")
+        routable = self._read_gauge("gateway_workers_live")
+        slo_ratio = self._read_gauge("slo_violation_ratio", agg=max)
+        pressure = queue_depth / max(routable, 1.0) + occupancy
+        return {"queue_depth": queue_depth,
+                "occupancy": occupancy,
+                "routable": routable,
+                "slo_violation_ratio": slo_ratio,
+                "pressure": pressure}
+
+    # -- the control loop ------------------------------------------------
+
+    @property
+    def target_workers(self) -> int:
+        with self._lock:
+            if self._target is not None:
+                return self._target
+        return self._clamp(self.supervisor.managed_count())
+
+    def _clamp(self, n: int) -> int:
+        return max(self.config.min_workers,
+                   min(self.config.max_workers, int(n)))
+
+    def poll_once(self) -> str:
+        """One control decision; returns the action taken:
+        ``hold`` (inside the hysteresis band), ``dwell`` (a decision
+        wanted but the dwell hasn't elapsed), ``cooldown`` (direction
+        cooldown still arming), ``at-max`` / ``at-min`` (clamped),
+        ``scale-up``, ``scale-down``, ``no-victim`` (wanted to drain
+        but no routable managed worker qualified), ``drain-failed``
+        (the directive never reached its worker; no state changed).
+        Never sleeps; at most ONE step of fleet change per call."""
+        now = self._clock()
+        cfg = self.config
+        with self._lock:
+            if self._target is None:
+                self._target = self._clamp(
+                    self.supervisor.managed_count())
+            target = self._target
+        sig = self.signals()
+        slo_hot = sig["slo_violation_ratio"] >= cfg.slo_high_water
+        want_up = sig["pressure"] >= cfg.high_water or slo_hot
+        want_down = (not slo_hot
+                     and sig["pressure"] <= cfg.low_water)
+        if not want_up and not want_down:
+            return self._done("hold", sig)
+        if (self._last_decision_at is not None
+                and now - self._last_decision_at < cfg.dwell_s):
+            return self._done("dwell", sig)
+        if want_up:
+            if target >= cfg.max_workers:
+                return self._done("at-max", sig)
+            if (self._last_up_at is not None
+                    and now - self._last_up_at
+                    < cfg.scale_up_cooldown_s):
+                return self._done("cooldown", sig)
+            return self._scale_up(now, sig)
+        # want_down
+        if target <= cfg.min_workers:
+            return self._done("at-min", sig)
+        if (self._last_change_at is not None
+                and now - self._last_change_at
+                < cfg.scale_down_cooldown_s):
+            return self._done("cooldown", sig)
+        return self._scale_down(now, sig)
+
+    def _scale_up(self, now: float, sig: Dict[str, float]) -> str:
+        spec = self.spec_factory()
+        self.supervisor.add_worker(spec)
+        with self._lock:
+            self._target += 1
+            self.scale_ups += 1
+            self._last_decision_at = now
+            self._last_up_at = now
+            self._last_change_at = now
+        logger.info(
+            "scale-up -> target %d (pressure %.2f, slo %.3f): "
+            "spawned %s (unroutable until its lease proves warmup)",
+            self._target, sig["pressure"], sig["slo_violation_ratio"],
+            spec.worker_id)
+        return self._done("scale-up", sig,
+                          {"worker": spec.worker_id})
+
+    def _drain_victim(self):
+        """The least-loaded routable, supervisor-managed,
+        not-already-draining worker — ``(worker_id, lease)`` or
+        ``None``. Load is the lease's self-reported engine pressure;
+        ties break on worker id so the choice is deterministic."""
+        status = self.supervisor.status()
+        managed = {wid for wid, st in status.items()
+                   if not st.get("draining")}
+        now = self._wall()
+        candidates = []
+        for wid, lease in self.store.read_all().items():
+            if wid not in managed:
+                continue
+            if not lease.fresh(self.config.lease_ttl_s, now):
+                continue
+            if not is_routable(lease.state):
+                continue
+            load = float(lease.extra.get("load", 0.0))
+            candidates.append((load, wid, lease))
+        if not candidates:
+            return None
+        load, wid, lease = min(candidates, key=lambda c: (c[0], c[1]))
+        return wid, lease
+
+    def _scale_down(self, now: float, sig: Dict[str, float]) -> str:
+        victim = self._drain_victim()
+        if victim is None:
+            return self._done("no-victim", sig)
+        wid, lease = victim
+        # Mark BEFORE sending: the worker may ack and exit faster than
+        # the supervisor's next poll — its exit 0 must already read as
+        # a departure. A failed send un-marks.
+        self.supervisor.expect_drain(wid)
+        try:
+            deadline = self._clock() + self.config.drain_timeout_s
+            reply = self.transport.request(
+                tuple(lease.addr),
+                netproto.drain_header(reason="autoscaler scale-down"),
+                deadline=deadline, clock=self._clock)
+        except Exception as e:
+            self.supervisor.cancel_drain(wid)
+            logger.warning("drain directive to %s failed: %s", wid, e)
+            return self._done("drain-failed", sig, {"worker": wid})
+        hdr = reply[0] if isinstance(reply, tuple) else reply
+        if not (isinstance(hdr, dict) and hdr.get("draining")):
+            self.supervisor.cancel_drain(wid)
+            logger.warning("drain directive to %s not acknowledged: "
+                           "%r", wid, hdr)
+            return self._done("drain-failed", sig, {"worker": wid})
+        with self._lock:
+            self._target -= 1
+            self.scale_downs += 1
+            self.drains += 1
+            self._last_decision_at = now
+            self._last_change_at = now
+        logger.info(
+            "scale-down -> target %d (pressure %.2f): draining %s "
+            "(load %.1f)", self._target, sig["pressure"], wid,
+            float(lease.extra.get("load", 0.0)))
+        return self._done("scale-down", sig, {"worker": wid})
+
+    def _done(self, action: str, sig: Dict[str, float],
+              extra: Optional[dict] = None) -> str:
+        now = self._clock()
+        self.decisions.append((now, action, dict(sig)))
+        if len(self.decisions) > 1000:
+            del self.decisions[:-1000]
+        if action in ("scale-up", "scale-down", "drain-failed"):
+            tr = self._tracer
+            if tr is not None:
+                args = {"target": self.target_workers, **sig}
+                if extra:
+                    args.update(extra)
+                # Zero-duration complete slice = an instant marker on
+                # the control-plane track, next to the request spans.
+                tr.complete(f"autoscaler_{action.replace('-', '_')}",
+                            0.0, args=args)
+        return action
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        """Run :meth:`poll_once` on ``poll_interval_s`` in a
+        background thread (daemon; :meth:`close` stops it)."""
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+
+        def loop():
+            while not self._stop.wait(self.config.poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("autoscaler poll failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if hasattr(self.transport, "close"):
+            self.transport.close()
+
+    def __enter__(self) -> "Autoscaler":
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"target_workers": (self._target
+                                       if self._target is not None
+                                       else self._clamp(
+                                           self.supervisor
+                                           .managed_count())),
+                    "scale_ups": self.scale_ups,
+                    "scale_downs": self.scale_downs,
+                    "drains": self.drains,
+                    "decisions": len(self.decisions)}
+
+    def _attach_registry(self) -> None:
+        def _scalar(read):
+            def fn():
+                try:
+                    return float(read())
+                except Exception:
+                    return 0.0
+            return fn
+
+        self.registry.gauge(
+            "autoscaler_target_workers",
+            help="the control loop's current fleet-size target",
+            fn=_scalar(lambda: self.target_workers))
+        self.registry.gauge(
+            "autoscaler_scale_ups",
+            help="scale-up decisions taken (workers spawned)",
+            fn=_scalar(lambda: self.scale_ups))
+        self.registry.gauge(
+            "autoscaler_scale_downs",
+            help="scale-down decisions taken",
+            fn=_scalar(lambda: self.scale_downs))
+        self.registry.gauge(
+            "autoscaler_drains",
+            help="drain directives delivered and acknowledged",
+            fn=_scalar(lambda: self.drains))

@@ -52,6 +52,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import queue as queue_mod
+import select
 import socket
 import threading
 import time
@@ -60,7 +61,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu import resilience
 from raft_tpu.observability import registry as obs_registry
+from raft_tpu.observability import slo as slo_mod
 from raft_tpu.observability import tracer as tracing
 from raft_tpu.serving import health as health_mod
 from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
@@ -88,29 +91,174 @@ class SocketTransport:
     timeouts are derived from the request's remaining deadline, so a
     hung worker surfaces as ``RequestTimedOut`` when the budget is
     spent rather than hanging a dispatcher thread forever.
+
+    Hardening beyond the local-socket happy path:
+
+    * **Keepalive** — every fresh connection gets ``SO_KEEPALIVE``
+      (plus ``TCP_KEEPIDLE``/``TCP_KEEPINTVL``/``TCP_KEEPCNT`` where
+      the platform exposes them), so a silently-vanished peer (host
+      death, mid-path partition) is eventually torn down by the kernel
+      instead of idling in the pool forever.
+    * **Bounded pool with idle-age eviction** — at most
+      ``max_idle_per_addr`` idle sockets per address; a socket idle
+      longer than ``max_idle_age_s`` is closed at the next
+      checkout/checkin touch, not handed to a request (a restarted
+      worker's stale socket used to burn a failover retry).
+    * **Checkout liveness probe + one transparent reconnect** — a
+      pooled socket that is readable while supposedly idle carries an
+      EOF (or stray bytes) and is discarded at checkout; if a pooled
+      socket still proves dead at write time — before any reply bytes
+      — the exchange retries ONCE on a guaranteed-fresh connection,
+      burning no failover hop. Replies are never retried this way:
+      once bytes may have reached the worker's application layer the
+      failover contract (idempotent resubmit on the next owner) is
+      the only safe retry.
+    * **Per-hop stall deadline** — ``hop_timeout_s`` caps how long one
+      exchange may sit on a single worker. A stall past it with
+      request budget remaining raises :class:`WorkerConnectionError`
+      (a retryable hop failure — the partitioned-worker case, where
+      the lease looks healthy but traffic blackholes); only an
+      exhausted overall deadline raises ``RequestTimedOut`` (never
+      retried). Default ``None`` keeps the old behavior: the only
+      timeout is the request deadline itself.
+
+    ``clock`` is injectable so idle-age eviction is testable without
+    sleeping.
     """
 
-    def __init__(self, connect_timeout_s: float = 2.0):
+    def __init__(self, connect_timeout_s: float = 2.0,
+                 max_idle_per_addr: int = 8,
+                 max_idle_age_s: float = 30.0,
+                 hop_timeout_s: Optional[float] = None,
+                 keepalive_idle_s: int = 15,
+                 clock=time.monotonic):
+        if max_idle_per_addr < 0:
+            raise ValueError("max_idle_per_addr must be >= 0, got "
+                             f"{max_idle_per_addr}")
         self.connect_timeout_s = connect_timeout_s
+        self.max_idle_per_addr = max_idle_per_addr
+        self.max_idle_age_s = max_idle_age_s
+        self.hop_timeout_s = hop_timeout_s
+        self.keepalive_idle_s = keepalive_idle_s
+        self._clock = clock
         self._lock = threading.Lock()
-        self._idle: Dict[Tuple[str, int], List[socket.socket]] = {}
+        # addr -> [(sock, t_checkin)], newest last (LIFO checkout keeps
+        # the warmest socket busiest and lets the oldest age out).
+        self._idle: Dict[Tuple[str, int],
+                         List[Tuple[socket.socket, float]]] = {}
+        self.reconnects = 0         # transparent write-retry successes
+        self.dead_checkouts = 0     # pooled socks the probe discarded
+        self.evicted_idle = 0       # pooled socks aged/bounded out
 
-    def _checkout(self, addr: Tuple[str, int]) -> socket.socket:
-        with self._lock:
-            pool = self._idle.get(addr)
-            if pool:
-                return pool.pop()
+    @staticmethod
+    def _close_quietly(sock: socket.socket) -> None:
         try:
-            return socket.create_connection(
+            sock.close()
+        except OSError:
+            pass
+
+    def _new_conn(self, addr: Tuple[str, int]) -> socket.socket:
+        try:
+            sock = socket.create_connection(
                 addr, timeout=self.connect_timeout_s)
         except OSError as e:
             raise WorkerConnectionError(
                 f"connect to {addr} failed: {e}") from e
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            for opt, val in (("TCP_KEEPIDLE", self.keepalive_idle_s),
+                             ("TCP_KEEPINTVL", self.keepalive_idle_s),
+                             ("TCP_KEEPCNT", 3)):
+                if hasattr(socket, opt):    # Linux; absent on some OSes
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    getattr(socket, opt), val)
+        except OSError:
+            pass                    # keepalive is best-effort hardening
+        return sock
+
+    @staticmethod
+    def _probe_dead(sock: socket.socket) -> bool:
+        """An IDLE pooled socket must have nothing to read; readable
+        means EOF (peer closed/reset) or protocol garbage — dead either
+        way."""
+        try:
+            readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            return True
+        return bool(readable)
+
+    def _checkout(self, addr: Tuple[str, int]
+                  ) -> Tuple[socket.socket, bool]:
+        """Returns ``(sock, pooled)`` — ``pooled`` marks a reused
+        connection, the only kind eligible for the transparent
+        write-retry."""
+        now = self._clock()
+        while True:
+            with self._lock:
+                pool = self._idle.get(addr)
+                if not pool:
+                    break
+                sock, t_in = pool.pop()
+            if (self.max_idle_age_s is not None
+                    and now - t_in > self.max_idle_age_s):
+                self.evicted_idle += 1
+                self._close_quietly(sock)
+                continue
+            if self._probe_dead(sock):
+                self.dead_checkouts += 1
+                self._close_quietly(sock)
+                continue
+            inj = resilience.active_injector()
+            if inj.active and inj.maybe_stale_pool():
+                # Injected race: the peer dies between the probe and
+                # the write. The transparent reconnect must absorb it.
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            return sock, True
+        return self._new_conn(addr), False
 
     def _checkin(self, addr: Tuple[str, int],
                  sock: socket.socket) -> None:
+        now = self._clock()
+        evicted: List[socket.socket] = []
         with self._lock:
-            self._idle.setdefault(addr, []).append(sock)
+            pool = self._idle.setdefault(addr, [])
+            pool.append((sock, now))
+            # Age out from the oldest end, then enforce the bound.
+            while pool and (self.max_idle_age_s is not None
+                            and now - pool[0][1] > self.max_idle_age_s):
+                evicted.append(pool.pop(0)[0])
+            while len(pool) > self.max_idle_per_addr:
+                evicted.append(pool.pop(0)[0])
+        for s in evicted:
+            self.evicted_idle += 1
+            self._close_quietly(s)
+
+    def _hop_timeout(self, addr, deadline, clock) -> Optional[float]:
+        if deadline is not None:
+            remaining = deadline - clock()
+            if remaining <= 0:
+                raise RequestTimedOut(
+                    f"deadline expired before dispatch to {addr}")
+            return (remaining if self.hop_timeout_s is None
+                    else min(remaining, self.hop_timeout_s))
+        return self.hop_timeout_s
+
+    def _raise_stall(self, addr, deadline, clock, cause):
+        """A socket timeout fired: decide which contract it falls
+        under. Budget exhausted -> ``RequestTimedOut`` (never retried);
+        budget remaining -> the per-hop stall deadline tripped first,
+        a retryable hop failure (``WorkerConnectionError``) so a
+        partitioned worker loses the request to failover instead of
+        eating the whole client budget."""
+        if deadline is not None and clock() >= deadline:
+            raise RequestTimedOut(
+                f"deadline expired in flight to {addr}") from cause
+        raise WorkerConnectionError(
+            f"worker {addr} stalled past hop_timeout_s="
+            f"{self.hop_timeout_s}; failing the hop over") from cause
 
     def request(self, addr: Tuple[str, int], header: dict,
                 body: bytes = b"",
@@ -120,54 +268,73 @@ class SocketTransport:
         deadline expires mid-exchange (the reply, if it ever comes, is
         already too late — the connection is discarded so a late reply
         can never be mis-paired with a future request), and
-        :class:`WorkerConnectionError` on any connection-level death."""
-        sock = self._checkout(addr)
+        :class:`WorkerConnectionError` on any connection-level death
+        (including a per-hop ``hop_timeout_s`` stall with request
+        budget still remaining)."""
+        sock, pooled = self._checkout(addr)
+        while True:             # at most two passes: pooled, then fresh
+            try:
+                sock.settimeout(self._hop_timeout(addr, deadline, clock))
+                write_message(sock, header, body)
+                break
+            except socket.timeout as e:
+                self._close_quietly(sock)
+                self._raise_stall(addr, deadline, clock, e)
+            except (ProtocolError, OSError) as e:
+                self._close_quietly(sock)
+                if pooled:
+                    # The pooled socket proved dead before any reply
+                    # bytes existed: one transparent reconnect on a
+                    # guaranteed-fresh connection, no failover burned.
+                    pooled = False
+                    self.reconnects += 1
+                    sock = self._new_conn(addr)
+                    continue
+                raise WorkerConnectionError(
+                    f"worker {addr} connection failed: {e}") from e
+            except BaseException:
+                self._close_quietly(sock)
+                raise
         try:
-            if deadline is not None:
-                remaining = deadline - clock()
-                if remaining <= 0:
-                    raise RequestTimedOut(
-                        f"deadline expired before dispatch to {addr}")
-                sock.settimeout(remaining)
-            else:
-                sock.settimeout(None)
-            write_message(sock, header, body)
             reply = read_message(sock)
             if reply is None:
                 raise WorkerConnectionError(
                     f"worker {addr} closed the connection mid-request")
         except socket.timeout as e:
-            try:
-                sock.close()
-            except OSError:
-                pass
-            raise RequestTimedOut(
-                f"deadline expired in flight to {addr}") from e
+            self._close_quietly(sock)
+            self._raise_stall(addr, deadline, clock, e)
         except (ProtocolError, OSError) as e:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._close_quietly(sock)
             raise WorkerConnectionError(
                 f"worker {addr} connection failed: {e}") from e
         except BaseException:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._close_quietly(sock)
             raise
         self._checkin(addr, sock)
         return reply
 
+    def idle_count(self, addr: Optional[Tuple[str, int]] = None) -> int:
+        with self._lock:
+            if addr is not None:
+                return len(self._idle.get(addr, ()))
+            return sum(len(p) for p in self._idle.values())
+
+    def close_addr(self, addr: Tuple[str, int]) -> None:
+        """Drop every idle connection pooled for one address — called
+        when its worker leaves the membership, so pools for departed
+        addresses don't accumulate dead sockets."""
+        with self._lock:
+            pool = self._idle.pop(addr, [])
+        for sock, _ in pool:
+            self._close_quietly(sock)
+
     def close(self) -> None:
         with self._lock:
-            socks = [s for pool in self._idle.values() for s in pool]
+            socks = [s for pool in self._idle.values()
+                     for s, _ in pool]
             self._idle.clear()
         for s in socks:
-            try:
-                s.close()
-            except OSError:
-                pass
+            self._close_quietly(s)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +358,18 @@ class GatewayConfig:
       connect_timeout_s: TCP connect budget per hop.
       expected_step: when set, only workers whose lease reports this
         checkpoint step are routable (cross-process weight-sync gate).
+      hop_timeout_s: per-hop stall deadline on the transport — a
+        single worker may hold one exchange at most this long; a
+        stall with request budget remaining fails over instead of
+        timing the request out (the partitioned-worker defense).
+        ``None`` = only the request deadline bounds a hop.
+      pool_max_idle_per_addr / pool_max_idle_age_s: idle-connection
+        pool bound and age cutoff per worker address.
+      slo_ms: per-priority-class latency objectives in ms (e.g.
+        ``{"high": 250.0, "low": 1000.0}``); when set the gateway
+        grades every response's client-observed latency on an
+        :class:`~raft_tpu.observability.slo.SloTracker` attached to
+        its registry — the violation-ratio gauge the autoscaler reads.
     """
 
     pad_mode: str = "sintel"
@@ -201,6 +380,10 @@ class GatewayConfig:
     dispatch_threads: int = 8
     connect_timeout_s: float = 2.0
     expected_step: Optional[int] = None
+    hop_timeout_s: Optional[float] = None
+    pool_max_idle_per_addr: int = 8
+    pool_max_idle_age_s: float = 30.0
+    slo_ms: Optional[Tuple[Tuple[str, float], ...]] = None
 
 
 class GatewayMetrics:
@@ -305,9 +488,15 @@ class ServingGateway:
         self.store = lease_store
         self.config = config or GatewayConfig()
         self.transport = transport or SocketTransport(
-            self.config.connect_timeout_s)
+            self.config.connect_timeout_s,
+            max_idle_per_addr=self.config.pool_max_idle_per_addr,
+            max_idle_age_s=self.config.pool_max_idle_age_s,
+            hop_timeout_s=self.config.hop_timeout_s,
+            clock=clock)
         self.metrics = GatewayMetrics()
         self.registry = registry or obs_registry.MetricsRegistry()
+        self.slo = (slo_mod.SloTracker(dict(self.config.slo_ms))
+                    if self.config.slo_ms else None)
         self._clock = clock
         self._wall = wall
         self._tracer = tracing.current()
@@ -385,6 +574,8 @@ class ServingGateway:
             if is_routable(state) and in_sync:
                 live.add(wid)
         with self._member_lock:
+            prev_addrs = {tuple(lease.addr)
+                          for lease in self._leases.values()}
             self._leases = leases
             for wid in list(self.router.replica_ids):
                 if wid not in live:
@@ -392,6 +583,13 @@ class ServingGateway:
             for wid in sorted(live):
                 self.router.add_replica(wid)
             self._live = live
+        # A departed worker's pooled sockets are dead weight (and a
+        # new worker may even reuse the port): drop its idle pool.
+        departed = prev_addrs - {tuple(lease.addr)
+                                 for lease in leases.values()}
+        if departed and hasattr(self.transport, "close_addr"):
+            for addr in departed:
+                self.transport.close_addr(addr)
         return states
 
     def live_workers(self) -> List[str]:
@@ -595,8 +793,15 @@ class ServingGateway:
                     rbody, dtype=rhdr.get("dtype", "float32")
                 ).reshape(shape)
                 worker = rhdr.get("worker", wid)
-                self.metrics.record_response(
-                    worker, self._clock() - req.t_submit)
+                latency = self._clock() - req.t_submit
+                self.metrics.record_response(worker, latency)
+                if self.slo is not None:
+                    try:
+                        self.slo.observe(
+                            req.header.get("priority", PRIORITY_HIGH),
+                            latency)
+                    except KeyError:
+                        pass        # class without an objective
                 req.future.replica_id = worker
                 req.future.set_result(flow)
                 return
@@ -647,6 +852,26 @@ class ServingGateway:
         self.registry.gauge(
             "gateway_timeouts", help="RequestTimedOut resolutions",
             fn=_scalar(lambda: m.timeouts))
+        self.registry.gauge(
+            "gateway_queue_depth",
+            help="requests waiting at the gateway for a dispatcher",
+            fn=_scalar(self._queue.qsize))
+
+        def _occupancy():
+            with self._member_lock:
+                loads = [float(lease.extra.get("load", 0.0))
+                         for wid, lease in self._leases.items()
+                         if wid in self._live]
+            return (sum(loads) / len(loads)) if loads else 0.0
+
+        self.registry.gauge(
+            "gateway_fleet_occupancy",
+            help="mean per-routable-worker load (engine queue depth + "
+                 "in-flight batches, as heartbeat leases report it) — "
+                 "the autoscaler's slot-occupancy signal",
+            fn=_scalar(_occupancy))
+        if self.slo is not None:
+            self.slo.attach_registry(self.registry)
 
         def _liveness():
             states = self.worker_states()

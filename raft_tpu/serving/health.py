@@ -27,6 +27,9 @@ a first-class answer instead of "it hasn't crashed yet":
     consistently, submits fail fast with :class:`EngineUnhealthy`.
     Route elsewhere.
   - ``CLOSED``   — the engine was shut down (terminal).
+  - ``DRAINING`` — (worker-process tier) a directed decommission in
+    progress: finish in-flight work, remove the lease, exit 0. Not
+    routable, not a fault.
 
 * **:class:`CircuitBreaker`** — the classic three-state breaker
   (Nygard, *Release It!*; the same shape Clipper puts in front of
@@ -62,12 +65,19 @@ CLOSED = "closed"
 # thread, a stalled host) but the replica is unproven — not routable,
 # and the supervisor treats it like a death (kill + respawn).
 STALE = "stale"
+# Worker-process lifecycle state: the worker received a drain directive
+# (autoscaler scale-down, operator decommission) and is finishing its
+# in-flight work before removing its lease and exiting 0. Carried on
+# the heartbeat lease so the gateway stops routing the moment the drain
+# starts; deliberately NOT routable and NOT a fault — the supervisor
+# treats the subsequent exit-0 as a directed departure, never a crash.
+DRAINING = "draining"
 
 # Numeric encoding for the scalar stream (TrainLogger/JSONL want
 # floats): ordered roughly by "how routable is this replica".
 # BROWNOUT got the next free code (6) rather than a re-numbering —
 # the existing codes are pinned by dashboards and golden tests; STALE
-# follows the same append-only rule (7).
+# (7) and DRAINING (8) follow the same append-only rule.
 HEALTH_CODES: Dict[str, int] = {
     STARTING: 0,
     WARMING: 1,
@@ -77,6 +87,7 @@ HEALTH_CODES: Dict[str, int] = {
     CLOSED: 5,
     BROWNOUT: 6,
     STALE: 7,
+    DRAINING: 8,
 }
 
 # The states a load balancer may send traffic to. DEGRADED is

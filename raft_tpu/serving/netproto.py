@@ -59,6 +59,24 @@ MAX_HEADER_BYTES = 1 << 20
 MAX_BODY_BYTES = 1 << 31
 
 
+# Frame operations a worker understands (the ``op`` header field).
+# ``drain`` is the directed-decommission directive: the worker
+# acknowledges immediately, republishes its lease as DRAINING (the
+# gateway stops routing), finishes in-flight work, removes the lease,
+# and exits 0 — the autoscaler's graceful scale-down primitive.
+OP_PING = "ping"
+OP_SUBMIT = "submit"
+OP_DRAIN = "drain"
+
+
+def drain_header(reason: str = "") -> dict:
+    """The drain directive frame header (body is always empty)."""
+    hdr = {"op": OP_DRAIN}
+    if reason:
+        hdr["reason"] = reason
+    return hdr
+
+
 class ProtocolError(RuntimeError):
     """A malformed frame on a worker socket (bad length prefix, short
     read mid-frame, unparseable header)."""

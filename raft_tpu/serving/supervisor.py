@@ -35,6 +35,16 @@ traffic only once its own lease reports a routable health state (its
 warmup finished) and — under the gateway's ``expected_step`` gate —
 the fleet's current checkpoint step. The supervisor only guarantees a
 process exists; the lease plane decides when it serves.
+
+**Directed departures are not crashes.** The autoscaler grows the
+fleet through :meth:`WorkerSupervisor.add_worker` and shrinks it by
+draining a worker it first marks with
+:meth:`WorkerSupervisor.expect_drain`: that worker's subsequent exit 0
+retires its slot without touching the crash streak, the breaker, or
+the respawn machinery — a supervisor that respawned what the
+autoscaler just decommissioned would oscillate the fleet forever. A
+worker that crashes (nonzero exit) MID-drain is counted as a crash but
+still retired: the decommission decision stands.
 """
 
 from __future__ import annotations
@@ -75,6 +85,7 @@ class _WorkerState:
         self.respawns = 0                   # spawns after the first
         self.pending_until: Optional[float] = None
         self.breaker = breaker
+        self.draining = False               # a drain was directed here
 
 
 class WorkerSupervisor:
@@ -106,6 +117,8 @@ class WorkerSupervisor:
         self.respawn_base_delay_s = respawn_base_delay_s
         self.respawn_max_delay_s = respawn_max_delay_s
         self.min_uptime_s = min_uptime_s
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
         self._spawn_fn = spawn_fn or spawn_worker
         self._clock = clock
         self._wall = wall
@@ -128,6 +141,61 @@ class WorkerSupervisor:
                 if st.proc is None:
                     self._do_spawn(st, respawn=False)
         return self
+
+    # -- fleet-size surgery (the autoscaler's levers) --------------------
+
+    def add_worker(self, spec: WorkerSpec,
+                   spawn: bool = True) -> None:
+        """Register (and by default spawn) a NEW worker slot — the
+        autoscaler's scale-up lever. The new worker is unroutable
+        until its own lease proves warmup; the supervisor only
+        guarantees the process exists."""
+        with self._lock:
+            if spec.worker_id in self._workers:
+                raise ValueError(
+                    f"worker {spec.worker_id!r} already supervised")
+            st = _WorkerState(spec, CircuitBreaker(
+                threshold=self._breaker_threshold,
+                cooldown_s=self._breaker_cooldown_s,
+                clock=self._clock))
+            self._workers[spec.worker_id] = st
+            if spawn:
+                self._do_spawn(st, respawn=False)
+
+    def expect_drain(self, worker_id: str) -> bool:
+        """Mark one worker as directed-to-drain: its next exit-0 is a
+        departure, not a crash (no streak, no breaker count, no
+        respawn — the slot is retired). Returns False for an unknown
+        worker id."""
+        with self._lock:
+            st = self._workers.get(worker_id)
+            if st is None:
+                return False
+            st.draining = True
+            return True
+
+    def cancel_drain(self, worker_id: str) -> bool:
+        """Undo :meth:`expect_drain` for a drain directive that never
+        reached its worker (connection failed before the ack): the
+        slot returns to normal supervision."""
+        with self._lock:
+            st = self._workers.get(worker_id)
+            if st is None:
+                return False
+            st.draining = False
+            return True
+
+    def worker_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    def managed_count(self, include_draining: bool = False) -> int:
+        """Slots the supervisor is keeping alive — the autoscaler's
+        notion of current fleet size (draining slots are already
+        leaving, so they don't count by default)."""
+        with self._lock:
+            return sum(1 for st in self._workers.values()
+                       if include_draining or not st.draining)
 
     def start(self) -> "WorkerSupervisor":
         """Run :meth:`poll_once` on ``poll_interval_s`` in a
@@ -167,20 +235,56 @@ class WorkerSupervisor:
     def poll_once(self) -> Dict[str, str]:
         """One supervision pass; returns ``{worker_id: action}`` with
         actions ``ok`` / ``dead`` / ``stale-killed`` / ``respawned`` /
-        ``backoff`` / ``breaker-open``. Non-blocking (backoff is an
-        absolute respawn time, never a sleep)."""
+        ``backoff`` / ``breaker-open`` / ``draining`` / ``drained`` /
+        ``drain-crashed``. Non-blocking (backoff is an absolute
+        respawn time, never a sleep). A ``drained`` / ``drain-crashed``
+        worker's slot is retired: directed departures are never
+        respawned."""
         leases = self.store.read_all()
         now = self._clock()
         wall_now = self._wall()
         actions: Dict[str, str] = {}
+        retired: List[str] = []
         with self._lock:
             for wid, st in self._workers.items():
                 if st.proc is None:
+                    if st.draining:
+                        # Drain directed before any process existed
+                        # (or after its death): just retire the slot.
+                        retired.append(wid)
+                        actions[wid] = "drained"
+                        continue
                     actions[wid] = self._maybe_respawn(st, now)
                     continue
                 rc = st.proc.poll()
+                if rc is not None and st.draining and rc == 0:
+                    # Exit 0 after a directed drain: a departure, not
+                    # a crash — no streak, no breaker count, no
+                    # respawn. The worker removed its own lease as
+                    # part of the drain; the slot is retired.
+                    logger.info("worker %s drained (exit 0)", wid)
+                    retired.append(wid)
+                    actions[wid] = "drained"
+                    continue
                 if rc is not None:
-                    self._on_death(st, now, f"exit code {rc}")
+                    why = f"exit code {rc}"
+                    if st.draining:
+                        # Crashed MID-drain: its in-flight work may
+                        # have died with it. Count the crash honestly,
+                        # but the slot was directed to leave —
+                        # respawning would fight the autoscaler.
+                        logger.warning(
+                            "worker %s crashed while draining (%s)",
+                            wid, why)
+                        st.crashes += 1
+                        retired.append(wid)
+                        try:
+                            self.store.remove(wid)
+                        except Exception:
+                            pass
+                        actions[wid] = "drain-crashed"
+                        continue
+                    self._on_death(st, now, why)
                     actions[wid] = "dead"
                     continue
                 lease = leases.get(wid)
@@ -191,6 +295,11 @@ class WorkerSupervisor:
                     # Alive but unprovable: heartbeat wedged/stalled
                     # past any warmup allowance. Kill and recycle —
                     # same policy as the gateway's STALE routing ban.
+                    # A draining worker removes its own lease just
+                    # before exiting, so a kill here only fires if the
+                    # drain itself wedged — the slot still retires
+                    # (via the drain-crashed branch next poll) rather
+                    # than respawning against the autoscaler.
                     logger.warning(
                         "worker %s lease stale at uptime %.1fs: "
                         "killing", wid, uptime)
@@ -198,6 +307,15 @@ class WorkerSupervisor:
                         st.proc.kill()
                     except OSError:
                         pass
+                    if st.draining:
+                        st.crashes += 1
+                        retired.append(wid)
+                        try:
+                            self.store.remove(wid)
+                        except Exception:
+                            pass
+                        actions[wid] = "drain-crashed"
+                        continue
                     self._on_death(st, now, "stale lease")
                     actions[wid] = "stale-killed"
                     continue
@@ -206,7 +324,9 @@ class WorkerSupervisor:
                     if st.crash_streak:
                         st.crash_streak = 0
                     st.breaker.record_success()
-                actions[wid] = "ok"
+                actions[wid] = "ok" if not st.draining else "draining"
+            for wid in retired:
+                self._workers.pop(wid, None)
         return actions
 
     def _on_death(self, st: _WorkerState, now: float,
@@ -271,6 +391,7 @@ class WorkerSupervisor:
                 "crash_streak": st.crash_streak,
                 "breaker": st.breaker.state,
                 "pending_until": st.pending_until,
+                "draining": st.draining,
             } for wid, st in self._workers.items()}
 
     def respawns(self, worker_id: str) -> int:

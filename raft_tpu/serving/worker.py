@@ -31,11 +31,14 @@ entire contract is:
   warmup must read as "alive, not routable", never as a death.
 
 Fault injection (:class:`~raft_tpu.resilience.FaultInjector`
-``RAFT_FAULT_WORKER_*`` knobs) hooks three seams: kill the process on
+``RAFT_FAULT_WORKER_*`` knobs) hooks four seams: kill the process on
 the Nth received request (``os._exit`` mid-request — after acceptance,
 before any reply: the exact window the gateway's post-acceptance retry
 covers), stall the heartbeat once so the lease expires under a live
-process, and drop a connection after serving instead of replying.
+process, drop a connection after serving instead of replying, and
+blackhole every request for one partition window while the heartbeat
+stays fresh (alive to membership, dead to traffic — only the
+gateway's per-hop stall deadline can catch it).
 
 ``python -m raft_tpu.serving.worker --spec spec.json`` runs one worker
 until SIGTERM; :func:`spawn_worker` is the supervisor-side launcher
@@ -63,6 +66,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from raft_tpu import resilience
+from raft_tpu.serving import health as health_mod
 from raft_tpu.serving import netproto
 from raft_tpu.serving.batcher import PRIORITY_HIGH, RequestTimedOut
 from raft_tpu.serving.metrics import CompileWatch
@@ -97,16 +101,35 @@ class WorkerConfig:
     iters: int = 2
     step: Optional[int] = None      # static served step (no reloader)
     persistent_cache: object = False
+    # Per-connection read deadline: a client that stalls mid-frame (or
+    # never sends one) is dropped after this many seconds instead of
+    # pinning a connection thread forever. 0 disables. The default is
+    # far above the gateway pool's idle-age cutoff, so a pooled
+    # keep-alive connection always ages out of the pool before the
+    # worker reaps it.
+    conn_read_timeout_s: float = 120.0
+    # Bound on how long a drain waits for in-flight work before
+    # stopping anyway (a wedged request must not leak the process).
+    drain_timeout_s: float = 30.0
+    # Engine brownout knobs (see ServingConfig): the worker's overload
+    # valve while the autoscaler's new capacity warms up.
+    iters_ladder: Tuple[int, ...] = ()
+    brownout_high_water: int = 0
+    brownout_low_water: int = 0
+    brownout_dwell_ms: float = 250.0
 
     def to_dict(self) -> Dict[str, object]:
         d = dataclasses.asdict(self)
         d["buckets"] = [list(b) for b in self.buckets]
+        d["iters_ladder"] = [int(v) for v in self.iters_ladder]
         return d
 
     @staticmethod
     def from_dict(d: Dict[str, object]) -> "WorkerConfig":
         d = dict(d)
         d["buckets"] = tuple(tuple(b) for b in d.get("buckets", ()))
+        d["iters_ladder"] = tuple(
+            int(v) for v in d.get("iters_ladder", ()))
         known = {f.name for f in dataclasses.fields(WorkerConfig)}
         return WorkerConfig(**{k: v for k, v in d.items() if k in known})
 
@@ -122,12 +145,16 @@ class WorkerServer:
     """
 
     def __init__(self, engine, config: WorkerConfig,
-                 lease_store=None, reloader=None):
+                 lease_store=None, reloader=None, on_drained=None):
         self.engine = engine
         self.config = config
         self.store = (lease_store if lease_store is not None
                       else netproto.default_lease_store(config.lease_dir))
         self.reloader = reloader
+        # Invoked (once) after a drain directive finished: in-flight
+        # work done, engine closed, lease removed. The worker ``main``
+        # hooks its stop event here so a drained process exits 0.
+        self.on_drained = on_drained
         self.addr: Optional[Tuple[str, int]] = None
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -139,6 +166,15 @@ class WorkerServer:
         self._serving = False
         self._hb_seq = 0
         self._compile_watch: Optional[CompileWatch] = None
+        # Drain lifecycle: _draining flips once (under _inflight_cv),
+        # the drain thread waits for _inflight to hit zero, and
+        # drained is set after the full stop sequence completed.
+        self._inflight_cv = threading.Condition()
+        self._inflight = 0
+        self._draining = False
+        self.drained = threading.Event()
+        self.slow_client_drops = 0  # connections reaped by read deadline
+        self._partition_until = 0.0  # injected blackhole window end
 
     # -- lifecycle -------------------------------------------------------
 
@@ -194,6 +230,60 @@ class WorkerServer:
         if remove_lease:
             self.store.remove(self.config.worker_id)
 
+    # -- drain lifecycle -------------------------------------------------
+
+    def drain(self, reason: str = "") -> bool:
+        """Begin the graceful decommission sequence (idempotent;
+        returns False when a drain was already running).
+
+        The lease flips to ``draining`` immediately — the gateway stops
+        routing here at its next membership refresh, and any submit
+        that still lands is answered with a typed ``WorkerDraining``
+        error the failover contract walks past. A background thread
+        waits for in-flight work to finish (bounded by
+        ``drain_timeout_s``), runs the normal :meth:`stop` sequence
+        (lease removed), then fires ``on_drained`` — which in the
+        process entry point means a clean exit 0."""
+        with self._inflight_cv:
+            if self._draining:
+                return False
+            self._draining = True
+        logger.info("drain directive accepted%s",
+                    f" ({reason})" if reason else "")
+        t = threading.Thread(target=self._drain_loop,
+                             name=f"{self.config.worker_id}-drain",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return True
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_cv:
+            return self._inflight
+
+    def _drain_loop(self) -> None:
+        self._publish_lease()       # go DRAINING now, not next beat
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        with self._inflight_cv:
+            while (self._inflight > 0
+                   and time.monotonic() < deadline):
+                self._inflight_cv.wait(timeout=0.05)
+            leaked = self._inflight
+        if leaked:
+            logger.warning(
+                "drain timeout: %d request(s) still in flight after "
+                "%.1fs; stopping anyway", leaked,
+                self.config.drain_timeout_s)
+        self.stop(remove_lease=True)
+        self.drained.set()
+        cb = self.on_drained
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("on_drained callback failed")
+
     # -- membership ------------------------------------------------------
 
     def _served_step(self) -> Optional[int]:
@@ -202,6 +292,10 @@ class WorkerServer:
         return self.config.step
 
     def _lease_state(self) -> str:
+        if self._draining:
+            # The drain overrides the engine's self-report: routing
+            # must stop even while the engine still looks READY.
+            return health_mod.DRAINING
         if not self._serving:
             return "warming"
         try:
@@ -214,6 +308,19 @@ class WorkerServer:
         extra: Dict[str, object] = {}
         if self._compile_watch is not None:
             extra["post_warmup_compiles"] = self._compile_watch.so_far
+        try:
+            h = self.engine.health()
+            # The autoscaler's occupancy signal and its drain-target
+            # tiebreaker: queued + in-flight work at the last beat.
+            extra["load"] = (float(h.get("queue_depth", 0))
+                             + float(h.get("inflight_batches", 0)))
+            bstats = h.get("brownout")
+            if isinstance(bstats, dict):
+                extra["brownout_transitions"] = \
+                    int(bstats.get("transitions", 0))
+                extra["brownout_level"] = int(bstats.get("level", 0))
+        except Exception:
+            pass                    # stub engines carry no load signal
         lease = Lease(
             worker_id=self.config.worker_id,
             addr=tuple(self.addr) if self.addr else ("", 0),
@@ -261,6 +368,16 @@ class WorkerServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self.config.conn_read_timeout_s:
+            # Slow-client defense: a peer that stalls mid-frame (or
+            # opens a connection and never speaks) is reaped after
+            # this deadline instead of pinning this thread forever.
+            # The gateway pool's idle-age eviction sits well below it,
+            # so healthy pooled connections never trip the reaper.
+            try:
+                conn.settimeout(self.config.conn_read_timeout_s)
+            except OSError:
+                pass
         try:
             while not self._stop.is_set():
                 msg = read_message(conn)
@@ -268,6 +385,11 @@ class WorkerServer:
                     return          # peer closed cleanly
                 if not self._handle(conn, *msg):
                     return          # injected drop: connection is gone
+        except socket.timeout:
+            self.slow_client_drops += 1
+            logger.warning(
+                "dropping slow/wedged client connection (no complete "
+                "frame within %.1fs)", self.config.conn_read_timeout_s)
         except (ProtocolError, OSError):
             pass                    # torn peer: drop the connection
         finally:
@@ -282,12 +404,22 @@ class WorkerServer:
                 body: bytearray) -> bool:
         """Serve one frame; False = the connection was dropped."""
         op = header.get("op")
-        if op == "ping":
+        if op == netproto.OP_PING:
             write_message(conn, {"status": "ok",
                                  "state": self._lease_state(),
                                  "step": self._served_step()})
             return True
-        if op != "submit":
+        if op == netproto.OP_DRAIN:
+            # Acknowledge BEFORE the drain starts tearing things down,
+            # so the directive's sender gets a definite answer on the
+            # same connection it asked on.
+            write_message(conn, {"status": "ok",
+                                 "draining": True,
+                                 "worker": self.config.worker_id,
+                                 "inflight": self.inflight})
+            self.drain(reason=str(header.get("reason", "")))
+            return True
+        if op != netproto.OP_SUBMIT:
             write_message(conn, {"status": "error",
                                  "error_type": "ProtocolError",
                                  "error": f"unknown op {op!r}"})
@@ -303,6 +435,44 @@ class WorkerServer:
             # skips atexit/finally exactly like a real kill.
             logger.error("injected kill on request %d", seq)
             os._exit(KILLED_BY_INJECTION)
+        if inj is not None:
+            window = inj.take_worker_partition()
+            if window > 0:
+                self._partition_until = time.monotonic() + window
+                logger.warning("injected partition: blackholing "
+                               "requests for %.1fs", window)
+        if self._partition_until > time.monotonic():
+            # Accept-then-blackhole: the bytes were read, no reply will
+            # ever be written, and the heartbeat thread keeps the lease
+            # looking healthy — only the gateway's per-hop stall
+            # deadline can detect this worker and fail the request
+            # over. Hold silently for the window, then drop the conn.
+            while (self._partition_until > time.monotonic()
+                   and not self._stop.is_set()):
+                time.sleep(0.05)
+            return False
+        with self._inflight_cv:
+            draining = self._draining
+            if not draining:
+                self._inflight += 1
+        if draining:
+            # Raced the drain announcement: a typed post-acceptance
+            # error the gateway's failover contract walks past.
+            write_message(conn, {"status": "error",
+                                 "error_type": "WorkerDraining",
+                                 "error": f"worker "
+                                          f"{self.config.worker_id} is "
+                                          "draining; route elsewhere"})
+            return True
+        try:
+            return self._serve_submit(conn, header, body, seq, inj)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _serve_submit(self, conn: socket.socket, header: dict,
+                      body: bytearray, seq: int, inj) -> bool:
         deadline = header.get("deadline")
         if deadline is not None and time.monotonic() >= deadline:
             # Expired before we touched the engine: the budget was
@@ -438,9 +608,18 @@ def main(argv=None) -> int:
         buckets=tuple(tuple(b) for b in cfg.buckets),
         queue_timeout_ms=cfg.queue_timeout_ms,
         replica_id=cfg.worker_id,
-        persistent_cache=cfg.persistent_cache))
-    server = WorkerServer(engine, cfg)
+        persistent_cache=cfg.persistent_cache,
+        iters_ladder=cfg.iters_ladder,
+        brownout_high_water=cfg.brownout_high_water,
+        brownout_low_water=cfg.brownout_low_water,
+        brownout_dwell_ms=cfg.brownout_dwell_ms))
     stop = threading.Event()
+    # A drain directive ends the process the same way SIGTERM does —
+    # except the server already finished in-flight work, closed the
+    # engine and removed its lease before firing this. Exit code 0 is
+    # the drain contract the supervisor keys on (directed departure,
+    # not a crash).
+    server = WorkerServer(engine, cfg, on_drained=stop.set)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     server.start(warmup=True)
@@ -450,7 +629,8 @@ def main(argv=None) -> int:
         while not stop.is_set():
             stop.wait(0.5)
     finally:
-        server.stop()
+        if not server.drained.is_set():
+            server.stop()
     return 0
 
 

@@ -63,6 +63,15 @@ AB_METRICS = {
     STEP_METRIC: ("step", STEP_ARMS),
 }
 
+# The autoscale drill's artifact is a contract record, not a speedup
+# claim: its honesty is the drill's counters travelling with it. A
+# payload carrying this metric must ship the counters that make the
+# "converged with zero loss" claim auditable.
+AUTOSCALE_METRIC = "autoscale_drill_capacity_convergence"
+AUTOSCALE_COUNTERS = ("scale_ups", "graceful_drains", "failover_retries",
+                      "completed", "dropped", "mismatched",
+                      "post_warmup_compiles")
+
 
 def _check_trace_artifact(path) -> List[str]:
     """Validate a payload's optional ``trace_artifact`` reference: the
@@ -123,6 +132,16 @@ def check_payload(name: str, payload: dict) -> List[str]:
             problems.append(
                 f"{label} A/B artifact missing arm(s) {missing} in "
                 "'per_arm' — an A/B claim needs both measurements")
+    if payload.get("metric") == AUTOSCALE_METRIC:
+        drill = payload.get("drill")
+        missing = [k for k in AUTOSCALE_COUNTERS
+                   if not isinstance(drill, dict)
+                   or not isinstance(drill.get(k), numbers.Number)]
+        if missing:
+            problems.append(
+                f"autoscale drill artifact missing counter(s) {missing} "
+                "in 'drill' — the convergence claim needs its audit "
+                "trail")
     return [f"{name}: {p}" for p in problems]
 
 

@@ -88,6 +88,20 @@
     reported by every worker's lease, and per-worker liveness/respawn/
     retry gauges live in the registry's Prometheus export.
 
+12. autoscale (``--drill autoscale``) — self-healing capacity: burst
+    load against a 1-worker fleet drives the metrics-fed autoscaler to
+    spawn a second worker process (unroutable until its lease proves
+    warmup; the incumbent's brownout controller provably covers the
+    gap), a partition-injected worker
+    (``RAFT_FAULT_WORKER_PARTITION_S``) loses its request to the
+    gateway's hop-stall failover rather than a client timeout, and
+    when load drops the autoscaler drains the least-loaded worker
+    gracefully — in-flight work finishes, the lease is removed, the
+    worker exits 0 and the supervisor retires the slot without
+    counting a crash or respawning. Gate: 0 dropped, 0 bit-incorrect,
+    ≥1 failover retry, and 0 post-warmup compiles on every survivor,
+    with the autoscaler's decision gauges live in the registry export.
+
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
 bit-identical; under a forced multi-device topology
@@ -1597,6 +1611,264 @@ def drill_gateway(root):
         sup.stop(kill_workers=True)
 
 
+def drill_autoscale(root):
+    """Self-healing capacity end to end: burst load against a 1-worker
+    fleet -> the autoscaler spawns a second worker PROCESS (unroutable
+    until its lease proves warmup, brownout covering the gap on the
+    incumbent); a partition-injected worker loses its requests to
+    failover (hop stall, not client timeout); load drops -> the
+    autoscaler drains the least-loaded worker gracefully (in-flight
+    finishes, lease removed, exit 0, NO respawn). Gates: 0 dropped, 0
+    bit-incorrect, 0 post-warmup compiles on every survivor."""
+    import json
+
+    from raft_tpu.serving import loadgen
+    from raft_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+    from raft_tpu.serving.gateway import GatewayConfig, ServingGateway
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+    from raft_tpu.serving.worker import WorkerConfig
+
+    STEP = 0
+    lease_dir = os.path.join(root, "leases")
+    store = FileLeaseStore(lease_dir)
+
+    def _worker_cfg(wid):
+        # Brownout ladder on every worker: while a scale-up is still
+        # warming, the incumbent degrades LOW quality instead of
+        # queue-timing anyone out. HIGH traffic (this drill's load)
+        # stays bit-exact by the brownout contract.
+        return WorkerConfig(
+            worker_id=wid, lease_dir=lease_dir, buckets=BUCKETS,
+            max_batch=4, max_wait_ms=3.0, queue_timeout_ms=60_000,
+            step=STEP, iters_ladder=(1,), brownout_high_water=3,
+            brownout_low_water=1, brownout_dwell_ms=150.0).to_dict()
+
+    sup = WorkerSupervisor(
+        [WorkerSpec("w0", _worker_cfg("w0"))], store,
+        stale_after_s=3.0, lease_grace_s=300.0, poll_interval_s=0.25,
+        respawn_base_delay_s=0.25, respawn_max_delay_s=2.0,
+        min_uptime_s=2.0)
+    gw = ServingGateway(store, GatewayConfig(
+        queue_timeout_ms=120_000, lease_ttl_s=2.0, poll_interval_s=0.1,
+        dispatch_threads=CONCURRENCY, expected_step=STEP,
+        hop_timeout_s=1.5))
+    sup.attach_registry(gw.registry)
+
+    minted = []
+
+    def spec_factory():
+        # "scale0" vs "w0" splits the two padded buckets' rendezvous
+        # ownership (w0 owns 40x64, scale0 owns 56x80) — the scaled-up
+        # worker MUST own primary traffic or the partition leg never
+        # arms its injector.
+        wid = f"scale{len(minted)}"
+        minted.append(wid)
+        # The first scaled-up worker carries the partition injector:
+        # its first accepted request blackholes for 4s — longer than
+        # the gateway's 1.5s hop stall, shorter than any client
+        # budget. spawn_worker treats env as a full REPLACEMENT, so
+        # merge over the parent environment (JAX_PLATFORMS et al).
+        env = (dict(os.environ, RAFT_FAULT_WORKER_PARTITION_S="4.0")
+               if len(minted) == 1 else None)
+        return WorkerSpec(wid, _worker_cfg(wid), env=env)
+
+    auto = Autoscaler(sup, store, gw.registry, spec_factory,
+                      AutoscalerConfig(
+                          min_workers=1, max_workers=2,
+                          high_water=1.5, low_water=0.5,
+                          dwell_s=1.0, scale_up_cooldown_s=5.0,
+                          scale_down_cooldown_s=10.0, lease_ttl_s=2.0))
+    sup.start_all()
+    sup.start()
+    gw.start()
+    try:
+        _await_metric(lambda: len(gw.live_workers()), 1, 300.0,
+                      "the initial worker becoming routable")
+        predictor = _make_predictor()
+        frames = loadgen.make_frames(SHAPES, per_shape=2, seed=29)
+        refs, ref_kind = _references(predictor, frames, max_batch=4)
+
+        # -- Phase 1: burst against one worker -> scale-up -------------
+        n_burst, burst_conc = 80, 12
+        out1 = {}
+
+        def load1():
+            out1.update(loadgen.run_load(
+                gw, frames, n_requests=n_burst,
+                concurrency=burst_conc, references=refs, timeout=600.0))
+
+        loader = threading.Thread(target=load1, name="autoscale-burst")
+        loader.start()
+        # Drive the control loop at drill pace while the burst runs:
+        # pressure (gateway queue depth / routable + lease-reported
+        # engine load) must cross the high watermark and spawn exactly
+        # one worker (max_workers=2 turns further desire into at-max).
+        deadline = time.monotonic() + 120.0
+        while auto.stats()["scale_ups"] == 0:
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    "burst never drove a scale-up (signals "
+                    f"{auto.signals()})")
+            auto.poll_once()
+            time.sleep(0.25)
+        print(f"  scale-up under burst: target "
+              f"{auto.target_workers}, signals {auto.signals()}")
+        loader.join(600)
+        assert not loader.is_alive(), "burst load generator wedged"
+        assert out1["completed"] == n_burst, \
+            f"completed {out1['completed']}/{n_burst}"
+        assert not out1["dropped"], f"dropped: {out1['dropped']}"
+        assert not out1["mismatched"], \
+            f"bit-incorrect responses: {out1['mismatched']}"
+        assert auto.stats()["scale_ups"] == 1, auto.stats()
+        assert "scale0" in sup.worker_ids(), sup.worker_ids()
+        # Brownout covered the warmup gap on the incumbent: its
+        # controller provably engaged while the burst outran capacity.
+        w0_lease = store.read_all()["w0"]
+        assert w0_lease.extra.get("brownout_transitions", 0) >= 1, \
+            (f"brownout never engaged on w0 during the burst: "
+             f"{w0_lease.extra}")
+        print(f"  burst: {out1['completed']}/{n_burst} bit-exact at "
+              f"concurrency {burst_conc}; w0 brownout transitions = "
+              f"{w0_lease.extra['brownout_transitions']}; reference = "
+              f"{ref_kind}")
+
+        # -- Phase 2: the scale-up joins routing only after warmup ----
+        _await_metric(lambda: len(gw.live_workers()), 2, 300.0,
+                      "the scaled-up worker becoming routable")
+        assert "scale0" in gw.live_workers(), gw.live_workers()
+        print(f"  scale0 warmed and routable: {gw.live_workers()}")
+
+        # -- Phase 3: partition leg rides the failover contract --------
+        # Wave A: scale0's first accepted request arms the 4s
+        # blackhole; the gateway's hop stall (1.5s) converts the
+        # silence into a retryable failure and every stalled request
+        # completes on w0 — no client ever times out, nothing is
+        # dropped. Wave B (after the partition window expires) proves
+        # scale0 rejoins service on its own bucket.
+        n_a = 16
+        out2 = loadgen.run_load(gw, frames, n_requests=n_a,
+                                concurrency=4, references=refs,
+                                timeout=600.0)
+        assert out2["completed"] == n_a, \
+            f"completed {out2['completed']}/{n_a}"
+        assert not out2["dropped"], f"dropped: {out2['dropped']}"
+        assert not out2["mismatched"], \
+            f"bit-incorrect responses: {out2['mismatched']}"
+        retries = sum(gw.metrics.retries.values())
+        assert retries >= 1, \
+            "partition produced no failover retries"
+        print(f"  partition wave: {out2['completed']}/{n_a} bit-exact "
+              f"through {retries} failover retr"
+              f"{'y' if retries == 1 else 'ies'}")
+        time.sleep(4.5)             # let the blackhole window expire
+        n_b = 24
+        out2b = loadgen.run_load(gw, frames, n_requests=n_b,
+                                 concurrency=CONCURRENCY,
+                                 references=refs, timeout=600.0)
+        assert out2b["completed"] == n_b, \
+            f"completed {out2b['completed']}/{n_b}"
+        assert not out2b["dropped"], f"dropped: {out2b['dropped']}"
+        assert not out2b["mismatched"], \
+            f"bit-incorrect responses: {out2b['mismatched']}"
+        assert out2b["per_replica"].get("scale0", {}).get(
+            "completed", 0) >= 1, \
+            (f"scale0 never served post-partition: "
+             f"{out2b['per_replica']}")
+        print(f"  post-partition wave: {out2b['completed']}/{n_b} "
+              f"bit-exact; per-replica = "
+              f"{ {k: v['completed'] for k, v in out2b['per_replica'].items()} }")
+
+        # -- Phase 4: load drops -> graceful drain to min_workers ------
+        deadline = time.monotonic() + 120.0
+        action = None
+        while auto.stats()["drains"] == 0:
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"idle fleet never drained (last action {action}, "
+                    f"signals {auto.signals()})")
+            action = auto.poll_once()
+            time.sleep(0.25)
+        victim = next(wid for wid, st in sup.status().items()
+                      if st["draining"])
+        print(f"  scale-down: draining {victim} "
+              f"(target {auto.target_workers})")
+        # The supervisor retires the slot on exit 0 — no streak, no
+        # breaker, no respawn — and the drained worker removed its own
+        # lease on the way out.
+        _await_metric(lambda: 1 if victim not in sup.worker_ids()
+                      else 0, 1, 120.0, f"{victim}'s slot retiring")
+        _await_metric(lambda: 0 if victim in store.read_all() else 1,
+                      1, 30.0, f"{victim}'s lease removal")
+        _await_metric(lambda: len(gw.live_workers()), 1, 30.0,
+                      "routing converging to the survivor")
+        assert sup.managed_count() == 1, sup.status()
+        survivor_ids = sup.worker_ids()
+        print(f"  {victim} drained (exit 0, slot retired, lease "
+              f"removed); survivors: {survivor_ids}")
+
+        # Survivors still serve bit-exact with 0 post-warmup compiles.
+        out3 = loadgen.run_load(gw, frames, n_requests=20,
+                                concurrency=4, references=refs,
+                                timeout=300.0)
+        assert out3["completed"] == 20 and not out3["dropped"] \
+            and not out3["mismatched"], out3
+        for wid, lease in sorted(store.read_all().items()):
+            compiles = lease.extra.get("post_warmup_compiles")
+            assert compiles == 0, \
+                f"{wid} reports {compiles} post-warmup compile(s)"
+        txt = gw.registry.prometheus_text()
+        for needle in ("autoscaler_target_workers 1",
+                       "autoscaler_scale_ups 1",
+                       "autoscaler_scale_downs 1",
+                       "autoscaler_drains 1"):
+            assert needle in txt, \
+                f"{needle!r} missing from the registry export"
+        print(f"  post-drain wave: {out3['completed']}/20 bit-exact; "
+              f"0 post-warmup compiles on survivors; autoscaler "
+              f"gauges in the export")
+
+        bench_out = os.environ.get("RAFT_BENCH_OUT")
+        if bench_out:
+            payload = {
+                "metric": "autoscale_drill_capacity_convergence",
+                "value": float(auto.stats()["drains"]),
+                "unit": "graceful_drains",
+                "platform": "cpu",
+                "smoke_operating_point": True,
+                "criterion_note": (
+                    "CPU drill topology (small model, 2-bucket load): "
+                    "the numbers prove the capacity-convergence "
+                    "CONTRACT (scale-up through warming, partition "
+                    "failover, graceful drain), not serving "
+                    "throughput; on-TPU capture is ROADMAP debt"),
+                "drill": {
+                    "scale_ups": auto.stats()["scale_ups"],
+                    "scale_downs": auto.stats()["scale_downs"],
+                    "graceful_drains": auto.stats()["drains"],
+                    "failover_retries": retries,
+                    "completed": (out1["completed"] + out2["completed"]
+                                  + out2b["completed"]
+                                  + out3["completed"]),
+                    "dropped": 0,
+                    "mismatched": 0,
+                    "post_warmup_compiles": 0,
+                    "brownout_transitions_during_burst": int(
+                        w0_lease.extra["brownout_transitions"]),
+                    "drained_worker": victim,
+                    "survivors": survivor_ids,
+                },
+            }
+            with open(bench_out, "w") as f:
+                json.dump(payload, f)
+            print(f"  wrote {bench_out}")
+    finally:
+        auto.close()
+        gw.close()
+        sup.stop(kill_workers=True)
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1610,6 +1882,7 @@ DRILLS = [
     drill_trace,
     drill_contbatch,
     drill_gateway,
+    drill_autoscale,
 ]
 
 

@@ -1,0 +1,431 @@
+"""Self-healing capacity: the autoscaler control loop and the graceful
+drain lifecycle.
+
+The control-loop suite runs entirely on FAKE clocks against a real
+:class:`WorkerSupervisor` (fake processes) and a real
+:class:`MetricsRegistry` whose gateway gauges read from a mutable dict
+— hysteresis, dwell, cooldowns, clamps and victim selection are pinned
+without a single sleep. The drain lifecycle test runs a REAL
+:class:`WorkerServer` on real sockets: the drain directive must let
+in-flight work finish, reject late submits with a typed error the
+failover contract walks past, remove the lease, and fire
+``on_drained`` (exit 0 in the process entry point).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.observability.registry import MetricsRegistry
+from raft_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+from raft_tpu.serving.gateway import SocketTransport
+from raft_tpu.serving.health import DRAINING
+from raft_tpu.serving.netproto import (FileLeaseStore, Lease,
+                                       drain_header)
+from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeProc:
+    def __init__(self):
+        self.rc = None
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+
+class DrainAckTransport:
+    """Scripted drain-directive transport: acks by default, or raises /
+    answers garbage when told to."""
+
+    def __init__(self):
+        self.sent = []
+        self.fail = False
+        self.nack = False
+
+    def request(self, addr, header, body=b"", deadline=None,
+                clock=time.monotonic):
+        self.sent.append((tuple(addr), dict(header)))
+        if self.fail:
+            raise OSError("drain directive lost")
+        if self.nack:
+            return ({"status": "error"}, bytearray())
+        return ({"status": "ok", "draining": True}, bytearray())
+
+    def close(self):
+        pass
+
+
+def _registry(sig):
+    """A registry exposing the gateway gauges the autoscaler reads,
+    backed by the mutable ``sig`` dict."""
+    reg = MetricsRegistry()
+    reg.gauge("gateway_queue_depth", fn=lambda: sig["queue"])
+    reg.gauge("gateway_fleet_occupancy", fn=lambda: sig["occ"])
+    reg.gauge("gateway_workers_live", fn=lambda: sig["live"])
+    reg.gauge("slo_violation_ratio", labelnames=("class",),
+              fn=lambda: {("low",): sig["slo"]})
+    return reg
+
+
+class TestAutoscaler:
+    def _rig(self, tmp_path, n_workers=2, **cfg):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path / "leases"))
+        procs = []
+
+        def spawn(spec, env=None):
+            p = FakeProc()
+            procs.append(p)
+            return p
+
+        sup = WorkerSupervisor(
+            [WorkerSpec(f"w{i}", {"worker_id": f"w{i}"})
+             for i in range(n_workers)],
+            store, spawn_fn=spawn, clock=clock, wall=wall)
+        sup.start_all()
+        minted = []
+
+        def spec_factory():
+            wid = f"auto{len(minted)}"
+            minted.append(wid)
+            return WorkerSpec(wid, {"worker_id": wid})
+
+        sig = {"queue": 0.0, "occ": 0.0, "live": float(n_workers),
+               "slo": 0.0}
+        transport = DrainAckTransport()
+        cfg.setdefault("min_workers", 1)
+        cfg.setdefault("max_workers", 4)
+        cfg.setdefault("high_water", 8.0)
+        cfg.setdefault("low_water", 1.0)
+        cfg.setdefault("dwell_s", 5.0)
+        cfg.setdefault("scale_up_cooldown_s", 10.0)
+        cfg.setdefault("scale_down_cooldown_s", 60.0)
+        cfg.setdefault("lease_ttl_s", 2.0)
+        auto = Autoscaler(sup, store, _registry(sig), spec_factory,
+                          AutoscalerConfig(**cfg),
+                          transport=transport, clock=clock, wall=wall)
+        return auto, sup, store, sig, clock, wall, transport, procs
+
+    def _lease(self, store, wall, wid, load, state="ready", port=9000):
+        store.publish(Lease(worker_id=wid, addr=("127.0.0.1", port),
+                            state=state, t_heartbeat=wall(),
+                            extra={"load": load}))
+
+    def test_holds_in_hysteresis_band(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, procs = self._rig(
+            tmp_path)
+        sig["queue"] = 8.0       # pressure = 8/2 + 0 = 4, in (1, 8)
+        for _ in range(5):
+            assert auto.poll_once() == "hold"
+            clock.advance(10.0)
+        assert auto.stats()["scale_ups"] == 0
+        assert auto.stats()["scale_downs"] == 0
+        assert sup.managed_count() == 2
+        assert tr.sent == []
+
+    def test_scale_up_on_high_pressure(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, procs = self._rig(
+            tmp_path)
+        sig["queue"] = 20.0      # pressure = 20/2 = 10 >= 8
+        assert auto.poll_once() == "scale-up"
+        assert sup.managed_count() == 3
+        assert "auto0" in sup.worker_ids()
+        assert len(procs) == 3   # the new slot actually spawned
+        assert auto.target_workers == 3
+        assert auto.stats()["scale_ups"] == 1
+
+    def test_slo_violation_forces_scale_up(self, tmp_path):
+        auto, sup, store, sig, clock, *_ = self._rig(tmp_path)
+        # Queue looks idle, SLO is burning: capacity must still grow.
+        sig["slo"] = 0.2
+        assert auto.poll_once() == "scale-up"
+        assert sup.managed_count() == 3
+
+    def test_dwell_gates_consecutive_decisions(self, tmp_path):
+        auto, sup, store, sig, clock, *_ = self._rig(
+            tmp_path, dwell_s=5.0, scale_up_cooldown_s=0.0)
+        sig["queue"] = 100.0
+        assert auto.poll_once() == "scale-up"
+        clock.advance(4.9)
+        assert auto.poll_once() == "dwell"
+        clock.advance(0.2)
+        assert auto.poll_once() == "scale-up"
+        assert sup.managed_count() == 4
+
+    def test_scale_up_cooldown(self, tmp_path):
+        auto, sup, store, sig, clock, *_ = self._rig(
+            tmp_path, dwell_s=1.0, scale_up_cooldown_s=30.0)
+        sig["queue"] = 100.0
+        assert auto.poll_once() == "scale-up"
+        clock.advance(10.0)      # past dwell, inside up-cooldown
+        assert auto.poll_once() == "cooldown"
+        clock.advance(21.0)
+        assert auto.poll_once() == "scale-up"
+
+    def test_at_max_clamp(self, tmp_path):
+        auto, sup, store, sig, clock, *_ = self._rig(
+            tmp_path, n_workers=2, max_workers=2)
+        sig["queue"] = 100.0
+        assert auto.poll_once() == "at-max"
+        assert sup.managed_count() == 2
+        assert auto.stats()["scale_ups"] == 0
+
+    def test_scale_down_drains_least_loaded(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, scale_down_cooldown_s=0.0)
+        self._lease(store, wall, "w0", load=5.0, port=9000)
+        self._lease(store, wall, "w1", load=1.0, port=9001)
+        sig["queue"] = 0.0       # pressure 0 <= low_water
+        assert auto.poll_once() == "scale-down"
+        # The directive went to the LEAST loaded worker's address.
+        addr, hdr = tr.sent[0]
+        assert addr == ("127.0.0.1", 9001)
+        assert hdr["op"] == "drain"
+        assert sup.status()["w1"]["draining"] is True
+        assert sup.status()["w0"]["draining"] is False
+        assert auto.target_workers == 1
+        assert auto.stats()["drains"] == 1
+
+    def test_never_drains_below_min(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, n_workers=1, min_workers=1,
+            scale_down_cooldown_s=0.0)
+        self._lease(store, wall, "w0", load=0.0)
+        sig["live"] = 1.0
+        assert auto.poll_once() == "at-min"
+        assert tr.sent == []
+        assert sup.status()["w0"]["draining"] is False
+
+    def test_scale_down_cooldown_covers_recent_scale_up(self, tmp_path):
+        """Capacity added under burst must not be drained back the
+        moment the queue dips: ANY change re-arms the down cooldown."""
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, dwell_s=1.0, scale_up_cooldown_s=0.0,
+            scale_down_cooldown_s=60.0)
+        sig["queue"] = 100.0
+        assert auto.poll_once() == "scale-up"
+        self._lease(store, wall, "w0", load=0.0, port=9000)
+        self._lease(store, wall, "w1", load=0.0, port=9001)
+        sig["queue"] = 0.0
+        clock.advance(10.0)      # past dwell, inside down-cooldown
+        wall.advance(10.0)
+        self._lease(store, wall, "w0", load=0.0, port=9000)
+        self._lease(store, wall, "w1", load=0.0, port=9001)
+        assert auto.poll_once() == "cooldown"
+        clock.advance(51.0)
+        wall.advance(51.0)
+        self._lease(store, wall, "w0", load=0.0, port=9000)
+        self._lease(store, wall, "w1", load=0.0, port=9001)
+        assert auto.poll_once() == "scale-down"
+
+    def test_victim_selection_skips_unroutable_and_draining(
+            self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, n_workers=3, scale_down_cooldown_s=0.0)
+        sig["live"] = 3.0
+        # w0 is least loaded but DRAINING already; w1 is warming
+        # (unroutable); w2 must be picked despite the highest load.
+        self._lease(store, wall, "w0", load=0.0, state=DRAINING,
+                    port=9000)
+        sup.expect_drain("w0")
+        self._lease(store, wall, "w1", load=1.0, state="warming",
+                    port=9001)
+        self._lease(store, wall, "w2", load=9.0, port=9002)
+        assert auto.poll_once() == "scale-down"
+        assert tr.sent[0][0] == ("127.0.0.1", 9002)
+
+    def test_stale_lease_not_a_victim(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, scale_down_cooldown_s=0.0, lease_ttl_s=2.0)
+        self._lease(store, wall, "w0", load=0.0)
+        wall.advance(10.0)       # w0's lease is now stale
+        assert auto.poll_once() == "no-victim"
+        assert tr.sent == []
+
+    def test_drain_failed_reverts_everything(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, scale_down_cooldown_s=0.0)
+        self._lease(store, wall, "w0", load=0.0)
+        tr.fail = True
+        assert auto.poll_once() == "drain-failed"
+        # Nothing changed: no draining mark, target intact, and the
+        # slot remains under normal supervision.
+        assert sup.status()["w0"]["draining"] is False
+        assert auto.target_workers == 2
+        assert auto.stats()["scale_downs"] == 0
+        # A nack (connected, wrong answer) reverts the same way.
+        tr.fail, tr.nack = False, True
+        clock.advance(10.0)
+        wall.advance(10.0)
+        self._lease(store, wall, "w0", load=0.0)
+        assert auto.poll_once() == "drain-failed"
+        assert sup.status()["w0"]["draining"] is False
+
+    def test_registry_gauges_and_missing_signals(self, tmp_path):
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(tmp_path)
+        txt = auto.registry.prometheus_text()
+        assert "autoscaler_target_workers 2" in txt
+        sig["queue"] = 100.0
+        auto.poll_once()
+        txt = auto.registry.prometheus_text()
+        assert "autoscaler_target_workers 3" in txt
+        assert "autoscaler_scale_ups 1" in txt
+        # A registry without the gateway gauges stalls the controller
+        # at 'no evidence' — never crashes it.
+        bare = Autoscaler(sup, store, MetricsRegistry(),
+                          lambda: WorkerSpec("x", {}),
+                          AutoscalerConfig(), transport=tr,
+                          clock=clock, wall=wall)
+        assert bare.signals()["pressure"] == 0.0
+        assert bare.poll_once() in ("hold", "cooldown", "dwell",
+                                    "at-min", "no-victim")
+
+
+# -- the drain lifecycle on a real WorkerServer --------------------------
+
+class _GateFuture:
+    def __init__(self, gate, value):
+        self._gate = gate
+        self._value = value
+
+    def result(self, timeout=None):
+        assert self._gate.wait(timeout if timeout else 30.0), \
+            "gate never opened"
+        return self._value
+
+
+class _GateEngine:
+    """Stub engine whose futures block on an event — in-flight work
+    stays in flight until the test says otherwise."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.submits = 0
+
+    def start(self, warmup=True):
+        return self
+
+    def close(self):
+        pass
+
+    def health_state(self):
+        return "ready"
+
+    def submit(self, im1, im2, priority="high", iters=None,
+               trace_id=None, deadline_s=None):
+        self.submits += 1
+        flow = np.zeros((*im1.shape[:2], 2), np.float32)
+        return _GateFuture(self.gate, flow)
+
+
+class TestDrainLifecycle:
+    def _submit_header(self, frame):
+        return {"op": "submit", "shape": list(frame.shape),
+                "dtype": str(frame.dtype), "split": frame.nbytes,
+                "priority": "high", "iters": None,
+                "deadline": None, "trace_id": None}
+
+    def test_drain_finishes_inflight_removes_lease_fires_callback(
+            self, tmp_path):
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _GateEngine()
+        drained_cb = threading.Event()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.05,
+                           drain_timeout_s=10.0)
+        server = WorkerServer(engine, cfg,
+                              on_drained=drained_cb.set)
+        server.start(warmup=False)
+        try:
+            frame = np.zeros((8, 8, 3), np.uint8)
+            result = {}
+
+            def client():
+                hdr, body = SocketTransport().request(
+                    server.addr, self._submit_header(frame),
+                    frame.tobytes() + frame.tobytes())
+                result["hdr"] = hdr
+                result["body"] = bytes(body)
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while server.inflight < 1:
+                assert time.monotonic() < deadline, \
+                    "submit never went in-flight"
+                time.sleep(0.01)
+
+            # The drain directive over the wire: immediate ack with
+            # the in-flight count, lease flips to draining.
+            hdr, _ = SocketTransport().request(server.addr,
+                                               drain_header("test"))
+            assert hdr["status"] == "ok" and hdr["draining"] is True
+            assert hdr["inflight"] == 1
+            deadline = time.monotonic() + 5.0
+            while True:
+                lease = server.store.read_all().get("w0")
+                if lease is not None and lease.state == DRAINING:
+                    break
+                assert time.monotonic() < deadline, \
+                    "lease never flipped to draining"
+                time.sleep(0.01)
+
+            # A submit landing mid-drain gets the typed error the
+            # failover contract walks past — never an engine call.
+            n = engine.submits
+            hdr2, _ = SocketTransport().request(
+                server.addr, self._submit_header(frame),
+                frame.tobytes() + frame.tobytes())
+            assert hdr2["status"] == "error"
+            assert hdr2["error_type"] == "WorkerDraining"
+            assert engine.submits == n
+
+            # In-flight work is NOT dropped: release it, the client
+            # gets its full reply, and only then does the server die.
+            engine.gate.set()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+            assert result["hdr"]["status"] == "ok"
+            assert len(result["body"]) == 8 * 8 * 2 * 4
+
+            assert server.drained.wait(10.0), "drain never completed"
+            assert drained_cb.is_set()
+            assert server.store.read_all() == {}   # lease removed
+            assert server.inflight == 0
+        finally:
+            engine.gate.set()
+            server.stop()
+
+    def test_drain_idempotent(self, tmp_path):
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _GateEngine()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.05)
+        server = WorkerServer(engine, cfg)
+        server.start(warmup=False)
+        try:
+            assert server.drain() is True
+            assert server.drain() is False      # already draining
+            assert server.drained.wait(10.0)
+        finally:
+            server.stop()

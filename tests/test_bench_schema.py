@@ -9,7 +9,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
-from check_bench_schema import (CONTBATCH_METRIC, GATEWAY_METRIC,  # noqa: E402
+from check_bench_schema import (AUTOSCALE_METRIC,  # noqa: E402
+                                CONTBATCH_METRIC, GATEWAY_METRIC,
                                 STEP_METRIC, check_file, check_payload,
                                 main)
 
@@ -116,6 +117,28 @@ def test_checker_requires_both_step_arms():
     # An honest error record is exempt — there is no ratio to back.
     assert not check_payload("err", {
         "metric": STEP_METRIC, "value": None, "error": "boom"})
+
+
+def test_checker_requires_autoscale_audit_trail():
+    counters = {"scale_ups": 1, "graceful_drains": 1,
+                "failover_retries": 2, "completed": 140, "dropped": 0,
+                "mismatched": 0, "post_warmup_compiles": 0}
+    base = {"metric": AUTOSCALE_METRIC, "value": 1.0,
+            "unit": "graceful_drains", "platform": "cpu",
+            "smoke_operating_point": True}
+    assert not check_payload("ok", dict(base, drill=counters))
+    # Missing the drill dict, a missing counter, or a non-numeric
+    # counter: all violations — the convergence claim needs its
+    # audit trail.
+    assert check_payload("none", base)
+    partial = dict(counters)
+    del partial["post_warmup_compiles"]
+    assert check_payload("half", dict(base, drill=partial))
+    assert check_payload("shape", dict(
+        base, drill=dict(counters, dropped="0")))
+    # An honest error record is exempt.
+    assert not check_payload("err", {
+        "metric": AUTOSCALE_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_rejects_silent_empty_wrapper(tmp_path):

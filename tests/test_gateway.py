@@ -540,6 +540,269 @@ class TestSupervisor:
         assert 'gateway_worker_crash_streak{worker="w0"} 1' in txt
         assert 'gateway_worker_breaker{worker="w0"} 0' in txt
 
+    def test_directed_drain_exit0_is_not_a_crash(self, tmp_path):
+        """The autoscaler contract: expect_drain + exit 0 retires the
+        slot with NO streak, NO breaker count, NO respawn."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        assert sup.expect_drain("w0") is True
+        assert sup.status()["w0"]["draining"] is True
+        # Still alive mid-drain: supervised but reported as leaving.
+        assert sup.poll_once()["w0"] == "draining"
+        procs[0].rc = 0          # the worker finished and exited clean
+        assert sup.poll_once()["w0"] == "drained"
+        # Slot retired: no respawn ever, no crash accounting anywhere.
+        assert sup.worker_ids() == []
+        assert sup.managed_count() == 0
+        assert sup.poll_once() == {}
+        clock.advance(60.0)
+        assert sup.poll_once() == {}
+        assert len(procs) == 1   # nothing ever respawned
+
+    def test_drain_crash_retires_without_respawn(self, tmp_path):
+        """Nonzero exit mid-drain: counted as a crash (in-flight work
+        may have died) but the decommission stands — no respawn."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        self._heartbeat(store, wall)
+        sup.expect_drain("w0")
+        procs[0].rc = 1
+        assert sup.poll_once()["w0"] == "drain-crashed"
+        assert sup.worker_ids() == []
+        assert store.read_all() == {}    # corpse's lease dropped
+        clock.advance(60.0)
+        assert sup.poll_once() == {}     # still no respawn
+
+    def test_cancel_drain_restores_normal_supervision(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        sup.expect_drain("w0")
+        assert sup.cancel_drain("w0") is True
+        assert sup.status()["w0"]["draining"] is False
+        # Back under normal supervision: a death respawns as usual.
+        procs[0].rc = -9
+        assert sup.poll_once()["w0"] == "dead"
+        clock.advance(1.0)
+        assert sup.poll_once()["w0"] == "respawned"
+
+    def test_add_worker_scales_the_fleet(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        sup.add_worker(WorkerSpec("w9", {"worker_id": "w9"}))
+        assert len(procs) == 2
+        assert sup.worker_ids() == ["w0", "w9"]
+        assert sup.managed_count() == 2
+        with pytest.raises(ValueError):
+            sup.add_worker(WorkerSpec("w9", {"worker_id": "w9"}))
+        # Draining slots don't count toward fleet size by default.
+        sup.expect_drain("w9")
+        assert sup.managed_count() == 1
+        assert sup.managed_count(include_draining=True) == 2
+
+
+# -- transport hardening (real sockets, fake clock for ages) -------------
+
+class _EchoServer:
+    """Minimal frame echo peer for transport tests; connections can be
+    killed under the pool's feet, and ``blackhole=True`` accepts frames
+    without ever replying (the partition shape)."""
+
+    def __init__(self, blackhole=False):
+        self.blackhole = blackhole
+        self.listener = socket.socket()
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(16)
+        self.addr = self.listener.getsockname()
+        self.conns = []
+        self._stop = threading.Event()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                return
+            self.conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = read_message(conn)
+                if msg is None:
+                    return
+                if self.blackhole:
+                    self._stop.wait(30.0)
+                    return
+                hdr, body = msg
+                write_message(conn, {"status": "ok", "echo": hdr},
+                              bytes(body))
+        except (ProtocolError, OSError):
+            pass
+
+    def kill_conns(self):
+        for c in self.conns:
+            try:
+                # shutdown (not just close): the serve thread holds the
+                # fd in a blocked recv, which would defer the FIN.
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.conns.clear()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        self.kill_conns()
+
+
+@pytest.fixture
+def echo():
+    server = _EchoServer()
+    yield server
+    server.close()
+
+
+class TestTransportHardening:
+    def test_keepalive_enabled_on_new_conns(self, echo):
+        tr = SocketTransport()
+        sock = tr._new_conn(echo.addr)
+        try:
+            assert sock.getsockopt(socket.SOL_SOCKET,
+                                   socket.SO_KEEPALIVE) == 1
+        finally:
+            sock.close()
+
+    def test_pool_reuse_and_idle_count(self, echo):
+        tr = SocketTransport()
+        try:
+            tr.request(echo.addr, {"op": "ping"})
+            assert tr.idle_count(echo.addr) == 1
+            tr.request(echo.addr, {"op": "ping"})
+            assert tr.idle_count(echo.addr) == 1   # same sock reused
+            assert len(echo.conns) == 1            # one TCP connect
+            assert tr.dead_checkouts == 0
+        finally:
+            tr.close()
+
+    def test_pool_bound_evicts_oldest(self, echo):
+        tr = SocketTransport(max_idle_per_addr=2)
+        socks = [tr._new_conn(echo.addr) for _ in range(3)]
+        for s in socks:
+            tr._checkin(echo.addr, s)
+        assert tr.idle_count(echo.addr) == 2
+        assert tr.evicted_idle == 1
+        # The OLDEST was the one evicted (closed): its fd is dead.
+        assert socks[0].fileno() == -1
+        tr.close()
+        assert tr.idle_count() == 0
+
+    def test_idle_age_eviction_fake_clock(self, echo):
+        clock = FakeClock()
+        tr = SocketTransport(max_idle_age_s=30.0, clock=clock)
+        try:
+            tr.request(echo.addr, {"op": "ping"})
+            assert tr.idle_count(echo.addr) == 1
+            clock.advance(31.0)
+            # The pooled socket aged out at checkout; a fresh connect
+            # serves the request — no stale socket ever written to.
+            tr.request(echo.addr, {"op": "ping"})
+            assert tr.evicted_idle == 1
+            assert len(echo.conns) == 2
+        finally:
+            tr.close()
+
+    def test_dead_pooled_socket_caught_by_probe(self, echo):
+        tr = SocketTransport()
+        try:
+            tr.request(echo.addr, {"op": "ping"})
+            echo.kill_conns()      # peer closes under the pool's feet
+            time.sleep(0.05)       # let the FIN land
+            hdr, _ = tr.request(echo.addr, {"op": "ping"})
+            assert hdr["status"] == "ok"
+            assert tr.dead_checkouts == 1
+            assert tr.reconnects == 0   # probe caught it pre-write
+        finally:
+            tr.close()
+
+    def test_transparent_reconnect_on_stale_pool_injection(self, echo):
+        """The probe-passes-then-write-fails race, forced by the
+        RAFT_FAULT_GATEWAY_STALE_POOL injector: exactly one transparent
+        reconnect, the request succeeds, no failover burned."""
+        from raft_tpu import resilience
+
+        tr = SocketTransport()
+        prev = resilience.set_injector(
+            resilience.FaultInjector(gateway_stale_pool=1))
+        try:
+            tr.request(echo.addr, {"op": "ping"})
+            hdr, _ = tr.request(echo.addr, {"op": "ping"})
+            assert hdr["status"] == "ok"
+            assert tr.reconnects == 1
+            # The injection budget is spent: steady state after.
+            hdr, _ = tr.request(echo.addr, {"op": "ping"})
+            assert hdr["status"] == "ok"
+            assert tr.reconnects == 1
+        finally:
+            resilience.set_injector(prev)
+            tr.close()
+
+    def test_close_addr_drops_only_that_pool(self, echo):
+        other = _EchoServer()
+        tr = SocketTransport()
+        try:
+            tr.request(echo.addr, {"op": "ping"})
+            tr.request(other.addr, {"op": "ping"})
+            assert tr.idle_count() == 2
+            tr.close_addr(echo.addr)
+            assert tr.idle_count(echo.addr) == 0
+            assert tr.idle_count(other.addr) == 1
+        finally:
+            tr.close()
+            other.close()
+
+    def test_hop_stall_is_retryable_not_timeout(self):
+        """A worker that accepts then never replies: with client
+        budget remaining the per-hop stall deadline raises
+        WorkerConnectionError (failover), NOT RequestTimedOut."""
+        hole = _EchoServer(blackhole=True)
+        tr = SocketTransport(hop_timeout_s=0.15)
+        try:
+            with pytest.raises(WorkerConnectionError):
+                tr.request(hole.addr, {"op": "ping"},
+                           deadline=time.monotonic() + 30.0)
+        finally:
+            tr.close()
+            hole.close()
+
+    def test_exhausted_deadline_mid_read_is_timeout(self):
+        hole = _EchoServer(blackhole=True)
+        tr = SocketTransport()     # no hop timeout: budget rules
+        try:
+            with pytest.raises(RequestTimedOut):
+                tr.request(hole.addr, {"op": "ping"},
+                           deadline=time.monotonic() + 0.2)
+        finally:
+            tr.close()
+            hole.close()
+
 
 # -- worker protocol (stub engine, real sockets) -------------------------
 
@@ -638,6 +901,64 @@ class TestWorkerProtocol:
         assert engine.submits[0]["deadline_s"] == pytest.approx(
             deadline)
 
+    def test_slow_client_read_deadline_reaps_connection(self, tmp_path):
+        """A connection that goes quiet mid-session is reaped by the
+        per-connection read deadline — one wedged client can't pin a
+        worker handler thread forever."""
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _StubEngine()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.05,
+                           conn_read_timeout_s=0.2)
+        server = WorkerServer(engine, cfg).start(warmup=False)
+        try:
+            sock = socket.create_connection(server.addr, timeout=5.0)
+            sock.settimeout(5.0)
+            # Send nothing: the worker must close the connection on us.
+            assert sock.recv(1) == b""
+            sock.close()
+            deadline = time.monotonic() + 5.0
+            while (server.slow_client_drops < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert server.slow_client_drops == 1
+            # Healthy clients are unaffected.
+            hdr, _ = SocketTransport().request(server.addr,
+                                               {"op": "ping"})
+            assert hdr["status"] == "ok"
+        finally:
+            server.stop()
+
+    def test_partition_injection_stalls_then_recovers(self, stub_worker):
+        """RAFT_FAULT_WORKER_PARTITION_S: the worker accepts the frame
+        then blackholes. The gateway's per-hop stall deadline converts
+        the silence into a retryable WorkerConnectionError (never
+        RequestTimedOut with budget left); after the window the worker
+        serves normally."""
+        from raft_tpu import resilience
+
+        server, engine = stub_worker
+        frame = np.zeros((8, 8, 3), np.uint8)
+        tr = SocketTransport(hop_timeout_s=0.1)
+        prev = resilience.set_injector(
+            resilience.FaultInjector(worker_partition_s=0.4))
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(WorkerConnectionError):
+                tr.request(server.addr, self._submit_header(frame),
+                           frame.tobytes() + frame.tobytes(),
+                           deadline=t0 + 30.0)
+            time.sleep(0.5)          # let the partition window lapse
+            hdr, _ = tr.request(server.addr,
+                                self._submit_header(frame),
+                                frame.tobytes() + frame.tobytes(),
+                                deadline=time.monotonic() + 30.0)
+            assert hdr["status"] == "ok"
+        finally:
+            resilience.set_injector(prev)
+            tr.close()
+
     def test_lease_published_with_heartbeats(self, stub_worker):
         server, _ = stub_worker
         store = server.store
@@ -717,3 +1038,21 @@ def test_gateway_drill_subprocess():
     assert proc.returncode == 0, \
         f"drill failed:\n{proc.stdout}\n{proc.stderr}"
     assert "PASS drill_gateway" in proc.stdout
+
+
+@pytest.mark.slow
+def test_autoscale_drill_subprocess():
+    """Self-healing capacity end to end: burst -> scale-up through
+    warming (brownout covering), partition-injected failover, graceful
+    drain back to min_workers. Slow-marked — spawns real interpreters
+    and warms engines on both the incumbent and the scaled-up worker."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RAFT_BENCH_OUT", None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "serve_drill.py"),
+         "--drill", "autoscale"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"drill failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "PASS drill_autoscale" in proc.stdout

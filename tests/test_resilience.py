@@ -195,6 +195,48 @@ def test_fault_injector_socket_drop_burns_budget():
     assert off.worker_socket_drop == 1
 
 
+def test_fault_injector_new_serving_knobs(monkeypatch):
+    monkeypatch.setenv("RAFT_FAULT_WORKER_PARTITION_S", "2.5")
+    monkeypatch.setenv("RAFT_FAULT_GATEWAY_STALE_POOL", "2")
+    inj = FaultInjector.from_env()
+    assert inj.worker_partition_s == 2.5
+    assert inj.gateway_stale_pool == 2
+    assert inj.active
+    assert FaultInjector(worker_partition_s=1.0).active
+    assert FaultInjector(gateway_stale_pool=1).active
+    # Partition is one-shot (the worker holds the window itself);
+    # stale-pool is a per-checkout budget.
+    assert inj.take_worker_partition() == 2.5
+    assert inj.take_worker_partition() == 0.0
+    assert inj.maybe_stale_pool() is True
+    assert inj.maybe_stale_pool() is True
+    assert inj.maybe_stale_pool() is False
+    off = FaultInjector(worker_partition_s=1.0, gateway_stale_pool=1,
+                        target_process=jax.process_index() + 1)
+    assert off.take_worker_partition() == 0.0
+    assert off.maybe_stale_pool() is False
+
+
+def test_fault_knob_docstring_matches_from_env():
+    """Consistency lint: every RAFT_FAULT_* knob documented in the
+    FaultInjector docstring is parsed by from_env, and every knob
+    from_env parses is documented. A knob added on one side only is a
+    silent no-op waiting to burn a drill."""
+    import inspect
+    import re
+
+    pat = re.compile(r"RAFT_FAULT_[A-Z0-9_]+")
+    documented = set(pat.findall(FaultInjector.__doc__ or ""))
+    parsed = set(pat.findall(inspect.getsource(FaultInjector.from_env)))
+    assert documented, "FaultInjector docstring lists no knobs?"
+    missing_parse = documented - parsed
+    missing_docs = parsed - documented
+    assert not missing_parse, \
+        f"documented but never parsed by from_env: {missing_parse}"
+    assert not missing_docs, \
+        f"parsed by from_env but undocumented: {missing_docs}"
+
+
 # -- checkpoint hardening -----------------------------------------------
 
 
