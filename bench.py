@@ -1888,6 +1888,225 @@ def _gateway_failure(msg: str) -> None:
            "error": msg})
 
 
+EDGE_METRIC = "edge_vs_inprocess_p50_latency_overhead_ms"
+
+
+def edge_main(arm: str = "ab"):
+    """``python bench.py serving --edge {ab,on,off}`` — the HTTP front
+    door's toll on a client request (BENCH_edge).
+
+    Both arms run the SAME predictor, engine config, frames, and
+    closed-loop concurrency. The ``in_process`` arm submits straight to
+    a :class:`~raft_tpu.serving.engine.ServingEngine`; the ``edge`` arm
+    serves the same engine behind a :class:`~raft_tpu.serving.worker
+    .WorkerServer` socket, routes through a :class:`~raft_tpu.serving
+    .gateway.ServingGateway`, and fronts THAT with the
+    :class:`~raft_tpu.serving.edge.EdgeServer` — real HTTP/1.1 clients
+    (``submit_flow``) doing admission, header parsing, body staging and
+    response encoding per request. The headline is client-observed p50
+    through the full edge stack minus in-process p50, in ms — what
+    putting the hardened front door (plus the gateway hop it sits on)
+    in front of a request actually costs. ``on``/``off`` run one arm.
+
+    Honesty contract: every response in BOTH arms is checked bit-exact
+    against same-executable references, and both arms serve with ZERO
+    post-warmup compiles."""
+    import dataclasses
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.evaluate import load_predictor
+    from raft_tpu.serving import ServingConfig, ServingEngine, loadgen
+    from raft_tpu.serving import edge as edge_mod
+    from raft_tpu.serving.gateway import GatewayConfig, ServingGateway
+    from raft_tpu.serving.metrics import CompileWatch, _percentile
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+    platform = jax.devices()[0].platform
+    ncores = os.cpu_count() or 1
+    if platform == "tpu":
+        shapes = [(436, 1024)]
+        small, iters = False, ITERS
+        max_batch, concurrency, n_requests = 16, 16, 128
+        max_wait_ms = 5.0
+    else:
+        shapes = [(64, 96), (61, 93)]     # two raws, one padded bucket
+        small, iters = True, 2
+        max_batch, concurrency, n_requests = 4, 8, 48
+        max_wait_ms = 3.0
+
+    predictor = load_predictor("random", small=small, iters=iters)
+    frames = loadgen.make_frames(shapes, per_shape=2, seed=0)
+    refs = loadgen.batched_reference_flows(frames=frames,
+                                           predictor=predictor,
+                                           max_batch=max_batch)
+    cfg = ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        buckets=tuple(shapes), persistent_cache=True)
+
+    def _run_in_process() -> dict:
+        engine = ServingEngine(predictor, cfg)
+        t0 = time.perf_counter()
+        engine.warmup()
+        warm_s = round(time.perf_counter() - t0, 3)
+        engine.start(warmup=False)
+        try:
+            with CompileWatch() as watch:
+                res = loadgen.run_load(
+                    engine, frames, n_requests=n_requests,
+                    concurrency=concurrency, references=refs,
+                    timeout=600.0)
+        finally:
+            engine.close()
+        client = next(iter(res["per_replica"].values()))["latency_ms"]
+        return {
+            "completed": res["completed"],
+            "dropped": len(res["dropped"]),
+            "mismatched": len(res["mismatched"]),
+            "p50_ms": round(client["p50"], 3),
+            "p99_ms": round(client["p99"], 3),
+            "throughput_rps": round(res["throughput_rps"], 3),
+            "post_warmup_compiles": watch.compiles,
+            "warmup_seconds": warm_s,
+        }
+
+    def _run_edge_http(addr) -> dict:
+        """Closed-loop HTTP clients against the edge; latency is the
+        full submit_flow round trip (the number a caller feels)."""
+        lock = threading.Lock()
+        it = iter(range(n_requests))
+        lat_ms, mismatched, dropped = [], [], []
+
+        def client():
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                fi = i % len(frames)
+                im1, im2 = frames[fi]
+                t0 = time.perf_counter()
+                resp = edge_mod.submit_flow(addr, im1, im2,
+                                            timeout=600.0)
+                dt = (time.perf_counter() - t0) * 1e3
+                if resp is None or resp.status != 200:
+                    with lock:
+                        dropped.append(i)
+                    continue
+                flow = edge_mod.decode_flow(resp)
+                with lock:
+                    lat_ms.append(dt)
+                    if not np.array_equal(flow, refs[fi]):
+                        mismatched.append(i)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900.0)
+        wall = time.perf_counter() - t0
+        return {
+            "completed": len(lat_ms),
+            "dropped": len(dropped),
+            "mismatched": len(mismatched),
+            "p50_ms": round(_percentile(lat_ms, 50), 3),
+            "p99_ms": round(_percentile(lat_ms, 99), 3),
+            "throughput_rps": round(len(lat_ms) / wall, 3),
+        }
+
+    def _run_edge(lease_dir: str) -> dict:
+        store = FileLeaseStore(lease_dir)
+        engine = ServingEngine(predictor, dataclasses.replace(
+            cfg, replica_id="w0"))
+        server = WorkerServer(
+            engine,
+            WorkerConfig(worker_id="w0", lease_dir=lease_dir,
+                         heartbeat_interval_s=0.2,
+                         buckets=tuple(shapes), max_batch=max_batch,
+                         max_wait_ms=max_wait_ms, step=0),
+            lease_store=store)
+        t0 = time.perf_counter()
+        server.start(warmup=True)
+        warm_s = round(time.perf_counter() - t0, 3)
+        gw = ServingGateway(store, GatewayConfig(
+            queue_timeout_ms=600_000, lease_ttl_s=2.0,
+            poll_interval_s=0.1, dispatch_threads=concurrency,
+            expected_step=0))
+        es = None
+        try:
+            gw.start()
+            t_join = time.monotonic() + 120.0
+            while not gw.live_workers():
+                if time.monotonic() > t_join:
+                    raise RuntimeError("worker never became routable")
+                time.sleep(0.05)
+            es = edge_mod.EdgeServer(gw).start_in_thread()
+            with CompileWatch() as watch:
+                rec = _run_edge_http(es.addr)
+            lease = store.read_all().get("w0")
+            rec["post_warmup_compiles"] = watch.compiles
+            rec["warmup_seconds"] = warm_s
+            rec["worker_lease_compiles"] = (
+                lease.extra.get("post_warmup_compiles")
+                if lease is not None else None)
+        finally:
+            if es is not None:
+                es.shutdown_sync()     # closes the gateway too
+            else:
+                gw.close()
+            server.stop()
+        return rec
+
+    per_arm = {}
+    if arm in ("ab", "off"):
+        per_arm["in_process"] = _run_in_process()
+    if arm in ("ab", "on"):
+        with tempfile.TemporaryDirectory() as lease_dir:
+            per_arm["edge"] = _run_edge(lease_dir)
+
+    overhead = None
+    if "in_process" in per_arm and "edge" in per_arm:
+        overhead = round(per_arm["edge"]["p50_ms"]
+                         - per_arm["in_process"]["p50_ms"], 3)
+    payload = {
+        "metric": EDGE_METRIC,
+        "value": overhead,
+        "unit": "ms",
+        "platform": platform,
+        "host_cores": ncores,
+        "model": "raft-small" if small else "raft-large",
+        "iters": iters,
+        "shapes": [list(s) for s in shapes],
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "edge_arm": arm,
+        "per_arm": per_arm,
+    }
+    if platform != "tpu":
+        payload["smoke_operating_point"] = True
+        payload["criterion_note"] = (
+            "both arms run the same small-model executables on this "
+            f"{ncores}-core {platform} host, so the p50 DELTA isolates "
+            "the HTTP front door stacked on the local-socket gateway "
+            "hop (admission, header parse, body staging, response "
+            "encoding) at a smoke operating point; absolute latencies "
+            "are smoke numbers, and the flagship-shape on-TPU capture "
+            "is tracked as ROADMAP debt")
+    _emit(payload)
+
+
+def _edge_failure(msg: str) -> None:
+    _emit({"metric": EDGE_METRIC, "value": None, "unit": "ms",
+           "error": msg})
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "streaming":
         try:
@@ -1959,6 +2178,15 @@ if __name__ == "__main__":
                                  "records the p50 latency overhead "
                                  "(the BENCH_gateway artifact); "
                                  "'on'/'off' run one arm")
+            ap.add_argument("--edge", choices=("ab", "on", "off"),
+                            default=None,
+                            help="HTTP front-door benchmark instead of "
+                                 "the throughput benchmark: 'ab' serves "
+                                 "the same load in-process and through "
+                                 "the full edge -> gateway -> worker "
+                                 "stack over real HTTP and records the "
+                                 "p50 latency overhead (the BENCH_edge "
+                                 "artifact); 'on'/'off' run one arm")
             ap.add_argument("--trace", action="store_true",
                             help="record a request-scoped trace of the "
                                  "benchmark run and ship its path as "
@@ -1966,6 +2194,14 @@ if __name__ == "__main__":
                                  "(Perfetto-loadable Chrome trace "
                                  "JSON)")
             args = ap.parse_args(sys.argv[2:])
+            if args.edge is not None:
+                try:
+                    edge_main(arm=args.edge)
+                except SystemExit:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    _edge_failure(f"{type(e).__name__}: {e}")
+                sys.exit(0)
             if args.gateway is not None:
                 try:
                     gateway_main(arm=args.gateway)

@@ -327,6 +327,20 @@ class FaultInjector:
       per-hop stall deadline (``hop_timeout_s``) can detect it and
       fail the request over to the next owner. The
       alive-to-membership, dead-to-traffic failure mode.
+    * ``RAFT_FAULT_EDGE_SLOWLORIS_S=S`` — ONE HTTP edge client
+      connection turns slowloris: the request bytes are trickled one
+      byte per S-second interval instead of sent whole, so the only
+      defense is the edge's header read deadline
+      (``EdgeConfig.header_read_timeout_s``) reaping the connection.
+      Consumed by the edge HTTP client helper
+      (:func:`raft_tpu.serving.edge.http_request`); one-shot like the
+      heartbeat stall.
+    * ``RAFT_FAULT_EDGE_CLIENT_ABORT_NTH=N`` — the Nth HTTP edge
+      request the client helper sends under this injector (1-based)
+      disconnects right after the request bytes, before any response
+      — the client-gone-mid-response fault the edge must absorb
+      without poisoning the gateway or leaking the in-flight slot.
+      Fires once.
     * ``RAFT_FAULT_GATEWAY_STALE_POOL=N`` — the gateway's next N
       pooled-connection checkouts hand back a socket that was just
       shut down under the checkout probe's nose, simulating a worker
@@ -356,6 +370,8 @@ class FaultInjector:
     worker_socket_drop: int = 0
     worker_partition_s: float = 0.0
     gateway_stale_pool: int = 0
+    edge_slowloris_s: float = 0.0
+    edge_client_abort_nth: int = 0
     target_process: Optional[int] = None
 
     @staticmethod
@@ -388,6 +404,11 @@ class FaultInjector:
                 os.environ.get("RAFT_FAULT_WORKER_PARTITION_S", "0")),
             gateway_stale_pool=int(
                 os.environ.get("RAFT_FAULT_GATEWAY_STALE_POOL", "0")),
+            edge_slowloris_s=float(
+                os.environ.get("RAFT_FAULT_EDGE_SLOWLORIS_S", "0")),
+            edge_client_abort_nth=int(
+                os.environ.get("RAFT_FAULT_EDGE_CLIENT_ABORT_NTH",
+                               "0")),
             target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
@@ -499,6 +520,32 @@ class FaultInjector:
             return True
         return False
 
+    def take_edge_slowloris(self) -> float:
+        """One-shot: the first call on the target process returns the
+        configured trickle interval in seconds (the edge HTTP client
+        helper sends its next request ONE BYTE per interval, never
+        completing the header frame); later calls return 0. The edge's
+        header read deadline is the only thing that can free the
+        connection — exactly the slow-client window
+        ``WorkerServer.conn_read_timeout_s`` covers on the binary
+        protocol."""
+        if self.edge_slowloris_s > 0 and self._on_target():
+            interval = self.edge_slowloris_s
+            self.edge_slowloris_s = 0.0
+            return interval
+        return 0.0
+
+    def aborts_edge_client(self, send_seq: int) -> bool:
+        """Whether the ``send_seq``-th edge HTTP request sent under
+        this injector (1-based; the helper keeps the counter on the
+        injector instance) should disconnect right after the request
+        bytes, before reading any response — the client that hangs up
+        while its answer is being computed. Fires once: the edge must
+        count the abort, release the admission slot, and leave the
+        gateway future to resolve harmlessly."""
+        return (self.edge_client_abort_nth > 0 and self._on_target()
+                and send_seq == self.edge_client_abort_nth)
+
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
@@ -516,7 +563,9 @@ class FaultInjector:
                     or self.worker_heartbeat_stall_s
                     or self.worker_socket_drop
                     or self.worker_partition_s
-                    or self.gateway_stale_pool)
+                    or self.gateway_stale_pool
+                    or self.edge_slowloris_s
+                    or self.edge_client_abort_nth)
 
 
 _ACTIVE: Optional[FaultInjector] = None

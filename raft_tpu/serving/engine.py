@@ -457,6 +457,10 @@ class ServingConfig:
         Prometheus text, ``/metrics.json``). ``0`` binds an ephemeral
         port (see ``ServingEngine.metrics_server``); ``None`` (default)
         starts no server.
+      metrics_host: bind host for the telemetry HTTP server. Loopback
+        by default; a multi-host deployment that scrapes workers
+        off-box sets an interface address (or ``"0.0.0.0"``) here —
+        the same bind-host story as the serving edge listener.
       slo_ms: per-priority-class latency objectives,
         ``(("high", 50.0), ("low", 250.0))``-style. When non-empty the
         engine feeds every completion into an
@@ -494,6 +498,7 @@ class ServingConfig:
     trace: bool = False
     trace_capacity: int = 65536
     metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
     slo_ms: Tuple[Tuple[str, float], ...] = ()
 
 
@@ -905,7 +910,8 @@ class ServingEngine:
         self.metrics_server = None
         if config.metrics_port is not None:
             self.metrics_server = obs_registry.start_http_server(
-                self.registry, config.metrics_port)
+                self.registry, config.metrics_port,
+                host=config.metrics_host)
 
     # -- trace plumbing -------------------------------------------------
     #

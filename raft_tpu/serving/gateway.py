@@ -568,6 +568,12 @@ class ServingGateway:
         for wid, lease in leases.items():
             state = (lease.state if lease.fresh(ttl, now)
                      else health_mod.STALE)
+            if not lease.has_routable_addr():
+                # Routable-to-nowhere (missing addr / port 0): treat
+                # like an expired lease whatever the state says.
+                # ``Lease.from_json`` already coerces this on the wire;
+                # this covers in-memory stores too.
+                state = health_mod.STALE
             states[wid] = state
             in_sync = (self.config.expected_step is None
                        or lease.step == self.config.expected_step)
@@ -618,14 +624,22 @@ class ServingGateway:
     def submit(self, image1: np.ndarray, image2: np.ndarray,
                priority: str = PRIORITY_HIGH,
                iters: Optional[int] = None,
-               trace_id: Optional[int] = None
+               trace_id: Optional[int] = None,
+               deadline: Optional[float] = None
                ) -> concurrent.futures.Future:
         """Enqueue one request; returns a future resolving to the
         unpadded ``(H, W, 2)`` float32 flow, bit-identical to any
         single worker's answer. Wire detection + serialization happen
         here, in the caller's thread (the same cost split as the
         engine's padding): uint8-eligible frames cross the socket at
-        1 byte/channel. Thread-safe."""
+        1 byte/channel. Thread-safe.
+
+        ``deadline`` is an ABSOLUTE monotonic deadline (the gateway's
+        ``clock`` domain) supplied by a caller that already holds the
+        client's budget — the HTTP edge converts ``X-Deadline-Ms``
+        exactly once and passes it here so one budget is enforced at
+        every hop. ``None`` (default) derives the deadline from
+        ``config.queue_timeout_ms`` as before."""
         if self._closed:
             raise RuntimeError("gateway is closed")
         self.metrics.record_request()
@@ -634,8 +648,10 @@ class ServingGateway:
                              factor=self.config.factor).padded_shape
         key = owners_key(padded, iters)
         t_submit = self._clock()
-        timeout_ms = self.config.queue_timeout_ms
-        deadline = (t_submit + timeout_ms / 1e3) if timeout_ms else None
+        if deadline is None:
+            timeout_ms = self.config.queue_timeout_ms
+            deadline = ((t_submit + timeout_ms / 1e3) if timeout_ms
+                        else None)
         fut: concurrent.futures.Future = concurrent.futures.Future()
         fut.replica_id = None
         tr = self._tracer

@@ -183,10 +183,32 @@ class Lease:
     @staticmethod
     def from_json(raw: str) -> "Lease":
         d = json.loads(raw)
-        d["addr"] = tuple(d.get("addr", ("127.0.0.1", 0)))
+        addr = tuple(d.get("addr") or ("", 0))
+        d["addr"] = addr
         d["buckets"] = tuple(tuple(b) for b in d.get("buckets", ()))
         known = {f.name for f in dataclasses.fields(Lease)}
-        return Lease(**{k: v for k, v in d.items() if k in known})
+        lease = Lease(**{k: v for k, v in d.items() if k in known})
+        if not lease.has_routable_addr():
+            # A lease without a dialable address is routable-to-nowhere:
+            # port 0 is never a listening socket and an empty host has
+            # no destination. Mark it STALE-style (the membership
+            # plane's "unproven" state) rather than letting the gateway
+            # route requests at it. The raw self-reported state is
+            # preserved under ``extra`` for debugging.
+            lease.extra = dict(lease.extra)
+            lease.extra.setdefault("unroutable_addr_state", lease.state)
+            lease.state = "stale"   # == health.STALE (append-only code 7)
+        return lease
+
+    def has_routable_addr(self) -> bool:
+        """Whether ``addr`` names a dialable endpoint: a non-empty host
+        and a nonzero port. ``port=0`` is the ephemeral-bind wildcard —
+        meaningful to ``bind()``, never to ``connect()``."""
+        try:
+            host, port = self.addr[0], int(self.addr[1])
+        except (IndexError, TypeError, ValueError):
+            return False
+        return bool(host) and port != 0
 
 
 class FileLeaseStore:

@@ -230,6 +230,67 @@ class WorkerSupervisor:
                 except OSError:
                     pass
 
+    def drain_fleet(self, transport, timeout_s: float = 30.0,
+                    reason: str = "coordinated shutdown"
+                    ) -> Dict[str, str]:
+        """Directed decommission of EVERY managed worker — the final
+        leg of the coordinated SIGTERM path (edge stops accepting →
+        gateway closes → workers drain). Each worker is marked with
+        :meth:`expect_drain` BEFORE its :data:`~raft_tpu.serving
+        .netproto.OP_DRAIN` directive is sent (its ack-and-exit-0 may
+        beat the next poll), then the fleet is waited on until every
+        process exited or ``timeout_s`` elapsed; stragglers are
+        killed — a wedged drain must not leak processes. Returns
+        ``{worker_id: "drained" | "drain-failed" | "killed" |
+        "not-running"}``."""
+        from raft_tpu.serving import netproto
+
+        with self._lock:
+            targets = {wid: st.proc for wid, st in self._workers.items()}
+        leases = self.store.read_all()
+        out: Dict[str, str] = {}
+        deadline = self._clock() + timeout_s
+        for wid, proc in sorted(targets.items()):
+            if proc is None or proc.poll() is not None:
+                out[wid] = "not-running"
+                continue
+            lease = leases.get(wid)
+            self.expect_drain(wid)
+            try:
+                if lease is None or not lease.has_routable_addr():
+                    raise RuntimeError(f"no routable lease for {wid}")
+                reply = transport.request(
+                    tuple(lease.addr),
+                    netproto.drain_header(reason=reason),
+                    deadline=deadline, clock=self._clock)
+                hdr = reply[0] if isinstance(reply, tuple) else reply
+                if not (isinstance(hdr, dict) and hdr.get("draining")):
+                    raise RuntimeError(f"drain not acked: {hdr!r}")
+                out[wid] = "drained"
+            except Exception as e:
+                # The mark STAYS: the decommission decision stands and
+                # a respawn here would resurrect what shutdown is
+                # retiring; the straggler sweep below kills the
+                # process instead.
+                logger.warning("drain directive to %s failed: %s",
+                               wid, e)
+                out[wid] = "drain-failed"
+        # Wait out the acked drains (in-flight work finishing), then
+        # sweep stragglers.
+        while self._clock() < deadline:
+            if all(proc is None or proc.poll() is not None
+                   for proc in targets.values()):
+                break
+            time.sleep(0.05)
+        for wid, proc in targets.items():
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                out[wid] = "killed"
+        return out
+
     # -- the supervision loop --------------------------------------------
 
     def poll_once(self) -> Dict[str, str]:

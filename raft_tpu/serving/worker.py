@@ -80,6 +80,17 @@ logger = logging.getLogger(__name__)
 KILLED_BY_INJECTION = 17
 
 
+def _is_loopback(host: str) -> bool:
+    """Whether ``host`` names the loopback interface. An empty string
+    and ``0.0.0.0`` are wildcard binds — reachable on every interface,
+    so NOT loopback for the advertise-refusal rule."""
+    if not host:
+        return False
+    if host in ("localhost", "::1"):
+        return True
+    return host.startswith("127.")
+
+
 @dataclasses.dataclass
 class WorkerConfig:
     """One worker process's spec — everything needed to build its
@@ -91,6 +102,17 @@ class WorkerConfig:
     lease_dir: str
     host: str = "127.0.0.1"
     port: int = 0                   # 0 = ephemeral; published via lease
+    # Multi-host bind: ``bind_host`` is the interface the listener
+    # binds (falls back to ``host``); ``advertise_host`` is what the
+    # lease publishes for the gateway to dial. They differ exactly when
+    # the bound interface is not the dialable one (``0.0.0.0``
+    # wildcard, NAT, container bridge). A non-loopback bind WITHOUT an
+    # explicit advertise_host is refused at start: the listener would
+    # be reachable off-box while its lease advertises an address other
+    # hosts cannot resolve to it — routable-to-nowhere by construction.
+    # Loopback defaults keep the single-host posture unchanged.
+    bind_host: str = ""
+    advertise_host: str = ""
     heartbeat_interval_s: float = 0.5
     buckets: Tuple[Tuple[int, int], ...] = ()
     max_batch: int = 4
@@ -184,12 +206,24 @@ class WorkerServer:
         must be fresh DURING warmup (slow compile != death) but the
         state stays unroutable until the engine is actually ready —
         the supervisor's rejoin gate reads exactly this sequence."""
+        bind_host = self.config.bind_host or self.config.host
+        advertise = self.config.advertise_host
+        if not _is_loopback(bind_host) and not advertise:
+            raise ValueError(
+                f"worker {self.config.worker_id!r}: non-loopback "
+                f"bind_host {bind_host!r} requires an explicit "
+                "advertise_host — the lease must publish an address "
+                "other hosts can actually dial")
         ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        ls.bind((self.config.host, self.config.port))
+        ls.bind((bind_host, self.config.port))
         ls.listen(64)
         self._listener = ls
-        self.addr = ls.getsockname()[:2]
+        bound_host, bound_port = ls.getsockname()[:2]
+        # The lease advertises the dialable address, not the bound one:
+        # a 0.0.0.0 wildcard bind is meaningful to bind(), never to
+        # connect().
+        self.addr = (advertise or bound_host, bound_port)
         hb = threading.Thread(target=self._heartbeat_loop,
                               name=f"{self.config.worker_id}-heartbeat",
                               daemon=True)

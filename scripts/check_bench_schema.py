@@ -57,10 +57,15 @@ GATEWAY_METRIC = "gateway_vs_inprocess_p50_latency_overhead_ms"
 GATEWAY_ARMS = ("in_process", "gateway")
 STEP_METRIC = "fused_step_vs_chained_pairs_per_sec_speedup"
 STEP_ARMS = ("fused", "chained")
+# edge is the HTTP front door's toll claim: the same load served
+# in-process vs through edge -> gateway -> worker over real HTTP.
+EDGE_METRIC = "edge_vs_inprocess_p50_latency_overhead_ms"
+EDGE_ARMS = ("in_process", "edge")
 AB_METRICS = {
     CONTBATCH_METRIC: ("contbatch", CONTBATCH_ARMS),
     GATEWAY_METRIC: ("gateway", GATEWAY_ARMS),
     STEP_METRIC: ("step", STEP_ARMS),
+    EDGE_METRIC: ("edge", EDGE_ARMS),
 }
 
 # The autoscale drill's artifact is a contract record, not a speedup

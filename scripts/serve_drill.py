@@ -102,6 +102,19 @@
     ≥1 failover retry, and 0 post-warmup compiles on every survivor,
     with the autoscaler's decision gauges live in the registry export.
 
+13. edge (``--drill edge``) — the hardened HTTP front door: concurrent
+    HTTP/1.1 clients drive edge → gateway → 3 worker processes (one
+    bound ``0.0.0.0`` with an advertised non-loopback address, pinged
+    routable by the gateway's own transport) through a mid-load worker
+    SIGKILL, an injected slowloris (``RAFT_FAULT_EDGE_SLOWLORIS_S`` —
+    the edge's header-read deadline reaps the trickling connection and
+    the absorbed client retries clean) and an injected client abort
+    (``RAFT_FAULT_EDGE_CLIENT_ABORT_NTH`` — no poison downstream).
+    Gate: 0 dropped, 0 bit-incorrect, 0 post-warmup compiles, edge
+    counters live in the Prometheus export, and a SIGTERM drains
+    edge → gateway → workers IN ORDER with ``/readyz`` answering 503
+    while the listener is still open (the load-balancer grace window).
+
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
 bit-identical; under a forced multi-device topology
@@ -1869,6 +1882,266 @@ def drill_autoscale(root):
         sup.stop(kill_workers=True)
 
 
+def _detect_nonloopback_ip():
+    """An address of a real (non-loopback) local interface, or None.
+    UDP connect() picks the egress interface without sending a byte."""
+    import socket as socket_mod
+    try:
+        s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+        try:
+            s.connect(("192.0.2.1", 9))     # TEST-NET-1, never routed
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return None
+    return None if ip.startswith("127.") or ip == "0.0.0.0" else ip
+
+
+def _run_edge_load(edge_addr, frames, refs, n_requests, concurrency):
+    """Drive the HTTP edge with concurrent clients; every request must
+    eventually serve bit-exactly. Injected hostile-client behavior
+    (slowloris absorption, client abort) is counted and RETRIED — the
+    gate is that retries converge, not that the network was polite."""
+    from raft_tpu.serving import edge as edge_mod
+
+    res = {"completed": 0, "dropped": [], "mismatched": [],
+           "retries": 0, "slowloris_absorbed": 0, "aborts": 0}
+    lock = threading.Lock()
+    it = iter(range(n_requests))
+
+    def client():
+        while True:
+            with lock:
+                i = next(it, None)
+            if i is None:
+                return
+            fi = i % len(frames)
+            im1, im2 = frames[fi]
+            for _attempt in range(12):
+                try:
+                    resp = edge_mod.submit_flow(edge_addr, im1, im2,
+                                                timeout=300.0)
+                except edge_mod.ClientAbortInjected:
+                    with lock:
+                        res["aborts"] += 1
+                        res["retries"] += 1
+                    continue
+                except (ConnectionError, OSError):
+                    with lock:
+                        res["retries"] += 1
+                    time.sleep(0.1)
+                    continue
+                if resp is None:    # this call absorbed the slowloris
+                    with lock:
+                        res["slowloris_absorbed"] += 1
+                        res["retries"] += 1
+                    continue
+                if resp.status != 200:
+                    with lock:
+                        res["retries"] += 1
+                    time.sleep(0.1)
+                    continue
+                import numpy as np
+                flow = edge_mod.decode_flow(resp)
+                with lock:
+                    if np.array_equal(flow, refs[fi]):
+                        res["completed"] += 1
+                    else:
+                        res["mismatched"].append(i)
+                break
+            else:
+                with lock:
+                    res["dropped"].append(i)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    return res
+
+
+def drill_edge(root):
+    """The hardened HTTP front door end to end: concurrent HTTP clients
+    against edge -> gateway -> 3 worker PROCESSES (one bound 0.0.0.0
+    with an advertised non-loopback address) survive a mid-load worker
+    SIGKILL, an injected slowloris and an injected client abort with 0
+    dropped / 0 bit-incorrect / 0 post-warmup compiles; then SIGTERM
+    drains edge -> gateway -> workers in order with /readyz unready
+    BEFORE the listener closes."""
+    import signal as signal_mod
+
+    from raft_tpu import resilience
+    from raft_tpu.serving import edge as edge_mod, loadgen
+    from raft_tpu.serving.gateway import (GatewayConfig, ServingGateway,
+                                          SocketTransport)
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+    from raft_tpu.serving.worker import WorkerConfig
+
+    STEP = 0
+    lease_dir = os.path.join(root, "leases")
+    store = FileLeaseStore(lease_dir)
+    ip = _detect_nonloopback_ip()
+    if ip:
+        print(f"  multi-host leg: w0 binds 0.0.0.0, advertises {ip}")
+    else:
+        print("  no non-loopback interface found; multi-host leg "
+              "degraded to loopback", flush=True)
+
+    def _cfg(i):
+        extra = ({"bind_host": "0.0.0.0", "advertise_host": ip}
+                 if (i == 0 and ip) else {})
+        return WorkerConfig(worker_id=f"w{i}", lease_dir=lease_dir,
+                            buckets=BUCKETS, max_batch=4, max_wait_ms=3.0,
+                            queue_timeout_ms=60_000, step=STEP,
+                            **extra).to_dict()
+
+    specs = [WorkerSpec(f"w{i}", _cfg(i)) for i in range(3)]
+    sup = WorkerSupervisor(
+        specs, store, stale_after_s=3.0, lease_grace_s=300.0,
+        poll_interval_s=0.25, respawn_base_delay_s=0.25,
+        respawn_max_delay_s=2.0, min_uptime_s=2.0)
+    gw = ServingGateway(store, GatewayConfig(
+        queue_timeout_ms=120_000, lease_ttl_s=2.0, poll_interval_s=0.1,
+        dispatch_threads=CONCURRENCY, expected_step=STEP))
+    sup.attach_registry(gw.registry)
+    drain_result = {}
+    es = edge_mod.EdgeServer(
+        gw,
+        edge_mod.EdgeConfig(header_read_timeout_s=2.0,
+                            drain_grace_s=1.0),
+        drain_workers=lambda: drain_result.update(
+            sup.drain_fleet(SocketTransport(), timeout_s=60.0)))
+    sup.start_all()
+    sup.start()
+    gw.start()
+    es.start_in_thread()
+    es.install_sigterm_handler()
+    try:
+        _await_metric(lambda: len(gw.live_workers()), 3, 300.0,
+                      "routable worker processes")
+        print(f"  3 workers routable: {gw.live_workers()}")
+        if ip:
+            lease0 = store.read_all()["w0"]
+            assert tuple(lease0.addr)[0] == ip, lease0.addr
+            ping = SocketTransport().request(tuple(lease0.addr),
+                                             {"op": "ping"})[0]
+            assert ping["status"] == "ok", ping
+            print(f"  w0 routable at advertised non-loopback "
+                  f"{tuple(lease0.addr)}")
+        r = edge_mod.http_request(es.addr, "GET", "/readyz")
+        assert r is not None and r.status == 200, r
+
+        predictor = _make_predictor()
+        frames = loadgen.make_frames(SHAPES, per_shape=2, seed=29)
+        refs, ref_kind = _references(predictor, frames, max_batch=4)
+
+        # -- wave 1: SIGKILL the busiest worker under HTTP load --------
+        killed = {}
+
+        def killer():
+            _await_metric(lambda: gw.metrics.responses, 5, 120.0,
+                          "responses before kill")
+            victim = gw.metrics.routed.most_common(1)[0][0]
+            pid = store.read_all()[victim].pid
+            os.kill(pid, signal_mod.SIGKILL)
+            killed["victim"], killed["pid"] = victim, pid
+            print(f"  SIGKILLed {victim} (pid {pid}) mid-load",
+                  flush=True)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        res = _run_edge_load(es.addr, frames, refs, N_REQUESTS,
+                             CONCURRENCY)
+        kt.join(timeout=120.0)
+        assert "victim" in killed, "kill thread never fired"
+        victim = killed["victim"]
+        print(f"  {res['completed']}/{N_REQUESTS} HTTP responses "
+              f"through the kill; reference = {ref_kind}")
+        assert res["completed"] == N_REQUESTS, res
+        assert not res["dropped"], f"dropped: {res['dropped']}"
+        assert not res["mismatched"], \
+            f"bit-incorrect responses: {res['mismatched']}"
+
+        _await_metric(lambda: sup.respawns(victim), 1, 120.0,
+                      f"supervised respawn of {victim}")
+        _await_metric(lambda: 1 if victim in gw.live_workers() else 0,
+                      1, 300.0, f"{victim} rejoining the routable set")
+        print(f"  {victim} respawned and rejoined routing")
+
+        # -- wave 2: injected slowloris absorbed by one client ---------
+        resilience.set_injector(
+            resilience.FaultInjector(edge_slowloris_s=0.05))
+        res2 = _run_edge_load(es.addr, frames, refs, 10, 4)
+        resilience.set_injector(None)
+        assert res2["completed"] == 10 and not res2["dropped"] \
+            and not res2["mismatched"], res2
+        assert res2["slowloris_absorbed"] >= 1, res2
+        assert es.slow_client_drops >= 1, \
+            "edge never reaped the injected slowloris"
+        print(f"  slowloris injected, reaped by the edge "
+              f"(drops={es.slow_client_drops}), victim retried clean")
+
+        # -- wave 3: injected client abort, no poison ------------------
+        resilience.set_injector(
+            resilience.FaultInjector(edge_client_abort_nth=3))
+        res3 = _run_edge_load(es.addr, frames, refs, 10, 4)
+        resilience.set_injector(None)
+        assert res3["completed"] == 10 and not res3["dropped"] \
+            and not res3["mismatched"], res3
+        assert res3["aborts"] == 1, res3
+        print("  injected client abort retried clean; fleet unpoisoned")
+
+        # 0 post-warmup compiles — cross-process via lease counters.
+        for wid, l in sorted(store.read_all().items()):
+            compiles = l.extra.get("post_warmup_compiles")
+            assert compiles == 0, \
+                f"{wid} reports {compiles} post-warmup compile(s)"
+
+        txt = gw.registry.prometheus_text()
+        for needle in ("edge_requests", 'edge_responses{status="200"}',
+                       'edge_errors{class="slowloris"}', "edge_inflight",
+                       "edge_ready"):
+            assert needle in txt, f"{needle!r} missing from export"
+
+        # -- SIGTERM: coordinated drain, unready before close ----------
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+        saw_unready = False
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                probe = edge_mod.http_request(es.addr, "GET", "/readyz",
+                                              timeout=2.0)
+            except (ConnectionError, OSError):
+                break               # listener already closed
+            if probe is not None and probe.status == 503:
+                saw_unready = True
+                break
+            time.sleep(0.02)
+        assert saw_unready, \
+            "/readyz never went 503 while the listener was still open"
+        deadline = time.monotonic() + 180.0
+        while ("workers_drained" not in es.shutdown_events
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert es.shutdown_events == [
+            "unready", "listener_closed", "edge_drained",
+            "gateway_closed", "workers_drained"], es.shutdown_events
+        assert drain_result and set(drain_result.values()) <= \
+            {"drained", "not-running"}, drain_result
+        print(f"  SIGTERM drained edge->gateway->workers in order; "
+              f"workers: {drain_result}")
+    finally:
+        resilience.set_injector(None)
+        if not es._closed:
+            es.shutdown_sync()
+        gw.close()
+        sup.stop(kill_workers=True)
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -1883,6 +2156,7 @@ DRILLS = [
     drill_contbatch,
     drill_gateway,
     drill_autoscale,
+    drill_edge,
 ]
 
 

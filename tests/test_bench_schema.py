@@ -10,9 +10,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from check_bench_schema import (AUTOSCALE_METRIC,  # noqa: E402
-                                CONTBATCH_METRIC, GATEWAY_METRIC,
-                                STEP_METRIC, check_file, check_payload,
-                                main)
+                                CONTBATCH_METRIC, EDGE_METRIC,
+                                GATEWAY_METRIC, STEP_METRIC, check_file,
+                                check_payload, main)
 
 
 def test_committed_artifacts_honor_schema(capsys):
@@ -91,6 +91,23 @@ def test_checker_requires_both_gateway_arms():
         base, per_arm={"gateway": {"p50_ms": 5.8}, "in_process": 5.0}))
     assert not check_payload("err", {
         "metric": GATEWAY_METRIC, "value": None, "error": "boom"})
+
+
+def test_checker_requires_both_edge_arms():
+    base = {"metric": EDGE_METRIC, "value": 190.0, "unit": "ms",
+            "platform": "cpu", "smoke_operating_point": True}
+    ok = dict(base, per_arm={"in_process": {"p50_ms": 110.0},
+                             "edge": {"p50_ms": 300.0}})
+    assert not check_payload("ok", ok)
+    # The front-door toll claim needs both the in-process baseline and
+    # the through-the-edge arm from the same run.
+    assert check_payload("none", base)
+    assert check_payload("half", dict(
+        base, per_arm={"edge": {"p50_ms": 300.0}}))
+    assert check_payload("shape", dict(
+        base, per_arm={"edge": {"p50_ms": 300.0}, "in_process": 110.0}))
+    assert not check_payload("err", {
+        "metric": EDGE_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_requires_both_step_arms():

@@ -244,6 +244,9 @@ class TestSlo:
 SNAPSHOT_KEYS = [
     "serving_batches", "serving_breaker_fastfails",
     "serving_cold_stream_requests", "serving_compiles",
+    "serving_contbatch_admits", "serving_contbatch_freed_iters",
+    "serving_contbatch_mean_occupancy", "serving_contbatch_retargets",
+    "serving_contbatch_retires", "serving_contbatch_steps",
     "serving_early_exit_iters_saved", "serving_encoder_cache_hit_rate",
     "serving_encoder_hits", "serving_encoder_misses", "serving_errors",
     "serving_isolated_retries", "serving_latency_mean_ms",
@@ -269,6 +272,9 @@ ENGINE_GAUGE_KEYS = [
 REGISTRY_NAMES = [
     "serving_batch_size", "serving_batches", "serving_breaker_fastfails",
     "serving_cold_stream_requests", "serving_compiles",
+    "serving_contbatch_admits", "serving_contbatch_freed_iters",
+    "serving_contbatch_mean_occupancy", "serving_contbatch_retargets",
+    "serving_contbatch_retires", "serving_contbatch_steps",
     "serving_early_exit_iters_saved", "serving_encoder_cache_hit_rate",
     "serving_encoder_hits", "serving_encoder_misses", "serving_errors",
     "serving_gauge", "serving_isolated_retries", "serving_latency_ms",
