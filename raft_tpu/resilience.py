@@ -347,6 +347,18 @@ class FaultInjector:
       that died after the probe and before the write. Exercises the
       transport's one transparent reconnect (the request must succeed
       without burning a failover retry).
+    * ``RAFT_FAULT_WORKER_DUP_DELIVERY_NTH=N`` — the Nth submit frame
+      a serving worker ACCEPTS (1-based receive order) is delivered
+      twice through the real serve path, simulating an at-least-once
+      transport replaying a frame. The worker's idempotency cache must
+      collapse the pair to ONE engine compute and two bit-identical
+      replies. Fires once.
+    * ``RAFT_FAULT_WORKER_SDC_NTH=N`` — the Nth SDC sentinel
+      self-check a serving worker runs (1-based) has its output
+      corrupted before comparison, simulating silent data corruption.
+      The sentinel must fail the check and flip the lease to
+      QUARANTINED (non-routable; the supervisor recycles the process
+      without counting a crash). Fires once.
     * ``RAFT_FAULT_TARGET_PROCESS=K`` — restrict EVERY host-side fault
       above to the host with ``jax.process_index() == K`` (multi-host
       drills: exactly one simulated host fails while the others
@@ -372,6 +384,8 @@ class FaultInjector:
     gateway_stale_pool: int = 0
     edge_slowloris_s: float = 0.0
     edge_client_abort_nth: int = 0
+    worker_dup_delivery_nth: int = 0
+    worker_sdc_nth: int = 0
     target_process: Optional[int] = None
 
     @staticmethod
@@ -409,6 +423,11 @@ class FaultInjector:
             edge_client_abort_nth=int(
                 os.environ.get("RAFT_FAULT_EDGE_CLIENT_ABORT_NTH",
                                "0")),
+            worker_dup_delivery_nth=int(
+                os.environ.get("RAFT_FAULT_WORKER_DUP_DELIVERY_NTH",
+                               "0")),
+            worker_sdc_nth=int(
+                os.environ.get("RAFT_FAULT_WORKER_SDC_NTH", "0")),
             target_process=int(target) if target else None)
 
     # -- hooks -----------------------------------------------------------
@@ -546,6 +565,28 @@ class FaultInjector:
         return (self.edge_client_abort_nth > 0 and self._on_target()
                 and send_seq == self.edge_client_abort_nth)
 
+    def duplicates_worker_request(self, recv_seq: int) -> bool:
+        """Whether the ``recv_seq``-th submit frame ACCEPTED by this
+        worker (1-based receive order) should be delivered twice
+        through the real serve path — the at-least-once transport
+        replaying a frame it already delivered. Deterministic by
+        receive order and fires once; the caller (``WorkerServer``)
+        runs the second delivery so both passes share one idempotency
+        key and the dedup cache's one-compute contract is what's under
+        test."""
+        return (self.worker_dup_delivery_nth > 0 and self._on_target()
+                and recv_seq == self.worker_dup_delivery_nth)
+
+    def corrupts_self_check(self, check_seq: int) -> bool:
+        """Whether the ``check_seq``-th SDC sentinel self-check run by
+        this worker (1-based) should have its output corrupted before
+        the golden comparison — the silent-data-corruption simulation
+        the QUARANTINED lifecycle is proven against. Fires once: the
+        recycled worker starts a fresh check counter and (without the
+        env var re-exported) a clean injector."""
+        return (self.worker_sdc_nth > 0 and self._on_target()
+                and check_seq == self.worker_sdc_nth)
+
     def maybe_fail_sample(self, index: int):
         """Called before each dataset read; deterministic by index so a
         corrupt sample stays corrupt across retries (forcing the
@@ -565,7 +606,9 @@ class FaultInjector:
                     or self.worker_partition_s
                     or self.gateway_stale_pool
                     or self.edge_slowloris_s
-                    or self.edge_client_abort_nth)
+                    or self.edge_client_abort_nth
+                    or self.worker_dup_delivery_nth
+                    or self.worker_sdc_nth)
 
 
 _ACTIVE: Optional[FaultInjector] = None

@@ -292,7 +292,13 @@ class Autoscaler:
         """The least-loaded routable, supervisor-managed,
         not-already-draining worker — ``(worker_id, lease)`` or
         ``None``. Load is the lease's self-reported engine pressure;
-        ties break on worker id so the choice is deterministic."""
+        ties break on worker id so the choice is deterministic.
+
+        The routability filter deliberately excludes
+        :data:`~raft_tpu.serving.health.QUARANTINED` workers: an
+        SDC-quarantined replica is a *fault* awaiting a supervisor
+        recycle, not spare capacity — draining it would both retire a
+        slot the fleet still needs and race the recycle."""
         status = self.supervisor.status()
         managed = {wid for wid, st in status.items()
                    if not st.get("draining")}

@@ -20,6 +20,12 @@ equal shapes), described by headers:
   carries, then enforced at every hop (edge admission, gateway queue,
   worker admission, engine queue gate). ``N <= 0`` → immediate 504.
 * ``X-Client-Id`` — quota key (falls back to the peer address).
+* ``X-Request-Id`` — optional client-supplied idempotency key
+  (``[A-Za-z0-9._-]``, at most 128 chars; anything else is a 400).
+  Threaded verbatim onto the gateway's wire-level idempotency key, so
+  a client retrying a 5xx under the same id dedupes at the worker
+  instead of recomputing. Absent, the edge mints one. Echoed on every
+  ``/v1/flow`` response — success or error — alongside ``X-Trace-Id``.
 
 A 200 carries the float32 ``(H, W, 2)`` flow as
 ``application/octet-stream`` with its own ``X-Shape``/``X-Dtype`` and
@@ -84,6 +90,7 @@ import signal
 import socket
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -100,6 +107,30 @@ _CRLF = b"\r\n"
 _HEAD_END = b"\r\n\r\n"
 _DTYPES = ("uint8", "float32")
 _PRIORITIES = ("high", "low")
+
+# Client-supplied idempotency keys ride the wire protocol and land in
+# worker-side cache maps and trace args: a bounded, conservative
+# charset keeps a hostile header from becoming a log/trace injection
+# or an unbounded-allocation vector.
+_REQUEST_ID_MAX = 128
+_REQUEST_ID_CHARS = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+    "0123456789._-")
+
+
+def _parse_request_id(headers: Dict[str, str]) -> Optional[str]:
+    """Validate an optional ``X-Request-Id``; malformed → 400 per the
+    taxonomy, absent → ``None`` (the edge mints one)."""
+    raw = headers.get("x-request-id", "").strip()
+    if not raw:
+        return None
+    if len(raw) > _REQUEST_ID_MAX:
+        raise _Reject(400, "malformed",
+                      f"X-Request-Id exceeds {_REQUEST_ID_MAX} chars")
+    if not set(raw) <= _REQUEST_ID_CHARS:
+        raise _Reject(400, "malformed",
+                      "X-Request-Id may contain only [A-Za-z0-9._-]")
+    return raw
 
 _STATUS_TEXT = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -497,10 +528,22 @@ class EdgeServer:
                 404, "not_found", f"no route for {method} {target}"))
             return False
         try:
-            return await self._serve_flow(reader, writer, headers)
+            request_id = _parse_request_id(headers)
+        except _Reject as rej:
+            await self._respond_error(writer, rej)
+            return False
+        # Minted here when the client supplied none, so EVERY /v1/flow
+        # response — success or rejection — can echo the key the wire
+        # request will carry (a client retrying on it dedupes at the
+        # worker).
+        request_id = request_id or uuid.uuid4().hex
+        try:
+            return await self._serve_flow(reader, writer, headers,
+                                          request_id)
         except _Reject as rej:
             self._c_errors.inc(**{"class": rej.err_class})
-            await self._respond_error(writer, rej, counted=True)
+            await self._respond_error(writer, rej, counted=True,
+                                      request_id=request_id)
             return False
 
     # -- the proxied request ---------------------------------------------
@@ -628,7 +671,8 @@ class EdgeServer:
 
     async def _serve_flow(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter,
-                          headers: Dict[str, str]) -> bool:
+                          headers: Dict[str, str],
+                          request_id: str) -> bool:
         peername = writer.get_extra_info("peername") or ("?", 0)
         deadline = self._admit(headers, str(peername[0]))
         shape, dtype, priority, iters, clen = \
@@ -664,11 +708,12 @@ class EdgeServer:
             try:
                 fut = self.gateway.submit(im1, im2, priority=priority,
                                           iters=iters, trace_id=tid,
-                                          deadline=deadline)
+                                          deadline=deadline,
+                                          request_id=request_id)
             except Exception as e:
                 status, err_class = classify_error(e)
                 await self._respond_error(writer, _Reject(
-                    status, err_class, str(e)))
+                    status, err_class, str(e)), request_id=request_id)
                 return False
             wait = None
             if deadline is not None:
@@ -683,12 +728,13 @@ class EdgeServer:
                 status, err_class = 504, "timeout"
                 await self._respond_error(writer, _Reject(
                     status, err_class,
-                    "deadline expired awaiting the gateway"))
+                    "deadline expired awaiting the gateway"),
+                    request_id=request_id)
                 return False
             except Exception as e:
                 status, err_class = classify_error(e)
                 await self._respond_error(writer, _Reject(
-                    status, err_class, str(e)))
+                    status, err_class, str(e)), request_id=request_id)
                 return False
             if reader.at_eof():
                 # The client hung up while its answer was computed
@@ -703,6 +749,7 @@ class EdgeServer:
                 ("Content-Type", "application/octet-stream"),
                 ("X-Shape", ",".join(str(v) for v in out.shape)),
                 ("X-Dtype", "float32"),
+                ("X-Request-Id", request_id),
             ]
             if tid is not None:
                 resp_headers.append(("X-Trace-Id", str(tid)))
@@ -755,13 +802,17 @@ class EdgeServer:
 
     async def _respond_error(self, writer: asyncio.StreamWriter,
                              rej: _Reject,
-                             counted: bool = False) -> None:
+                             counted: bool = False,
+                             request_id: Optional[str] = None) -> None:
         """One JSON error frame per the taxonomy table; closes the
         connection (the caller returns False). ``counted`` marks
-        rejections whose class counter the caller already ticked."""
+        rejections whose class counter the caller already ticked;
+        ``request_id`` is echoed so a client can retry the same key."""
         if not counted:
             self._c_errors.inc(**{"class": rej.err_class})
         extra = [("Connection", "close")]
+        if request_id is not None:
+            extra.append(("X-Request-Id", request_id))
         if rej.retry_after_s is not None:
             extra.append(("Retry-After",
                           str(max(1, math.ceil(rej.retry_after_s)))))
@@ -909,6 +960,7 @@ def submit_flow(addr: Tuple[str, int], image1: np.ndarray,
                 iters: Optional[int] = None,
                 deadline_ms: Optional[int] = None,
                 client_id: Optional[str] = None,
+                request_id: Optional[str] = None,
                 timeout: float = 60.0) -> Optional[EdgeResponse]:
     """Client-side encoding of the ``POST /v1/flow`` contract: two
     same-shape images, C-order bytes back to back. On 200 the decoded
@@ -929,6 +981,8 @@ def submit_flow(addr: Tuple[str, int], image1: np.ndarray,
         headers["X-Deadline-Ms"] = str(deadline_ms)
     if client_id is not None:
         headers["X-Client-Id"] = client_id
+    if request_id is not None:
+        headers["X-Request-Id"] = request_id
     return http_request(addr, "POST", "/v1/flow", headers,
                         a1.tobytes() + a2.tobytes(), timeout=timeout)
 

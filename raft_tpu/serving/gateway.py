@@ -23,14 +23,32 @@ in-process:
   (:func:`~raft_tpu.serving.netproto.owners_key`), so gateway and
   in-process fleet agree on every bucket's owner chain.
 
-* **The failover contract** (identical to ``ServingFleet``): each
-  worker is tried at most once per request; a post-acceptance failure
-  (connection death, typed error reply) walks to the next live owner;
-  ``RequestTimedOut`` is NEVER retried — the queue budget is the
-  client's, and a retry would only serve a staler answer later; when
-  no live lease-holder remains the request sheds with
+* **The failover contract**: every request carries an idempotency key
+  (``request_id`` — gateway-minted, or propagated from the edge's
+  ``X-Request-Id``), so a post-acceptance failure (connection death,
+  typed error reply) walks to the next live owner, and when the chain
+  is exhausted by *connection-class* failures the walk may re-cover
+  the SAME chain up to ``retry_rounds`` times: a worker that already
+  served the key replays its cached reply from its
+  :class:`~raft_tpu.serving.worker.DedupCache` instead of recomputing
+  — which is what makes retry-after-send safe, closing the one gap
+  PR 18 had to refuse (a reply lost after acceptance is now served,
+  not surfaced as ``WorkerConnectionError``). ``RequestTimedOut`` is
+  NEVER retried — the queue budget is the client's, and a retry would
+  only serve a staler answer later; when every round is exhausted the
+  request sheds with
   :class:`~raft_tpu.serving.health.EngineUnhealthy` naming the workers
   it saw.
+
+* **Hedged requests** (*The Tail at Scale*): once a bucket has enough
+  latency history, a dispatch that outlives the bucket's
+  ``hedge_quantile`` latency fires ONE hedge to the next owner under
+  the same idempotency key; the first reply wins, the loser's answer
+  is discarded (and any later duplicate of the key dedupes at its
+  worker). Hedges spend a token budget accrued per request
+  (``hedge_budget_fraction`` — they can never exceed a few percent of
+  traffic) and are disabled outright under pressure (gateway queue
+  backlog or any live worker reporting brownout).
 
 * **Deadlines at every hop** — ``submit`` stamps an absolute
   ``time.monotonic()`` deadline from ``queue_timeout_ms``. It is
@@ -56,6 +74,7 @@ import select
 import socket
 import threading
 import time
+import uuid
 from collections import Counter, deque
 from typing import Dict, List, Optional, Tuple
 
@@ -109,10 +128,12 @@ class SocketTransport:
       EOF (or stray bytes) and is discarded at checkout; if a pooled
       socket still proves dead at write time — before any reply bytes
       — the exchange retries ONCE on a guaranteed-fresh connection,
-      burning no failover hop. Replies are never retried this way:
-      once bytes may have reached the worker's application layer the
-      failover contract (idempotent resubmit on the next owner) is
-      the only safe retry.
+      burning no failover hop. Replies are never retried at THIS
+      layer: once bytes may have reached the worker's application
+      layer, retrying is the gateway's job — its failover walk
+      re-sends the same idempotency key (to the next owner, or back
+      around the same chain), and the worker's dedup cache replays
+      the completed reply instead of recomputing.
     * **Per-hop stall deadline** — ``hop_timeout_s`` caps how long one
       exchange may sit on a single worker. A stall past it with
       request budget remaining raises :class:`WorkerConnectionError`
@@ -370,6 +391,23 @@ class GatewayConfig:
         grades every response's client-observed latency on an
         :class:`~raft_tpu.observability.slo.SloTracker` attached to
         its registry — the violation-ratio gauge the autoscaler reads.
+      retry_rounds: how many times the failover walk may cover the
+        owner chain for CONNECTION-class failures. Round one is the
+        PR-18 contract (each worker at most once); further rounds are
+        safe because every request carries an idempotency key — a
+        worker that already served the key replays its cached reply.
+        ``1`` restores the old refuse-after-send behavior.
+      hedge_quantile: per-bucket latency quantile (0..1) after which a
+        still-unanswered dispatch fires one hedge to the next owner
+        under the same key. ``0`` disables hedging entirely.
+      hedge_min_ms: floor on the hedge trigger delay — a bucket whose
+        quantile collapses (warm cache, tiny frames) must not hedge
+        on noise.
+      hedge_min_samples: latency observations a bucket needs before
+        its quantile is trusted to trigger hedges.
+      hedge_budget_fraction: hedge-token accrual per submitted request
+        (a hedge spends one token), the *Tail at Scale* cap keeping
+        hedges to a few percent of traffic no matter the tail shape.
     """
 
     pad_mode: str = "sintel"
@@ -384,6 +422,11 @@ class GatewayConfig:
     pool_max_idle_per_addr: int = 8
     pool_max_idle_age_s: float = 30.0
     slo_ms: Optional[Tuple[Tuple[str, float], ...]] = None
+    retry_rounds: int = 2
+    hedge_quantile: float = 0.0
+    hedge_min_ms: float = 20.0
+    hedge_min_samples: int = 8
+    hedge_budget_fraction: float = 0.05
 
 
 class GatewayMetrics:
@@ -392,7 +435,7 @@ class GatewayMetrics:
     Batching happens inside the workers, so ``batch_histogram`` is
     empty here — per-batch truth lives in each worker's own metrics."""
 
-    def __init__(self, window: int = 10_000):
+    def __init__(self, window: int = 10_000, key_window: int = 512):
         self._lock = threading.Lock()
         self.requests = 0
         self.responses = 0
@@ -403,20 +446,74 @@ class GatewayMetrics:
         self.routed: Counter = Counter()     # ok responses per worker
         self.retries: Counter = Counter()    # failed hops per worker
         self._latencies = deque(maxlen=window)
+        # Reliability layer (PR 20) audit counters.
+        self.chain_rewalks = 0       # extra same-key owner-chain rounds
+        self.hedges = 0              # hedge dispatches fired
+        self.hedge_wins = 0          # hedge reply beat the primary
+        self.hedge_losses = 0        # primary beat the fired hedge
+        self.hedge_denied_budget = 0    # no token in the hedge budget
+        self.hedge_denied_pressure = 0  # backlog/brownout veto
+        self._key_window = key_window
+        # Per-bucket latency reservoir: the hedge trigger's quantile
+        # source (exact samples; the registry histogram attached by
+        # the gateway is the export view of the same stream).
+        self._lat_by_key: Dict[str, deque] = {}
 
     def record_request(self) -> None:
         with self._lock:
             self.requests += 1
 
-    def record_response(self, worker_id: str, latency_s: float) -> None:
+    def record_response(self, worker_id: str, latency_s: float,
+                        key: Optional[str] = None) -> None:
         with self._lock:
             self.responses += 1
             self.routed[worker_id] += 1
             self._latencies.append(latency_s)
+            if key is not None:
+                dq = self._lat_by_key.get(key)
+                if dq is None:
+                    dq = self._lat_by_key[key] = deque(
+                        maxlen=self._key_window)
+                dq.append(latency_s)
+
+    def key_latency_quantile(self, key: str, q: float,
+                             min_samples: int = 1
+                             ) -> Optional[float]:
+        """The ``q`` (0..1) latency quantile of bucket ``key`` in
+        seconds, or ``None`` until ``min_samples`` observations exist
+        — an untrusted quantile must not trigger hedges."""
+        with self._lock:
+            dq = self._lat_by_key.get(key)
+            if dq is None or len(dq) < max(1, min_samples):
+                return None
+            vals = sorted(dq)
+        return _percentile(vals, 100.0 * q)
 
     def record_retry(self, worker_id: str) -> None:
         with self._lock:
             self.retries[worker_id] += 1
+
+    def record_rewalk(self) -> None:
+        with self._lock:
+            self.chain_rewalks += 1
+
+    def record_hedge(self) -> None:
+        with self._lock:
+            self.hedges += 1
+
+    def record_hedge_outcome(self, hedge_won: bool) -> None:
+        with self._lock:
+            if hedge_won:
+                self.hedge_wins += 1
+            else:
+                self.hedge_losses += 1
+
+    def record_hedge_denied(self, pressure: bool) -> None:
+        with self._lock:
+            if pressure:
+                self.hedge_denied_pressure += 1
+            else:
+                self.hedge_denied_budget += 1
 
     def record_timeout(self, queued: bool = False) -> None:
         with self._lock:
@@ -454,6 +551,14 @@ class GatewayMetrics:
                 "gateway_timeouts_queued": float(self.timeouts_queued),
                 "gateway_shed": float(self.shed),
                 "gateway_retries": float(sum(self.retries.values())),
+                "gateway_chain_rewalks": float(self.chain_rewalks),
+                "gateway_hedges": float(self.hedges),
+                "gateway_hedge_wins": float(self.hedge_wins),
+                "gateway_hedge_losses": float(self.hedge_losses),
+                "gateway_hedge_denied_budget":
+                    float(self.hedge_denied_budget),
+                "gateway_hedge_denied_pressure":
+                    float(self.hedge_denied_pressure),
             }
         out.update({f"gateway_latency_{q}_ms": v
                     for q, v in lat.items()})
@@ -508,6 +613,20 @@ class ServingGateway:
         self._threads: list = []
         self._closed = False
         self._started = False
+        # Hedge token budget (Tail at Scale): each submit accrues
+        # ``hedge_budget_fraction`` tokens (capped — no unbounded
+        # burst), each fired hedge spends one, so hedges can never
+        # exceed that fraction of traffic.
+        self._hedge_lock = threading.Lock()
+        self._hedge_tokens = 0.0
+        self._hedge_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._latency_hist = self.registry.histogram(
+            "gateway_request_latency_s",
+            help="client-observed gateway latency per bucket key — "
+                 "the histogram the hedge trigger's per-bucket "
+                 "quantile is derived from",
+            labelnames=("key",))
         self._attach_registry()
 
     # -- lifecycle -------------------------------------------------------
@@ -543,6 +662,8 @@ class ServingGateway:
             if not req.future.done():
                 req.future.set_exception(
                     RuntimeError("gateway closed"))
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
         self.transport.close()
 
     def __enter__(self) -> "ServingGateway":
@@ -625,7 +746,8 @@ class ServingGateway:
                priority: str = PRIORITY_HIGH,
                iters: Optional[int] = None,
                trace_id: Optional[int] = None,
-               deadline: Optional[float] = None
+               deadline: Optional[float] = None,
+               request_id: Optional[str] = None
                ) -> concurrent.futures.Future:
         """Enqueue one request; returns a future resolving to the
         unpadded ``(H, W, 2)`` float32 flow, bit-identical to any
@@ -639,10 +761,22 @@ class ServingGateway:
         client's budget — the HTTP edge converts ``X-Deadline-Ms``
         exactly once and passes it here so one budget is enforced at
         every hop. ``None`` (default) derives the deadline from
-        ``config.queue_timeout_ms`` as before."""
+        ``config.queue_timeout_ms`` as before.
+
+        ``request_id`` is the request's idempotency key on the wire
+        (minted here when the caller has none; the HTTP edge passes a
+        validated client-supplied ``X-Request-Id`` through so a
+        client-side retry of a 5xx dedupes at the worker). Every
+        retry hop and hedge of this request re-sends the SAME key."""
         if self._closed:
             raise RuntimeError("gateway is closed")
         self.metrics.record_request()
+        if request_id is None:
+            request_id = uuid.uuid4().hex
+        with self._hedge_lock:
+            self._hedge_tokens = min(
+                self._hedge_tokens + self.config.hedge_budget_fraction,
+                4.0)
         wire_tag, a1, a2 = request_wire(image1, image2)
         padded = InputPadder(a1.shape, mode=self.config.pad_mode,
                              factor=self.config.factor).padded_shape
@@ -675,7 +809,8 @@ class ServingGateway:
                   "priority": priority,
                   "iters": iters,
                   "deadline": deadline,
-                  "trace_id": tid}
+                  "trace_id": tid,
+                  "request_id": request_id}
         self._queue.put(_PendingRequest(
             future=fut, key=key, header=header,
             body=a1c.tobytes() + a2c.tobytes(),
@@ -726,11 +861,19 @@ class ServingGateway:
 
     def _route(self, req: _PendingRequest) -> None:
         """Walk the key's owner-preference chain over live
-        lease-holders. The ``ServingFleet`` contract verbatim: each
-        worker tried at most once, post-acceptance failures walk on,
-        ``RequestTimedOut`` never retried, exhaustion sheds."""
+        lease-holders. The PR-18 contract plus the reliability layer:
+        within one round each worker is tried at most once and a
+        post-acceptance failure walks on; a chain exhausted by
+        CONNECTION-class failures re-walks the same chain up to
+        ``retry_rounds`` times (safe: every hop re-sends the same
+        idempotency key, and a worker that already served it replays
+        its cached reply); ``RequestTimedOut`` is never retried;
+        exhaustion of every round sheds. The first dispatch may race
+        one hedge (:meth:`_exchange`)."""
         tried: set = set()
         last_exc: Optional[Exception] = None
+        rounds_left = max(1, self.config.retry_rounds) - 1
+        conn_failures = False       # this round saw a retryable death
         hops = 0
         if not self._threads:
             # No poll thread (manual-drive mode): membership is
@@ -755,7 +898,21 @@ class ServingGateway:
                           if wid in self._live and wid not in tried]
                 lease = (self._leases.get(owners[0])
                          if owners else None)
+                hedge_lease = (self._leases.get(owners[1])
+                               if len(owners) > 1 else None)
             if not owners or lease is None:
+                if rounds_left > 0 and conn_failures:
+                    # Connection-class exhaustion with rounds left:
+                    # re-cover the SAME chain under the same key. The
+                    # worker whose reply bytes died serves the retry
+                    # from its dedup cache — one compute, bit-exact.
+                    rounds_left -= 1
+                    conn_failures = False
+                    tried.clear()
+                    self.metrics.record_rewalk()
+                    self._trace_instant(req, "chain_rewalk",
+                                        {"hops": hops})
+                    continue
                 self.metrics.record_shed()
                 with self._member_lock:
                     known = sorted(self._leases)
@@ -767,6 +924,13 @@ class ServingGateway:
                        f"{last_exc}" if last_exc else "") + ")"))
                 return
             wid, addr = owners[0], tuple(lease.addr)
+            hedge_wid = (owners[1]
+                         if hedge_lease is not None and hops == 0
+                         and rounds_left == max(
+                             1, self.config.retry_rounds) - 1
+                         else None)
+            hedge_addr = (tuple(hedge_lease.addr)
+                          if hedge_wid is not None else None)
             tr = self._tracer
             span = (tr.span("gateway_hop", req.trace_id,
                             args={"worker": wid, "hops": hops})
@@ -775,9 +939,8 @@ class ServingGateway:
                 if span is not None:
                     span.__enter__()
                 try:
-                    rhdr, rbody = self.transport.request(
-                        addr, req.header, req.body,
-                        deadline=req.deadline, clock=self._clock)
+                    rhdr, rbody, wid = self._exchange(
+                        req, wid, addr, hedge_wid, hedge_addr)
                 finally:
                     if span is not None:
                         span.__exit__(None, None, None)
@@ -791,12 +954,13 @@ class ServingGateway:
                 return
             except (WorkerConnectionError, OSError) as e:
                 # Post-acceptance death (or refused connect): next
-                # healthy owner. The worker may have served the batch —
-                # resubmitting elsewhere is safe because requests are
-                # idempotent pure functions of their frames.
+                # healthy owner — and possibly back around the chain,
+                # because the idempotency key makes the re-send safe
+                # whether or not the worker served the batch.
                 tried.add(wid)
                 hops += 1
                 last_exc = e
+                conn_failures = True
                 self.metrics.record_retry(wid)
                 self._trace_instant(req, "worker_failed",
                                     {"worker": wid,
@@ -810,7 +974,12 @@ class ServingGateway:
                 ).reshape(shape)
                 worker = rhdr.get("worker", wid)
                 latency = self._clock() - req.t_submit
-                self.metrics.record_response(worker, latency)
+                self.metrics.record_response(worker, latency,
+                                             key=req.key)
+                try:
+                    self._latency_hist.observe(latency, key=req.key)
+                except Exception:
+                    pass
                 if self.slo is not None:
                     try:
                         self.slo.observe(
@@ -830,7 +999,8 @@ class ServingGateway:
                 req.future.set_exception(RequestTimedOut(
                     f"worker {wid}: {rhdr.get('error', 'timed out')}"))
                 return
-            # Typed post-acceptance error: walk the chain.
+            # Typed post-acceptance error: walk the chain (within the
+            # round only — a deterministic error would repeat).
             tried.add(wid)
             hops += 1
             last_exc = RuntimeError(
@@ -842,6 +1012,143 @@ class ServingGateway:
                                 {"worker": wid,
                                  "error": rhdr.get("error_type",
                                                    "unknown")})
+
+    # -- hedged dispatch -------------------------------------------------
+
+    def _hedge_delay_s(self, key: str) -> Optional[float]:
+        """Seconds a dispatch may run before its hedge fires, or
+        ``None`` when hedging is off / the bucket's latency history is
+        too thin to trust."""
+        q = self.config.hedge_quantile
+        if q <= 0:
+            return None
+        lat = self.metrics.key_latency_quantile(
+            key, q, min_samples=self.config.hedge_min_samples)
+        if lat is None:
+            return None
+        return max(lat, self.config.hedge_min_ms / 1e3)
+
+    def _hedge_pressure(self) -> bool:
+        """Hedging is a luxury: under backlog (every dispatcher busy)
+        or fleet brownout (workers already shedding quality) the extra
+        load would feed the very tail it fights."""
+        if self._queue.qsize() > 0:
+            return True
+        with self._member_lock:
+            for wid in self._live:
+                lease = self._leases.get(wid)
+                if lease is None:
+                    continue
+                if (lease.state == health_mod.BROWNOUT
+                        or lease.extra.get("brownout_level", 0)):
+                    return True
+        return False
+
+    def _try_spend_hedge_token(self) -> bool:
+        with self._hedge_lock:
+            if self._hedge_tokens >= 1.0:
+                self._hedge_tokens -= 1.0
+                return True
+            return False
+
+    def _ensure_hedge_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        with self._hedge_lock:
+            if self._hedge_pool is None:
+                self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=2 * max(1, self.config.dispatch_threads),
+                    thread_name_prefix="gateway-hedge")
+            return self._hedge_pool
+
+    def _exchange(self, req: _PendingRequest, wid: str, addr,
+                  hedge_wid: Optional[str], hedge_addr
+                  ) -> Tuple[dict, bytearray, str]:
+        """One dispatch, possibly racing one hedge. Returns
+        ``(reply_header, reply_body, winner_worker_id)``; raises
+        exactly like ``transport.request`` when every attempt failed.
+
+        The hedge fires only when: the bucket's latency quantile
+        elapsed with the primary still unanswered, a next owner
+        exists, the fleet is not under pressure/brownout, and a budget
+        token is available. Both attempts carry the SAME idempotency
+        key; the first reply wins and the loser's answer is discarded
+        when it lands (its worker's dedup cache keeps any later
+        duplicate of this key free). Exactly one reply is ever
+        returned, so the caller's future can never double-resolve."""
+        delay = (self._hedge_delay_s(req.key)
+                 if hedge_wid is not None else None)
+        if delay is not None and req.deadline is not None \
+                and (req.deadline - self._clock()) <= delay:
+            delay = None            # no room for a hedge in the budget
+        if delay is None:
+            rhdr, rbody = self.transport.request(
+                addr, req.header, req.body,
+                deadline=req.deadline, clock=self._clock)
+            return rhdr, rbody, wid
+        pool = self._ensure_hedge_pool()
+
+        def attempt(a):
+            return self.transport.request(
+                a, req.header, req.body,
+                deadline=req.deadline, clock=self._clock)
+
+        f_primary = pool.submit(attempt, addr)
+        try:
+            rhdr, rbody = f_primary.result(timeout=delay)
+            return rhdr, rbody, wid
+        except concurrent.futures.TimeoutError:
+            pass                    # straggler: consider a hedge
+        # (a real primary failure inside the window re-raised above
+        # and the failover walk handles it — no hedge burned.)
+        if self._hedge_pressure():
+            self.metrics.record_hedge_denied(pressure=True)
+            rhdr, rbody = f_primary.result()
+            return rhdr, rbody, wid
+        if not self._try_spend_hedge_token():
+            self.metrics.record_hedge_denied(pressure=False)
+            rhdr, rbody = f_primary.result()
+            return rhdr, rbody, wid
+        self.metrics.record_hedge()
+        self._trace_instant(req, "hedge_fired",
+                            {"primary": wid, "hedge": hedge_wid})
+        f_hedge = pool.submit(attempt, hedge_addr)
+        by_future = {f_primary: wid, f_hedge: hedge_wid}
+        primary_exc: Optional[Exception] = None
+        hedge_exc: Optional[Exception] = None
+        pending = {f_primary, f_hedge}
+        while pending:
+            done, pending = concurrent.futures.wait(
+                pending,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+            # Primary first when both land in the same wake-up, so the
+            # outcome accounting is deterministic.
+            for f in sorted(done, key=lambda x: x is f_hedge):
+                try:
+                    rhdr, rbody = f.result()
+                except RequestTimedOut:
+                    # The budget is gone on one leg; the other can only
+                    # deliver a too-late answer. Surface immediately.
+                    for other in pending:
+                        other.add_done_callback(
+                            lambda o: o.exception())
+                    raise
+                except Exception as e:
+                    if f is f_primary:
+                        primary_exc = e
+                    else:
+                        hedge_exc = e
+                    continue
+                self.metrics.record_hedge_outcome(
+                    hedge_won=(f is f_hedge))
+                self._trace_instant(
+                    req, "hedge_won" if f is f_hedge else "hedge_lost",
+                    {"winner": by_future[f]})
+                for other in pending:
+                    # The loser resolves in the background; its reply
+                    # (if any) is discarded here, deduped at its
+                    # worker for any future duplicate of this key.
+                    other.add_done_callback(lambda o: o.exception())
+                return rhdr, rbody, by_future[f]
+        raise (primary_exc if primary_exc is not None else hedge_exc)
 
     # -- observability ---------------------------------------------------
 
@@ -872,6 +1179,23 @@ class ServingGateway:
             "gateway_queue_depth",
             help="requests waiting at the gateway for a dispatcher",
             fn=_scalar(self._queue.qsize))
+        for name, read, help_ in (
+                ("gateway_chain_rewalks", lambda: m.chain_rewalks,
+                 "same-key owner-chain re-walks after connection-class "
+                 "exhaustion (the retry-after-send path)"),
+                ("gateway_hedges", lambda: m.hedges,
+                 "hedge dispatches fired"),
+                ("gateway_hedge_wins", lambda: m.hedge_wins,
+                 "hedges whose reply beat the primary"),
+                ("gateway_hedge_losses", lambda: m.hedge_losses,
+                 "fired hedges the primary beat"),
+                ("gateway_hedge_denied_budget",
+                 lambda: m.hedge_denied_budget,
+                 "hedge candidates denied by the token budget"),
+                ("gateway_hedge_denied_pressure",
+                 lambda: m.hedge_denied_pressure,
+                 "hedge candidates denied under backlog/brownout")):
+            self.registry.gauge(name, help=help_, fn=_scalar(read))
 
         def _occupancy():
             with self._member_lock:

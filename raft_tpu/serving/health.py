@@ -72,12 +72,23 @@ STALE = "stale"
 # starts; deliberately NOT routable and NOT a fault — the supervisor
 # treats the subsequent exit-0 as a directed departure, never a crash.
 DRAINING = "draining"
+# Worker-process integrity state: the worker's SDC sentinel (a periodic
+# self-check of an idle slot against a golden pair, canary-style)
+# produced a non-finite / drifted / freshly-compiled answer. The
+# replica may be computing garbage silently, so it is not routable —
+# but the process is cooperative: it keeps heartbeating QUARANTINED so
+# the supervisor can recycle it as a *directed* replacement (no crash
+# streak, no backoff), and the autoscaler never picks it as a drain
+# victim (draining a quarantined worker would mistake a fault for
+# spare capacity).
+QUARANTINED = "quarantined"
 
 # Numeric encoding for the scalar stream (TrainLogger/JSONL want
 # floats): ordered roughly by "how routable is this replica".
 # BROWNOUT got the next free code (6) rather than a re-numbering —
 # the existing codes are pinned by dashboards and golden tests; STALE
-# (7) and DRAINING (8) follow the same append-only rule.
+# (7), DRAINING (8) and QUARANTINED (9) follow the same append-only
+# rule.
 HEALTH_CODES: Dict[str, int] = {
     STARTING: 0,
     WARMING: 1,
@@ -88,6 +99,7 @@ HEALTH_CODES: Dict[str, int] = {
     BROWNOUT: 6,
     STALE: 7,
     DRAINING: 8,
+    QUARANTINED: 9,
 }
 
 # The states a load balancer may send traffic to. DEGRADED is

@@ -45,6 +45,17 @@ the respawn machinery — a supervisor that respawned what the
 autoscaler just decommissioned would oscillate the fleet forever. A
 worker that crashes (nonzero exit) MID-drain is counted as a crash but
 still retired: the decommission decision stands.
+
+**Quarantine recycles are not crashes either.** A worker whose SDC
+sentinel failed keeps heartbeating the non-routable
+:data:`~raft_tpu.serving.health.QUARANTINED` state — the process is
+cooperative, the *silicon/runtime answer* is suspect. The supervisor
+kills and respawns it immediately as a directed replacement: no crash
+streak, no backoff, no breaker count (a breaker that trips on
+quarantines would stop replacing exactly the workers most in need of
+replacement). The recycle is audited separately
+(``quarantine_recycles`` in :meth:`WorkerSupervisor.status` and the
+``gateway_worker_quarantine_recycles`` gauge).
 """
 
 from __future__ import annotations
@@ -55,7 +66,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from raft_tpu.serving.health import CircuitBreaker
+from raft_tpu.serving.health import QUARANTINED, CircuitBreaker
 from raft_tpu.serving.worker import spawn_worker
 
 logger = logging.getLogger(__name__)
@@ -86,6 +97,7 @@ class _WorkerState:
         self.pending_until: Optional[float] = None
         self.breaker = breaker
         self.draining = False               # a drain was directed here
+        self.quarantine_recycles = 0        # SDC-directed replacements
 
 
 class WorkerSupervisor:
@@ -297,7 +309,10 @@ class WorkerSupervisor:
         """One supervision pass; returns ``{worker_id: action}`` with
         actions ``ok`` / ``dead`` / ``stale-killed`` / ``respawned`` /
         ``backoff`` / ``breaker-open`` / ``draining`` / ``drained`` /
-        ``drain-crashed``. Non-blocking (backoff is an absolute
+        ``drain-crashed`` / ``quarantine-recycled`` (SDC sentinel
+        verdict: kill + immediate respawn as a directed replacement —
+        no crash streak, no backoff). Non-blocking (backoff is an
+        absolute
         respawn time, never a sleep). A ``drained`` / ``drain-crashed``
         worker's slot is retired: directed departures are never
         respawned."""
@@ -349,6 +364,29 @@ class WorkerSupervisor:
                     actions[wid] = "dead"
                     continue
                 lease = leases.get(wid)
+                if (lease is not None and lease.state == QUARANTINED
+                        and not st.draining):
+                    # SDC sentinel verdict: the process is alive and
+                    # cooperative but its answers are suspect. Recycle
+                    # it as a DIRECTED replacement — kill, drop the
+                    # lease, respawn immediately. Deliberately not
+                    # _on_death: no crash streak, no backoff, no
+                    # breaker count (see module docstring).
+                    logger.warning(
+                        "worker %s quarantined (%s): recycling",
+                        wid, lease.extra.get("quarantine_reason", "?"))
+                    try:
+                        st.proc.kill()
+                    except OSError:
+                        pass
+                    try:
+                        self.store.remove(wid)
+                    except Exception:
+                        pass
+                    st.quarantine_recycles += 1
+                    self._do_spawn(st, respawn=True)
+                    actions[wid] = "quarantine-recycled"
+                    continue
                 fresh = (lease is not None
                          and lease.fresh(self.stale_after_s, wall_now))
                 uptime = now - st.spawned_at
@@ -453,6 +491,7 @@ class WorkerSupervisor:
                 "breaker": st.breaker.state,
                 "pending_until": st.pending_until,
                 "draining": st.draining,
+                "quarantine_recycles": st.quarantine_recycles,
             } for wid, st in self._workers.items()}
 
     def respawns(self, worker_id: str) -> int:
@@ -497,6 +536,12 @@ class WorkerSupervisor:
             help="consecutive early deaths (uptime < min_uptime_s)",
             labelnames=("worker",),
             fn=_per_worker(lambda st: st.crash_streak))
+        registry.gauge(
+            "gateway_worker_quarantine_recycles",
+            help="SDC-sentinel-directed recycles per worker (not "
+                 "crashes: no streak, no backoff, no breaker count)",
+            labelnames=("worker",),
+            fn=_per_worker(lambda st: st.quarantine_recycles))
         registry.gauge(
             "gateway_worker_breaker",
             help="crash-loop breaker state (0 closed, 1 half-open, "

@@ -20,6 +20,27 @@ entire contract is:
   ``ServingEngine.submit(deadline_s=...)`` so the in-engine queue gate
   honors the client's remaining budget too.
 
+* **The idempotency cache** — every submit frame may carry a
+  ``request_id`` (gateway-minted or edge-propagated). The worker keeps
+  a bounded LRU of key → in-flight-entry-or-completed-reply
+  (:class:`DedupCache`): a duplicate delivery *attaches* to the
+  in-flight computation (one engine compute, two bit-identical
+  replies) and a retry after the reply bytes were lost *replays* the
+  cached reply verbatim. This is what makes the gateway's
+  retry-after-send safe — and it is deliberately process-local: a
+  worker death loses the cache, and the retried key recomputes
+  honestly on the respawn (determinism makes that recompute
+  bit-identical anyway).
+
+* **The SDC sentinel** — with ``self_check_interval_s`` set, a
+  background thread periodically runs a golden frame pair through the
+  engine (HIGH priority, a warmed bucket shape — zero fresh compiles
+  by construction) and compares against the post-warmup reference:
+  non-finite output, EPE drift beyond ``self_check_max_epe``, or any
+  fresh compile flips the lease to ``QUARANTINED`` — non-routable,
+  cooperative (the process keeps heartbeating), and recycled by the
+  supervisor as a directed replacement, never a crash.
+
 * **The lease** — a :class:`~raft_tpu.serving.netproto.Lease`
   republished every ``heartbeat_interval_s`` with the worker's
   address, engine health state, bucket config, served checkpoint step
@@ -49,6 +70,7 @@ until SIGTERM; :func:`spawn_worker` is the supervisor-side launcher
 from __future__ import annotations
 
 import argparse
+import collections
 import concurrent.futures
 import dataclasses
 import json
@@ -139,6 +161,16 @@ class WorkerConfig:
     brownout_high_water: int = 0
     brownout_low_water: int = 0
     brownout_dwell_ms: float = 250.0
+    # Idempotency cache capacity (entries): bounded LRU of request_id →
+    # in-flight computation / completed reply bytes. 0 disables dedup
+    # (every delivery computes). Process-local by design: a restart
+    # loses the cache and recomputes honestly.
+    dedup_cache_size: int = 256
+    # SDC sentinel: seconds between golden-pair self-checks (0 =
+    # disabled) and the EPE drift band a check may move within before
+    # the worker quarantines itself.
+    self_check_interval_s: float = 0.0
+    self_check_max_epe: float = 5.0
 
     def to_dict(self) -> Dict[str, object]:
         d = dataclasses.asdict(self)
@@ -154,6 +186,116 @@ class WorkerConfig:
             int(v) for v in d.get("iters_ladder", ()))
         known = {f.name for f in dataclasses.fields(WorkerConfig)}
         return WorkerConfig(**{k: v for k, v in d.items() if k in known})
+
+
+class _DedupEntry:
+    """One idempotency-cache slot: in-flight until ``done`` is set,
+    then an immutable completed reply (header dict + body bytes).
+    Waiters hold a direct reference, so an entry keeps working even
+    after LRU eviction removed it from the cache's map."""
+
+    __slots__ = ("done", "header", "body", "cacheable")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.header: Optional[dict] = None
+        self.body: bytes = b""
+        self.cacheable = False
+
+
+class DedupCache:
+    """Bounded LRU of idempotency key → in-flight / completed reply.
+
+    The exactly-once-*effect* mechanism of the reliability layer: the
+    first delivery of a key becomes the *owner* (it computes), every
+    concurrent duplicate *attaches* (waits on the owner's entry and
+    replies with the same bytes), and a later duplicate of a completed
+    ``ok`` reply *replays* the cached bytes verbatim. Non-``ok``
+    outcomes (timeouts, typed errors) complete their waiters but are
+    NOT retained — a later retry of that key deserves a fresh compute,
+    not a replayed failure.
+
+    Strictly process-local and deliberately so: the cache survives
+    nothing across process death. A respawned worker recomputes a
+    retried key from scratch — determinism (bit-exact per bucket
+    executable) makes that recompute indistinguishable from a replay,
+    which is why dedup here is an optimization with honest fallback,
+    never a correctness requirement.
+
+    Thread-safe; counters are the audit trail the drill asserts on.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _DedupEntry]" = \
+            collections.OrderedDict()
+        self.inserts = 0            # keys that became owners
+        self.hits_inflight = 0      # duplicates attached to a compute
+        self.replays = 0            # completed replies served from cache
+        self.evictions = 0          # LRU evictions under churn
+
+    def begin(self, key: str) -> Tuple[_DedupEntry, bool]:
+        """Look up ``key``; returns ``(entry, owner)``. ``owner=True``
+        means the caller must compute and then call :meth:`finish`;
+        otherwise the caller waits on ``entry.done`` and replies with
+        the entry's bytes."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                if e.done.is_set():
+                    self.replays += 1
+                else:
+                    self.hits_inflight += 1
+                return e, False
+            e = _DedupEntry()
+            self._entries[key] = e
+            self.inserts += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return e, True
+
+    def finish(self, key: str, entry: _DedupEntry, header: dict,
+               body: bytes, cacheable: bool) -> None:
+        """Complete an owned entry: store the reply, wake every waiter,
+        and drop non-cacheable (non-``ok``) outcomes from the map so a
+        later retry recomputes."""
+        entry.header = dict(header)
+        entry.body = bytes(body)
+        entry.cacheable = cacheable
+        with self._lock:
+            if not cacheable and self._entries.get(key) is entry:
+                self._entries.pop(key, None)
+        entry.done.set()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._entries),
+                    "inserts": self.inserts,
+                    "hits_inflight": self.hits_inflight,
+                    "replays": self.replays,
+                    "evictions": self.evictions}
+
+
+class _SinkConn:
+    """Write-discarding stand-in for a socket: the injected duplicate
+    delivery runs the REAL serve path but its reply has no transport
+    to ride (the at-least-once replay it simulates was an extra frame,
+    not an extra client)."""
+
+    def sendall(self, data) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 class WorkerServer:
@@ -197,6 +339,17 @@ class WorkerServer:
         self.drained = threading.Event()
         self.slow_client_drops = 0  # connections reaped by read deadline
         self._partition_until = 0.0  # injected blackhole window end
+        # Idempotent dispatch (None = disabled): request_id → reply.
+        self.dedup: Optional[DedupCache] = (
+            DedupCache(config.dedup_cache_size)
+            if config.dedup_cache_size > 0 else None)
+        self.computes = 0           # wire submits that reached the engine
+        self.dup_deliveries = 0     # injected duplicate frames served
+        # SDC sentinel / quarantine lifecycle.
+        self._quarantined = False
+        self.quarantine_reason = ""
+        self._self_checks = 0
+        self._sentinel_ref: Optional[np.ndarray] = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -244,6 +397,13 @@ class WorkerServer:
                                daemon=True)
         acc.start()
         self._threads.append(acc)
+        if self.config.self_check_interval_s > 0 and self.config.buckets:
+            sen = threading.Thread(
+                target=self._sentinel_loop,
+                name=f"{self.config.worker_id}-sdc-sentinel",
+                daemon=True)
+            sen.start()
+            self._threads.append(sen)
         return self
 
     def stop(self, remove_lease: bool = True) -> None:
@@ -330,6 +490,12 @@ class WorkerServer:
             # The drain overrides the engine's self-report: routing
             # must stop even while the engine still looks READY.
             return health_mod.DRAINING
+        if self._quarantined:
+            # SDC sentinel verdict overrides the engine too: the
+            # engine still *runs* — it just can't be trusted. The
+            # supervisor reads this state and recycles the process as
+            # a directed replacement (no crash accounting).
+            return health_mod.QUARANTINED
         if not self._serving:
             return "warming"
         try:
@@ -342,6 +508,17 @@ class WorkerServer:
         extra: Dict[str, object] = {}
         if self._compile_watch is not None:
             extra["post_warmup_compiles"] = self._compile_watch.so_far
+        if self.dedup is not None:
+            # The reliability layer's audit trail, published per beat
+            # so the drill can assert one-compute / replay / hedge-
+            # loser accounting ACROSS process boundaries.
+            dd = self.dedup.stats()
+            dd["computes"] = self.computes
+            dd["dup_deliveries"] = self.dup_deliveries
+            extra["dedup"] = dd
+        extra["self_checks"] = self._self_checks
+        if self._quarantined:
+            extra["quarantine_reason"] = self.quarantine_reason
         try:
             h = self.engine.health()
             # The autoscaler's occupancy signal and its drain-target
@@ -485,6 +662,19 @@ class WorkerServer:
                    and not self._stop.is_set()):
                 time.sleep(0.05)
             return False
+        if self._quarantined:
+            # Raced the quarantine announcement (the gateway routes on
+            # its last membership refresh): a typed post-acceptance
+            # error the failover contract walks past — never serve a
+            # result the SDC sentinel just declared untrustworthy.
+            write_message(conn, {"status": "error",
+                                 "error_type": "WorkerQuarantined",
+                                 "error": f"worker "
+                                          f"{self.config.worker_id} is "
+                                          "quarantined "
+                                          f"({self.quarantine_reason}); "
+                                          "route elsewhere"})
+            return True
         with self._inflight_cv:
             draining = self._draining
             if not draining:
@@ -498,6 +688,21 @@ class WorkerServer:
                                           f"{self.config.worker_id} is "
                                           "draining; route elsewhere"})
             return True
+        if inj is not None and inj.duplicates_worker_request(seq):
+            # At-least-once transport replaying a frame it already
+            # delivered: run the SAME bytes through the real serve
+            # path concurrently. Both passes share one request_id, so
+            # the dedup cache must collapse them to one engine compute;
+            # the duplicate's reply rides a sink (the replayed frame
+            # had no second client attached).
+            logger.warning("injected duplicate delivery of request %d",
+                           seq)
+            self.dup_deliveries += 1
+            dup = threading.Thread(
+                target=self._serve_duplicate,
+                args=(dict(header), body),
+                name=f"{self.config.worker_id}-dup", daemon=True)
+            dup.start()
         try:
             return self._serve_submit(conn, header, body, seq, inj)
         finally:
@@ -507,38 +712,28 @@ class WorkerServer:
 
     def _serve_submit(self, conn: socket.socket, header: dict,
                       body: bytearray, seq: int, inj) -> bool:
-        deadline = header.get("deadline")
-        if deadline is not None and time.monotonic() >= deadline:
-            # Expired before we touched the engine: the budget was
-            # spent upstream (queues, retries). Answer fast — serving
-            # it would hand back a too-late result the client already
-            # gave up on.
-            write_message(conn, {"status": "timeout",
-                                 "error": "deadline expired at worker "
-                                          "admission"})
-            return True
-        try:
-            fut = self._submit_from_wire(header, body)
-            remaining = (None if deadline is None
-                         else max(deadline - time.monotonic(), 0.001))
-            flow = fut.result(timeout=remaining)
-        except RequestTimedOut as e:
-            write_message(conn, {"status": "timeout", "error": str(e)})
-            return True
-        except (concurrent.futures.TimeoutError, TimeoutError):
-            # fut.result() outlived the wire deadline.
-            write_message(conn, {"status": "timeout",
-                                 "error": "deadline expired in flight"})
-            return True
-        except Exception as e:     # engine-side failure: typed reply
-            write_message(conn, {"status": "error",
-                                 "error_type": type(e).__name__,
-                                 "error": str(e)})
-            return True
-        if inj is not None and inj.maybe_drop_worker_socket():
+        key = header.get("request_id")
+        entry = None
+        if key is not None and self.dedup is not None:
+            entry, owner = self.dedup.begin(str(key))
+            if not owner:
+                # Duplicate delivery: attach to the in-flight compute
+                # or replay the completed reply — never recompute.
+                return self._reply_from_entry(conn, entry, header)
+        reply_header, reply_body, cacheable = \
+            self._compute_reply(header, body)
+        if entry is not None:
+            # Fill the cache BEFORE any reply byte moves: a reply lost
+            # on the wire (drop injector below, SIGKILL upstream) must
+            # already be replayable when the same key is retried.
+            self.dedup.finish(str(key), entry, reply_header,
+                              reply_body, cacheable)
+        if (reply_header.get("status") == "ok" and inj is not None
+                and inj.maybe_drop_worker_socket()):
             # Post-acceptance, post-serve drop: the reply bytes are
             # the only casualty. The gateway sees a dead connection
-            # after acceptance and must retry on the next owner.
+            # after acceptance and retries the SAME key — served from
+            # the cache fill above with zero extra computes.
             logger.warning("injected socket drop (request %d)", seq)
             try:
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
@@ -550,13 +745,83 @@ class WorkerServer:
             except OSError:
                 pass
             return False
-        flow = np.ascontiguousarray(flow, dtype=np.float32)
-        write_message(conn, {"status": "ok",
-                             "shape": list(flow.shape),
-                             "dtype": "float32",
-                             "worker": self.config.worker_id},
-                      flow.tobytes())
+        write_message(conn, reply_header, reply_body)
         return True
+
+    def _reply_from_entry(self, conn, entry: _DedupEntry,
+                          header: dict) -> bool:
+        """Answer a duplicate delivery from the idempotency cache:
+        wait (deadline-bounded) for the owner's compute if it is still
+        in flight, then reply with the owner's exact bytes plus a
+        ``deduped`` marker in the header (the body is bit-identical —
+        the marker is audit, not payload)."""
+        deadline = header.get("deadline")
+        remaining = (None if deadline is None
+                     else max(deadline - time.monotonic(), 0.001))
+        if not entry.done.wait(timeout=remaining):
+            write_message(conn, {"status": "timeout",
+                                 "error": "deadline expired awaiting "
+                                          "the in-flight duplicate"})
+            return True
+        reply = dict(entry.header)
+        reply["deduped"] = True
+        write_message(conn, reply, entry.body)
+        return True
+
+    def _serve_duplicate(self, header: dict, body: bytearray) -> None:
+        """Body of the injected duplicate-delivery thread: the same
+        frame through the real serve path (inflight-accounted), reply
+        discarded into a sink."""
+        with self._inflight_cv:
+            if self._draining:
+                return
+            self._inflight += 1
+        try:
+            self._serve_submit(_SinkConn(), header, body, -1, None)
+        except Exception:
+            logger.exception("injected duplicate delivery failed")
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _compute_reply(self, header: dict, body: bytearray
+                       ) -> Tuple[dict, bytes, bool]:
+        """One real compute: deadline admission → engine submit →
+        typed reply. Returns ``(header, body, cacheable)`` —
+        ``cacheable`` only for ``ok`` replies; failures complete any
+        attached duplicates but are not retained for replay (a retry
+        of a failed key deserves a fresh compute)."""
+        deadline = header.get("deadline")
+        if deadline is not None and time.monotonic() >= deadline:
+            # Expired before we touched the engine: the budget was
+            # spent upstream (queues, retries). Answer fast — serving
+            # it would hand back a too-late result the client already
+            # gave up on.
+            return ({"status": "timeout",
+                     "error": "deadline expired at worker admission"},
+                    b"", False)
+        try:
+            fut = self._submit_from_wire(header, body)
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.001))
+            flow = fut.result(timeout=remaining)
+        except RequestTimedOut as e:
+            return {"status": "timeout", "error": str(e)}, b"", False
+        except (concurrent.futures.TimeoutError, TimeoutError):
+            # fut.result() outlived the wire deadline.
+            return ({"status": "timeout",
+                     "error": "deadline expired in flight"}, b"", False)
+        except Exception as e:     # engine-side failure: typed reply
+            return ({"status": "error",
+                     "error_type": type(e).__name__,
+                     "error": str(e)}, b"", False)
+        flow = np.ascontiguousarray(flow, dtype=np.float32)
+        return ({"status": "ok",
+                 "shape": list(flow.shape),
+                 "dtype": "float32",
+                 "worker": self.config.worker_id},
+                flow.tobytes(), True)
 
     def _submit_from_wire(self, header: dict, body: bytearray):
         """Reconstruct the frame pair as zero-copy views of the
@@ -573,12 +838,99 @@ class WorkerServer:
                             offset=0).reshape(shape)
         im2 = np.frombuffer(body, dtype=dtype, count=n,
                             offset=split).reshape(shape)
+        self.computes += 1          # the one-compute audit counter
         return self.engine.submit(
             im1, im2,
             priority=header.get("priority", PRIORITY_HIGH),
             iters=header.get("iters"),
             trace_id=header.get("trace_id"),
             deadline_s=header.get("deadline"))
+
+    # -- SDC sentinel ----------------------------------------------------
+
+    def _golden_pair(self) -> Tuple[np.ndarray, np.ndarray]:
+        """A deterministic frame pair at the first configured bucket
+        shape — exactly a warmed executable's shape, so the self-check
+        can never justify a fresh compile."""
+        h, w = (int(v) for v in self.config.buckets[0])
+        rng = np.random.RandomState(0)
+        im1 = rng.randint(0, 256, size=(h, w, 3)).astype(np.uint8)
+        im2 = rng.randint(0, 256, size=(h, w, 3)).astype(np.uint8)
+        return im1, im2
+
+    def _self_check_flow(self, im1: np.ndarray,
+                         im2: np.ndarray) -> np.ndarray:
+        """One golden-pair inference at HIGH priority (the brownout
+        ladder never cheapens HIGH, so the reference stays bit-exact
+        even while the overload valve is engaged)."""
+        fut = self.engine.submit(
+            im1, im2, priority=PRIORITY_HIGH,
+            trace_id=f"sdc-{self.config.worker_id}-{self._self_checks}")
+        timeout = max(30.0, 10 * self.config.self_check_interval_s)
+        return np.asarray(fut.result(timeout=timeout), dtype=np.float32)
+
+    def _quarantine(self, reason: str) -> None:
+        logger.error("SDC sentinel failed: %s — quarantining worker %s",
+                     reason, self.config.worker_id)
+        self.quarantine_reason = reason
+        self._quarantined = True
+        self._publish_lease()       # go QUARANTINED now, not next beat
+
+    def _sentinel_loop(self) -> None:
+        """Periodic silent-data-corruption self-check: golden pair →
+        finite + EPE drift band vs the post-warmup reference + zero
+        fresh compiles (the HotReloader canary's acceptance gates,
+        pointed at the *hardware/runtime* instead of a new model). Any
+        failure is terminal for this process: flip the lease to
+        QUARANTINED and let the supervisor recycle us."""
+        im1, im2 = self._golden_pair()
+        try:
+            self._sentinel_ref = self._self_check_flow(im1, im2)
+        except Exception as e:
+            # Can't even establish a reference post-warmup: that is
+            # itself a failed self-check.
+            self._quarantine(f"reference inference failed: {e}")
+            return
+        if not np.all(np.isfinite(self._sentinel_ref)):
+            self._quarantine("non-finite reference flow")
+            return
+        while not self._stop.wait(self.config.self_check_interval_s):
+            if self._quarantined or self._draining:
+                return
+            self._self_checks += 1
+            seq = self._self_checks
+            base = (self._compile_watch.so_far
+                    if self._compile_watch is not None else 0)
+            try:
+                flow = self._self_check_flow(im1, im2)
+            except Exception as e:
+                self._quarantine(f"self-check {seq} failed: {e}")
+                return
+            inj = resilience.active_injector()
+            if inj is not None and inj.corrupts_self_check(seq):
+                # Injected SDC: flip bits in the computed answer
+                # before the comparison — the corruption is in the
+                # output, the detection must be the sentinel's.
+                logger.warning("injected SDC on self-check %d", seq)
+                flow = flow + np.float32(1e6)
+            compiles = ((self._compile_watch.so_far
+                         if self._compile_watch is not None else 0)
+                        - base)
+            if not np.all(np.isfinite(flow)):
+                self._quarantine(f"self-check {seq}: non-finite flow")
+                return
+            epe = float(np.mean(np.sqrt(np.sum(
+                (flow - self._sentinel_ref) ** 2, axis=-1))))
+            if epe > self.config.self_check_max_epe:
+                self._quarantine(
+                    f"self-check {seq}: EPE drift {epe:.3f} > "
+                    f"{self.config.self_check_max_epe}")
+                return
+            if compiles > 0:
+                self._quarantine(
+                    f"self-check {seq}: {compiles} fresh compile(s) "
+                    "on a warmed bucket shape")
+                return
 
 
 # -- process entry points -----------------------------------------------

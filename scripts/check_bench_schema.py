@@ -77,6 +77,18 @@ AUTOSCALE_COUNTERS = ("scale_ups", "graceful_drains", "failover_retries",
                       "completed", "dropped", "mismatched",
                       "post_warmup_compiles")
 
+# The reliability drill's artifact is likewise a contract record: the
+# exactly-once claim (computes == unique requests despite duplicate
+# deliveries and lost replies) plus the hedge/quarantine lifecycle are
+# only auditable through the counters riding with the number.
+RELIABILITY_METRIC = "reliability_drill_exactly_once_effect"
+RELIABILITY_COUNTERS = ("completed", "dropped", "mismatched",
+                        "post_warmup_compiles", "dedup_replays",
+                        "dedup_hits_inflight", "dup_deliveries",
+                        "worker_computes", "chain_rewalks",
+                        "failover_retries", "hedges", "hedge_wins",
+                        "quarantine_recycles")
+
 
 def _check_trace_artifact(path) -> List[str]:
     """Validate a payload's optional ``trace_artifact`` reference: the
@@ -147,6 +159,16 @@ def check_payload(name: str, payload: dict) -> List[str]:
                 f"autoscale drill artifact missing counter(s) {missing} "
                 "in 'drill' — the convergence claim needs its audit "
                 "trail")
+    if payload.get("metric") == RELIABILITY_METRIC:
+        drill = payload.get("drill")
+        missing = [k for k in RELIABILITY_COUNTERS
+                   if not isinstance(drill, dict)
+                   or not isinstance(drill.get(k), numbers.Number)]
+        if missing:
+            problems.append(
+                f"reliability drill artifact missing counter(s) "
+                f"{missing} in 'drill' — the exactly-once claim needs "
+                "its audit trail")
     return [f"{name}: {p}" for p in problems]
 
 

@@ -115,6 +115,25 @@
     edge → gateway → workers IN ORDER with ``/readyz`` answering 503
     while the listener is still open (the load-balancer grace window).
 
+14. reliability (``--drill reliability``) — end-to-end request
+    reliability. Stage A: a single-owner fleet under injected reply
+    loss (``RAFT_FAULT_WORKER_SOCKET_DROP`` — the reply is computed,
+    cached, then the socket is RST) and duplicate delivery
+    (``RAFT_FAULT_WORKER_DUP_DELIVERY_NTH``): every lost reply is
+    served by the gateway's same-key chain rewalk from the worker's
+    idempotency cache, bit-exact, and the lease-published audit
+    counters prove the EXACTLY-ONCE EFFECT — worker computes equals
+    unique requests despite more deliveries than requests. Stage B: a
+    3-worker fleet takes a mid-load SIGKILL (post-acceptance retries,
+    0 dropped), then a partition-injected worker stalls its primary
+    bucket and the gateway's tail-latency hedge rescues the request
+    (hedge fires, hedge wins, budget-capped), and an SDC-injected
+    worker (``RAFT_FAULT_WORKER_SDC_NTH``) fails its sentinel
+    self-check, goes QUARANTINED (non-routable), is recycled by the
+    supervisor WITHOUT crash accounting, and its replacement rejoins
+    routable. Gate: 0 dropped, 0 bit-incorrect, 0 post-warmup
+    compiles everywhere.
+
 Correctness is bit-exact: on this script's single-process default
 topology the batch-1 ``__call__`` path and the batched serve path are
 bit-identical; under a forced multi-device topology
@@ -2142,6 +2161,362 @@ def drill_edge(root):
         sup.stop(kill_workers=True)
 
 
+def drill_reliability(root):
+    """End-to-end request reliability: idempotent dispatch replays a
+    reply lost after acceptance (exactly-once compute effect, proven
+    by lease-published audit counters), injected duplicate delivery
+    collapses in the worker's dedup cache, tail-latency hedging
+    rescues a partition-stalled request under budget, and an
+    SDC-failed worker is quarantined and recycled without crash
+    accounting. Gate: 0 dropped, 0 bit-incorrect, 0 post-warmup
+    compiles."""
+    import json
+    import signal as signal_mod
+
+    import numpy as np
+
+    from raft_tpu.serving import loadgen
+    from raft_tpu.serving.fleet import BucketRouter
+    from raft_tpu.serving.gateway import GatewayConfig, ServingGateway
+    from raft_tpu.serving.health import is_routable
+    from raft_tpu.serving.netproto import FileLeaseStore
+    from raft_tpu.serving.supervisor import WorkerSpec, WorkerSupervisor
+    from raft_tpu.serving.worker import WorkerConfig
+
+    STEP = 0
+    predictor = _make_predictor()
+    frames = loadgen.make_frames(SHAPES, per_shape=2, seed=37)
+    refs, ref_kind = _references(predictor, frames, max_batch=4)
+    print(f"  reference = {ref_kind}")
+
+    # ---- Stage A: reply loss + duplicate delivery, ONE owner --------
+    # A single-worker fleet makes the retry-after-send contract
+    # unambiguous: a reply dropped post-acceptance leaves the gateway
+    # no other owner, so completing the request REQUIRES the same-key
+    # chain rewalk back to the same worker and a dedup-cache replay —
+    # provable cross-process via the lease-published audit counters.
+    lease_a = os.path.join(root, "leases_a")
+    store_a = FileLeaseStore(lease_a)
+    # spawn_worker treats env as a full REPLACEMENT — merge over the
+    # parent environment (JAX_PLATFORMS et al).
+    env_a = dict(os.environ,
+                 RAFT_FAULT_WORKER_SOCKET_DROP="2",
+                 RAFT_FAULT_WORKER_DUP_DELIVERY_NTH="5")
+    sup_a = WorkerSupervisor(
+        [WorkerSpec("solo0", WorkerConfig(
+            worker_id="solo0", lease_dir=lease_a, buckets=BUCKETS,
+            max_batch=4, max_wait_ms=3.0, queue_timeout_ms=60_000,
+            step=STEP).to_dict(), env=env_a)],
+        store_a, stale_after_s=3.0, lease_grace_s=300.0,
+        poll_interval_s=0.25, respawn_base_delay_s=0.25,
+        respawn_max_delay_s=2.0, min_uptime_s=2.0)
+    gw_a = ServingGateway(store_a, GatewayConfig(
+        queue_timeout_ms=120_000, lease_ttl_s=2.0, poll_interval_s=0.1,
+        dispatch_threads=4, expected_step=STEP))
+    sup_a.start_all()
+    sup_a.start()
+    gw_a.start()
+    n_a = 24
+    try:
+        _await_metric(lambda: len(gw_a.live_workers()), 1, 300.0,
+                      "the solo worker becoming routable")
+        res_a = loadgen.run_load(gw_a, frames, n_requests=n_a,
+                                 concurrency=4, references=refs,
+                                 timeout=600.0)
+        assert res_a["completed"] == n_a, \
+            f"completed {res_a['completed']}/{n_a}"
+        assert not res_a["dropped"], f"dropped: {res_a['dropped']}"
+        assert not res_a["mismatched"], \
+            f"bit-incorrect responses: {res_a['mismatched']}"
+        # Two dropped replies, one owner: each MUST have completed via
+        # a chain rewalk (retry-after-send) — the PR-18 refusal is gone.
+        rewalks_a = gw_a.metrics.chain_rewalks
+        retries_a = sum(gw_a.metrics.retries.values())
+        assert rewalks_a >= 2, \
+            f"expected >=2 chain rewalks for 2 dropped replies, " \
+            f"got {rewalks_a}"
+        assert retries_a >= 2, \
+            f"expected >=2 same-key retries, got {retries_a}"
+
+        # Cross-process audit via the worker's own lease heartbeat
+        # (the audit counters ride the lease's ``dedup`` extra).
+        def _solo_computes():
+            lease = store_a.read_all().get("solo0")
+            if lease is None:
+                return 0
+            return int(lease.extra.get("dedup", {}).get("computes", 0))
+
+        _await_metric(_solo_computes, n_a, 30.0,
+                      "solo0's lease publishing its compute count")
+        lease = store_a.read_all()["solo0"]
+        dd = lease.extra["dedup"]
+        replays_a = int(dd["replays"])
+        hits_inflight_a = int(dd["hits_inflight"])
+        dups_a = int(dd["dup_deliveries"])
+        computes_a = int(dd["computes"])
+        # 2 lost-reply retries + 1 injected duplicate, all answered
+        # from the idempotency cache (replay or in-flight attach)...
+        assert replays_a + hits_inflight_a >= 3, lease.extra
+        assert dups_a == 1, lease.extra
+        # ...and the EXACTLY-ONCE EFFECT: computes == unique requests
+        # despite deliveries > requests.
+        assert computes_a == n_a, \
+            f"exactly-once violated: {computes_a} computes for " \
+            f"{n_a} requests ({lease.extra})"
+        assert lease.extra.get("post_warmup_compiles") == 0, lease.extra
+        print(f"  stage A: {n_a}/{n_a} bit-exact through 2 dropped "
+              f"replies + 1 duplicate delivery; rewalks={rewalks_a}, "
+              f"replays={replays_a}, inflight-hits={hits_inflight_a}, "
+              f"computes={computes_a} (exactly-once)")
+    finally:
+        gw_a.close()
+        sup_a.stop(kill_workers=True)
+
+    # ---- Stage B: SIGKILL + hedged stall + SDC quarantine -----------
+    lease_b = os.path.join(root, "leases_b")
+    store_b = FileLeaseStore(lease_b)
+
+    def _cfg_b(wid, **kw):
+        return WorkerConfig(
+            worker_id=wid, lease_dir=lease_b, buckets=BUCKETS,
+            max_batch=4, max_wait_ms=3.0, queue_timeout_ms=60_000,
+            step=STEP, **kw).to_dict()
+
+    base_ids = ["w0", "w1", "w2"]
+    sup = WorkerSupervisor(
+        [WorkerSpec(w, _cfg_b(w)) for w in base_ids], store_b,
+        stale_after_s=3.0, lease_grace_s=300.0, poll_interval_s=0.25,
+        respawn_base_delay_s=0.25, respawn_max_delay_s=2.0,
+        min_uptime_s=2.0)
+    hedge_fraction = 0.5
+    gw = ServingGateway(store_b, GatewayConfig(
+        queue_timeout_ms=120_000, lease_ttl_s=2.0, poll_interval_s=0.1,
+        dispatch_threads=CONCURRENCY, expected_step=STEP,
+        hedge_quantile=0.9, hedge_min_ms=50.0, hedge_min_samples=6,
+        hedge_budget_fraction=hedge_fraction))
+    sup.attach_registry(gw.registry)
+    sup.start_all()
+    sup.start()
+    gw.start()
+    try:
+        _await_metric(lambda: len(gw.live_workers()), 3, 300.0,
+                      "3 workers routable")
+
+        killed = {}
+
+        def killer():
+            _await_metric(lambda: gw.metrics.responses, 5, 120.0,
+                          "responses before kill")
+            victim = gw.metrics.routed.most_common(1)[0][0]
+            pid = store_b.read_all()[victim].pid
+            os.kill(pid, signal_mod.SIGKILL)
+            killed["victim"], killed["pid"] = victim, pid
+            print(f"  SIGKILLed {victim} (pid {pid}) mid-load",
+                  flush=True)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        n_warm = 40
+        res1 = loadgen.run_load(gw, frames, n_requests=n_warm,
+                                concurrency=CONCURRENCY,
+                                references=refs, timeout=600.0)
+        kt.join(timeout=120.0)
+        assert "victim" in killed, "kill thread never fired"
+        victim = killed["victim"]
+        assert res1["completed"] == n_warm, \
+            f"completed {res1['completed']}/{n_warm}"
+        assert not res1["dropped"], f"dropped: {res1['dropped']}"
+        assert not res1["mismatched"], \
+            f"bit-incorrect responses: {res1['mismatched']}"
+        retries_b = sum(gw.metrics.retries.values())
+        assert retries_b >= 1, \
+            "SIGKILL produced no post-acceptance retries"
+        print(f"  stage B warm wave: {n_warm}/{n_warm} bit-exact "
+              f"through the {victim} SIGKILL ({retries_b} same-key "
+              f"retries)")
+
+        # The stall worker must OWN a bucket or the partition never
+        # arms. Rendezvous scores are per-(key, id), so an id that
+        # tops a key in the superset tops it in every live subset.
+        stall_wid = stall_key = None
+        for i in range(1000):
+            cand = f"stall{i}"
+            r = BucketRouter(base_ids + [cand])
+            for k in ("40x64", "56x80"):
+                if r.owners_for_key(k)[0] == cand:
+                    stall_wid, stall_key = cand, k
+                    break
+            if stall_wid:
+                break
+        assert stall_wid, "no rendezvous-winning stall worker id found"
+        sup.add_worker(WorkerSpec(
+            stall_wid, _cfg_b(stall_wid),
+            env=dict(os.environ, RAFT_FAULT_WORKER_PARTITION_S="8.0")))
+
+        _await_metric(lambda: sup.respawns(victim), 1, 120.0,
+                      f"supervised respawn of {victim}")
+        _await_metric(lambda: 1 if victim in gw.live_workers() else 0,
+                      1, 300.0, f"{victim} rejoining the routable set")
+        _await_metric(
+            lambda: 1 if stall_wid in gw.live_workers() else 0,
+            1, 300.0, f"{stall_wid} becoming routable")
+
+        # A frame whose padded bucket the stall worker owns: its first
+        # delivery arms the 8s blackhole; the gateway's hedge (p90 +
+        # 50ms floor, budget permitting) must rescue it on the next
+        # owner under the SAME idempotency key.
+        key_of = {(36, 60): "40x64", (33, 57): "40x64",
+                  (52, 76): "56x80"}
+        si = next(i for i, (a, _b) in enumerate(frames)
+                  if key_of[a.shape[:2]] == stall_key)
+        im1, im2 = frames[si]
+        h0, hw0 = gw.metrics.hedges, gw.metrics.hedge_wins
+        f1 = gw.submit(im1, im2)
+        flow1 = f1.result(120.0)
+        assert np.array_equal(flow1, refs[si]), \
+            "hedged request not bit-exact"
+        assert f1.replica_id != stall_wid, \
+            f"stalled worker {stall_wid} somehow answered first"
+        for _ in range(2):
+            fx = gw.submit(im1, im2)
+            assert np.array_equal(fx.result(120.0), refs[si]), \
+                "request during the stall window not bit-exact"
+        hedges_fired = gw.metrics.hedges - h0
+        hedge_wins = gw.metrics.hedge_wins - hw0
+        assert hedges_fired >= 1, "the stall fired no hedge"
+        assert hedge_wins >= 1, \
+            f"no hedge win against the stalled primary " \
+            f"(fired {hedges_fired})"
+        print(f"  hedge vs stall: {hedges_fired} fired, {hedge_wins} "
+              f"won; winner={f1.replica_id} (stalled={stall_wid})")
+
+        # The SDC worker must NOT steal a bucket from the stall worker
+        # (the post-stall wave asserts the stall worker serves again).
+        sdc_wid = None
+        for i in range(1000):
+            cand = f"sdc{i}"
+            r = BucketRouter(base_ids + [stall_wid, cand])
+            if all(r.owners_for_key(k)[0] != cand
+                   for k in ("40x64", "56x80")):
+                sdc_wid = cand
+                break
+        assert sdc_wid, "no non-owning sdc worker id found"
+        # Long self-check interval: the recycled replacement gets a
+        # routable window (the spec's env — injector included — rides
+        # every respawn) before its own sentinel trips again.
+        sup.add_worker(WorkerSpec(
+            sdc_wid, _cfg_b(sdc_wid, self_check_interval_s=8.0),
+            env=dict(os.environ, RAFT_FAULT_WORKER_SDC_NTH="1")))
+
+        time.sleep(8.5)             # let the blackhole window expire
+        n_post = 16
+        res2 = loadgen.run_load(gw, frames, n_requests=n_post,
+                                concurrency=4, references=refs,
+                                timeout=600.0)
+        assert res2["completed"] == n_post and not res2["dropped"] \
+            and not res2["mismatched"], res2
+        assert res2["per_replica"].get(stall_wid, {}).get(
+            "completed", 0) >= 1, \
+            (f"{stall_wid} never served post-partition: "
+             f"{res2['per_replica']}")
+        print(f"  post-stall wave: {res2['completed']}/{n_post} "
+              f"bit-exact; {stall_wid} back in rotation")
+
+        # SDC sentinel: the worker joins routable, its first periodic
+        # self-check is corrupted -> QUARANTINED -> the supervisor
+        # recycles it WITHOUT crash accounting and the replacement
+        # rejoins routable.
+        _await_metric(lambda: 1 if sdc_wid in gw.live_workers() else 0,
+                      1, 300.0, f"{sdc_wid} warmed and routable")
+        first_pid = store_b.read_all()[sdc_wid].pid
+        _await_metric(
+            lambda: sup.status()[sdc_wid]["quarantine_recycles"],
+            1, 180.0, f"the quarantine recycle of {sdc_wid}")
+        st = sup.status()[sdc_wid]
+        assert st["crash_streak"] == 0, \
+            f"quarantine counted as a crash: {st}"
+        assert st["breaker"] == "closed", st
+        quarantine_recycles = int(st["quarantine_recycles"])
+
+        def _sdc_rejoined():
+            lease = store_b.read_all().get(sdc_wid)
+            if lease is None or lease.pid == first_pid:
+                return 0
+            return 1 if sdc_wid in gw.live_workers() else 0
+
+        _await_metric(_sdc_rejoined, 1, 300.0,
+                      f"{sdc_wid}'s replacement rejoining routable")
+        sdc_lease = store_b.read_all()[sdc_wid]
+        assert is_routable(sdc_lease.state), sdc_lease.state
+        assert sdc_lease.extra.get("post_warmup_compiles") == 0, \
+            sdc_lease.extra
+        print(f"  SDC: {sdc_wid} quarantined and recycled "
+              f"(recycles={quarantine_recycles}, crash_streak=0); "
+              f"replacement pid {sdc_lease.pid} routable")
+
+        # Hedges stay budget-capped fleet-wide, and the reliability
+        # gauges ride the Prometheus export.
+        total_requests = gw.metrics.requests
+        assert gw.metrics.hedges <= \
+            hedge_fraction * total_requests + 4.0, \
+            (f"hedges {gw.metrics.hedges} exceed budget "
+             f"{hedge_fraction} of {total_requests} requests")
+        txt = gw.registry.prometheus_text()
+        for needle in (
+                f'gateway_worker_quarantine_recycles{{worker="{sdc_wid}"}}',
+                "gateway_hedges", "gateway_hedge_wins",
+                "gateway_chain_rewalks"):
+            assert needle in txt, f"{needle!r} missing from export"
+
+        # Zero post-warmup compiles on every lease-holder.
+        for wid, l in sorted(store_b.read_all().items()):
+            compiles = l.extra.get("post_warmup_compiles", 0)
+            assert compiles == 0, \
+                f"{wid} reports {compiles} post-warmup compile(s)"
+        print("  0 post-warmup compiles fleet-wide; reliability "
+              "gauges in the registry export")
+
+        bench_out = os.environ.get("RAFT_BENCH_OUT")
+        if bench_out:
+            payload = {
+                "metric": "reliability_drill_exactly_once_effect",
+                "value": float(replays_a + hits_inflight_a),
+                "unit": "deduped_duplicate_replies",
+                "platform": "cpu",
+                "smoke_operating_point": True,
+                "criterion_note": (
+                    "CPU drill topology (small model, 2-bucket load): "
+                    "the numbers prove the request-reliability "
+                    "CONTRACT (idempotent replay after reply loss, "
+                    "budget-capped hedging, SDC quarantine recycle), "
+                    "not serving throughput; on-TPU capture is "
+                    "ROADMAP debt"),
+                "drill": {
+                    "completed": (res_a["completed"]
+                                  + res1["completed"]
+                                  + res2["completed"] + 3),
+                    "dropped": 0,
+                    "mismatched": 0,
+                    "post_warmup_compiles": 0,
+                    "dedup_replays": replays_a,
+                    "dedup_hits_inflight": hits_inflight_a,
+                    "dup_deliveries": dups_a,
+                    "worker_computes": computes_a,
+                    "chain_rewalks": rewalks_a,
+                    "failover_retries": retries_b,
+                    "hedges": int(gw.metrics.hedges),
+                    "hedge_wins": int(gw.metrics.hedge_wins),
+                    "quarantine_recycles": quarantine_recycles,
+                },
+            }
+            with open(bench_out, "w") as f:
+                json.dump(payload, f)
+            print(f"  wrote {bench_out}")
+    finally:
+        gw.close()
+        sup.stop(kill_workers=True)
+
+
 DRILLS = [
     drill_smoke,
     drill_breaker_isolation,
@@ -2157,6 +2532,7 @@ DRILLS = [
     drill_gateway,
     drill_autoscale,
     drill_edge,
+    drill_reliability,
 ]
 
 

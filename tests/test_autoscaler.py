@@ -252,6 +252,23 @@ class TestAutoscaler:
         assert auto.poll_once() == "scale-down"
         assert tr.sent[0][0] == ("127.0.0.1", 9002)
 
+    def test_quarantined_worker_never_a_victim(self, tmp_path):
+        """A QUARANTINED worker is a fault awaiting the supervisor's
+        directed recycle, not spare capacity: draining it would turn
+        the replacement into a permanent capacity loss. The scale-down
+        victim must be a routable worker."""
+        from raft_tpu.serving.health import QUARANTINED
+
+        auto, sup, store, sig, clock, wall, tr, _ = self._rig(
+            tmp_path, n_workers=2, scale_down_cooldown_s=0.0)
+        # w0 least loaded but quarantined; w1 routable despite load.
+        self._lease(store, wall, "w0", load=0.0, state=QUARANTINED,
+                    port=9000)
+        self._lease(store, wall, "w1", load=9.0, port=9001)
+        assert auto.poll_once() == "scale-down"
+        assert tr.sent[0][0] == ("127.0.0.1", 9001)
+        assert sup.status()["w0"]["draining"] is False
+
     def test_stale_lease_not_a_victim(self, tmp_path):
         auto, sup, store, sig, clock, wall, tr, _ = self._rig(
             tmp_path, scale_down_cooldown_s=0.0, lease_ttl_s=2.0)

@@ -11,8 +11,9 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from check_bench_schema import (AUTOSCALE_METRIC,  # noqa: E402
                                 CONTBATCH_METRIC, EDGE_METRIC,
-                                GATEWAY_METRIC, STEP_METRIC, check_file,
-                                check_payload, main)
+                                GATEWAY_METRIC, RELIABILITY_COUNTERS,
+                                RELIABILITY_METRIC, STEP_METRIC,
+                                check_file, check_payload, main)
 
 
 def test_committed_artifacts_honor_schema(capsys):
@@ -156,6 +157,31 @@ def test_checker_requires_autoscale_audit_trail():
     # An honest error record is exempt.
     assert not check_payload("err", {
         "metric": AUTOSCALE_METRIC, "value": None, "error": "boom"})
+
+
+def test_checker_requires_reliability_audit_trail():
+    counters = {k: 0 for k in RELIABILITY_COUNTERS}
+    counters.update(completed=83, dedup_replays=2,
+                    dedup_hits_inflight=1, dup_deliveries=1,
+                    worker_computes=24, chain_rewalks=2,
+                    failover_retries=3, hedges=2, hedge_wins=1,
+                    quarantine_recycles=1)
+    base = {"metric": RELIABILITY_METRIC, "value": 3.0,
+            "unit": "deduped_duplicate_replies", "platform": "cpu",
+            "smoke_operating_point": True}
+    assert not check_payload("ok", dict(base, drill=counters))
+    # Missing the drill dict, a missing counter, or a non-numeric
+    # counter: all violations — the exactly-once claim needs its
+    # audit trail.
+    assert check_payload("none", base)
+    partial = dict(counters)
+    del partial["worker_computes"]
+    assert check_payload("half", dict(base, drill=partial))
+    assert check_payload("shape", dict(
+        base, drill=dict(counters, quarantine_recycles="1")))
+    # An honest error record is exempt.
+    assert not check_payload("err", {
+        "metric": RELIABILITY_METRIC, "value": None, "error": "boom"})
 
 
 def test_checker_rejects_silent_empty_wrapper(tmp_path):

@@ -92,10 +92,11 @@ class FakeGateway:
         self.held = []               # unresolved futures under "hold"
 
     def submit(self, im1, im2, priority="high", iters=None,
-               trace_id=None, deadline=None):
+               trace_id=None, deadline=None, request_id=None):
         self.calls.append({"shape": im1.shape, "priority": priority,
                            "iters": iters, "trace_id": trace_id,
-                           "deadline": deadline})
+                           "deadline": deadline,
+                           "request_id": request_id})
         fut = concurrent.futures.Future()
         if self.resolve_with == "hold":
             self.held.append(fut)

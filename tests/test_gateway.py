@@ -331,15 +331,123 @@ class TestRouting:
         def dead(addr, header, body):
             raise WorkerConnectionError("connection reset")
 
-        gw = _gateway(store, FakeTransport([dead, dead]), clock, wall)
+        gw = _gateway(store, FakeTransport([dead] * 4), clock, wall)
         fut = gw.submit(FRAME, FRAME)
         gw._dispatch_next(timeout=0)
         with pytest.raises(EngineUnhealthy) as ei:
             fut.result(0)
-        # Each worker tried at most once, then shed naming the fleet.
-        assert len(gw.transport.sent) == 2
+        # Connection-class exhaustion re-walks the chain once (the
+        # default retry_rounds=2, safe under the idempotency key):
+        # each worker tried once per round, then shed naming the fleet.
+        assert len(gw.transport.sent) == 4
+        assert gw.metrics.chain_rewalks == 1
         assert "w0" in str(ei.value) and "w1" in str(ei.value)
         assert gw.metrics.shed == 1
+
+    def test_single_round_exhaustion_does_not_rewalk(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+
+        def dead(addr, header, body):
+            raise WorkerConnectionError("connection reset")
+
+        gw = _gateway(store, FakeTransport([dead, dead]), clock, wall,
+                      retry_rounds=1)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        with pytest.raises(EngineUnhealthy):
+            fut.result(0)
+        assert len(gw.transport.sent) == 2
+        assert gw.metrics.chain_rewalks == 0
+
+    def test_typed_errors_never_trigger_a_rewalk(self, tmp_path):
+        """Deterministic (typed) worker errors would only repeat on a
+        second pass: the rewalk is reserved for CONNECTION-class
+        failures."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+        err = lambda a, h, b: ({"status": "error",       # noqa: E731
+                                "error_type": "RuntimeError",
+                                "error": "boom"}, bytearray())
+        gw = _gateway(store, FakeTransport([err, err]), clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        with pytest.raises(EngineUnhealthy):
+            fut.result(0)
+        assert len(gw.transport.sent) == 2
+        assert gw.metrics.chain_rewalks == 0
+
+    def test_idempotency_key_minted_and_stable_across_retries(
+            self, tmp_path):
+        """Every hop of one request carries the SAME gateway-minted
+        request_id — the wire contract that makes retry-after-send
+        safe (the worker's dedup cache collapses re-sends)."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+
+        def dead(addr, header, body):
+            raise WorkerConnectionError("connection reset")
+
+        gw = _gateway(store, FakeTransport([dead, _ok_reply("w-ok")]),
+                      clock, wall)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        fut.result(0)
+        keys = [h["request_id"] for _, h, _ in gw.transport.sent]
+        assert len(keys) == 2
+        assert keys[0] == keys[1]
+        assert isinstance(keys[0], str) and len(keys[0]) == 32
+
+    def test_client_supplied_request_id_reaches_the_wire(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0"], wall)
+        gw = _gateway(store, FakeTransport([_ok_reply()]), clock, wall)
+        fut = gw.submit(FRAME, FRAME, request_id="edge-supplied-key")
+        gw._dispatch_next(timeout=0)
+        fut.result(0)
+        (_, header, _), = gw.transport.sent
+        assert header["request_id"] == "edge-supplied-key"
+
+    def test_reply_connection_drop_is_retried_not_refused(self, tmp_path):
+        """The PR-18 gap, closed: a connection that dies AFTER the
+        worker accepted (reply bytes lost — RAFT_FAULT_WORKER_SOCKET_
+        DROP) no longer surfaces WorkerConnectionError to the caller.
+        The gateway re-walks the chain under the same idempotency key
+        and the worker replays its cached reply: exactly one engine
+        compute, a successful answer, zero recomputation."""
+        from raft_tpu import resilience
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _StubEngine()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.05, step=3)
+        server = WorkerServer(engine, cfg).start(warmup=False)
+        gw = ServingGateway(
+            server.store,
+            GatewayConfig(queue_timeout_ms=30_000, dispatch_threads=0,
+                          poll_interval_s=0.0),
+            transport=SocketTransport())
+        prev = resilience.set_injector(
+            resilience.FaultInjector(worker_socket_drop=1))
+        try:
+            deadline = time.monotonic() + 10.0
+            while not gw.live_workers():
+                assert time.monotonic() < deadline, "worker never live"
+                gw.refresh_membership()
+                time.sleep(0.01)
+            fut = gw.submit(FRAME, FRAME)
+            gw._dispatch_next(timeout=0)
+            flow = fut.result(0)            # resolved, not refused
+            assert flow.shape == (8, 8, 2)
+            assert len(engine.submits) == 1      # exactly one compute
+            assert server.computes == 1
+            assert server.dedup.stats()["replays"] == 1
+            assert gw.metrics.chain_rewalks == 1
+            assert sum(gw.metrics.retries.values()) == 1
+        finally:
+            resilience.set_injector(prev)
+            gw.close()
+            server.stop()
 
     def test_no_lease_holder_sheds(self, tmp_path):
         clock, wall = FakeClock(), FakeClock(1000.0)
@@ -374,6 +482,173 @@ class TestRouting:
         # w{i} listens on port 9000+i in _fresh_store.
         owner_port = 9000 + workers.index(expected[0])
         assert got["addr"][1] == owner_port
+
+
+# -- hedged dispatch ------------------------------------------------------
+
+class _AddrTransport:
+    """Thread-safe transport keyed by ADDRESS: hedge tests race two
+    pool threads, so pop-order scripting (FakeTransport) would be
+    nondeterministic. Handlers may sleep real time — the hedge trigger
+    (`Future.result(timeout=...)`) runs on the real clock."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.sent = []
+        self._lock = threading.Lock()
+
+    def request(self, addr, header, body=b"", deadline=None,
+                clock=time.monotonic):
+        with self._lock:
+            self.sent.append((tuple(addr), dict(header), bytes(body)))
+        return self.handlers[tuple(addr)](addr, header, body)
+
+    def close(self):
+        pass
+
+
+class TestHedging:
+    """Tail-latency hedging: one extra dispatch to the next owner
+    after the bucket's latency quantile elapsed, same idempotency key,
+    first reply wins — bounded by a token budget and vetoed under
+    pressure (*The Tail at Scale*)."""
+
+    def _rig(self, tmp_path, **cfg):
+        """Two ready workers, an address-keyed transport, and the
+        request's bucket key discovered via one warm submit (both
+        addresses answering instantly)."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+        transport = _AddrTransport()
+        for i in range(2):
+            transport.handlers[("127.0.0.1", 9000 + i)] = \
+                _ok_reply(f"w{i}")
+        cfg.setdefault("hedge_quantile", 0.5)
+        cfg.setdefault("hedge_min_ms", 10.0)
+        cfg.setdefault("hedge_min_samples", 4)
+        cfg.setdefault("hedge_budget_fraction", 1.0)
+        gw = _gateway(store, transport, clock, wall, **cfg)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        fut.result(5)
+        key = next(iter(gw.metrics._lat_by_key))
+        transport.sent.clear()
+        owners = gw.router.owners_for_key(key)
+        addr_of = {w: ("127.0.0.1", 9000 + int(w[1:])) for w in owners}
+        return gw, transport, key, owners, addr_of
+
+    def _seed_history(self, gw, key, n=8, latency=0.005):
+        for _ in range(n):
+            gw.metrics.record_response("seed", latency, key=key)
+
+    def _slow(self, worker, delay_s):
+        def handler(addr, header, body):
+            time.sleep(delay_s)
+            return _ok_reply(worker)(addr, header, body)
+        return handler
+
+    def test_hedge_fires_and_first_reply_wins(self, tmp_path):
+        gw, tr, key, owners, addr_of = self._rig(tmp_path)
+        self._seed_history(gw, key)
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 1.0)
+        tr.handlers[addr_of[owners[1]]] = _ok_reply("hedge")
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(5).shape == (4, 4, 2)
+        assert fut.replica_id == "hedge"
+        assert gw.metrics.hedges == 1
+        assert gw.metrics.hedge_wins == 1
+        # Both legs carried the SAME idempotency key.
+        keys = {h["request_id"] for _, h, _ in tr.sent}
+        assert len(tr.sent) == 2 and len(keys) == 1
+        # No retry was burned: the hedge is a race, not a failover.
+        assert gw.metrics.retries == {}
+
+    def test_primary_win_accounts_a_hedge_loss(self, tmp_path):
+        gw, tr, key, owners, addr_of = self._rig(tmp_path)
+        self._seed_history(gw, key)
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 0.1)
+        tr.handlers[addr_of[owners[1]]] = self._slow("hedge", 2.0)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(5).shape == (4, 4, 2)
+        assert fut.replica_id == "primary"
+        assert gw.metrics.hedges == 1
+        assert gw.metrics.hedge_losses == 1
+        assert gw.metrics.hedge_wins == 0
+
+    def test_hedge_denied_without_budget(self, tmp_path):
+        gw, tr, key, owners, addr_of = self._rig(
+            tmp_path, hedge_budget_fraction=0.0)
+        self._seed_history(gw, key)
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 0.1)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(5).shape == (4, 4, 2)
+        assert gw.metrics.hedges == 0
+        assert gw.metrics.hedge_denied_budget == 1
+        assert len(tr.sent) == 1    # the hedge leg never dispatched
+
+    def test_hedge_budget_caps_fraction_of_traffic(self, tmp_path):
+        """N slow requests at fraction f accrue ~f*N tokens: fired
+        hedges stay within the configured fraction (+ the burst cap),
+        the rest are denied on budget."""
+        n, fraction = 12, 0.25
+        gw, tr, key, owners, addr_of = self._rig(
+            tmp_path, hedge_budget_fraction=fraction)
+        with gw._hedge_lock:
+            gw._hedge_tokens = 0.0      # drop the warm-up accrual
+        self._seed_history(gw, key)
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 0.05)
+        tr.handlers[addr_of[owners[1]]] = self._slow("hedge", 0.05)
+        for _ in range(n):
+            fut = gw.submit(FRAME, FRAME)
+            gw._dispatch_next(timeout=0)
+            fut.result(5)
+        assert gw.metrics.hedges + gw.metrics.hedge_denied_budget == n
+        assert gw.metrics.hedges <= int(n * fraction) + 1
+        assert gw.metrics.hedge_denied_budget >= n - int(
+            n * fraction) - 1
+
+    def test_hedge_denied_under_brownout_pressure(self, tmp_path):
+        gw, tr, key, owners, addr_of = self._rig(tmp_path)
+        self._seed_history(gw, key)
+        # One live worker reports an engaged brownout ladder: hedging
+        # would feed the very overload the valve is shedding. Publish
+        # through the store — _route refreshes membership in manual-
+        # drive mode, so a direct _leases poke would be overwritten.
+        gw.store.publish(Lease(
+            worker_id=owners[1], addr=addr_of[owners[1]],
+            state="ready", t_heartbeat=gw._wall(),
+            extra={"brownout_level": 1}))
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 0.1)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(5).shape == (4, 4, 2)
+        assert gw.metrics.hedges == 0
+        assert gw.metrics.hedge_denied_pressure == 1
+
+    def test_no_hedge_without_latency_history(self, tmp_path):
+        """A bucket whose latency history is thinner than
+        hedge_min_samples never hedges — an untrusted quantile must
+        not trigger extra load."""
+        gw, tr, key, owners, addr_of = self._rig(
+            tmp_path, hedge_min_samples=64)
+        tr.handlers[addr_of[owners[0]]] = self._slow("primary", 0.05)
+        fut = gw.submit(FRAME, FRAME)
+        gw._dispatch_next(timeout=0)
+        assert fut.result(5).shape == (4, 4, 2)
+        assert gw.metrics.hedges == 0
+        assert gw.metrics.hedge_denied_budget == 0
+        assert gw.metrics.hedge_denied_pressure == 0
+        assert len(tr.sent) == 1
+
+    def test_hedging_disabled_by_default(self, tmp_path):
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = _fresh_store(tmp_path, ["w0", "w1"], wall)
+        gw = _gateway(store, FakeTransport([_ok_reply()]), clock, wall)
+        assert gw.config.hedge_quantile == 0.0
+        assert gw._hedge_delay_s("any-key") is None
 
 
 # -- gateway metrics -----------------------------------------------------
@@ -590,6 +865,63 @@ class TestSupervisor:
         assert sup.poll_once()["w0"] == "dead"
         clock.advance(1.0)
         assert sup.poll_once()["w0"] == "respawned"
+
+    def test_quarantine_recycled_is_not_a_crash(self, tmp_path):
+        """A QUARANTINED lease (SDC sentinel verdict) is a directed
+        replacement: kill + immediate respawn with NO crash streak, NO
+        backoff, NO breaker count — a hardware-suspect worker must be
+        replaced exactly as eagerly the tenth time as the first."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        self._heartbeat(store, wall)
+        assert sup.poll_once()["w0"] == "ok"
+        store.publish(Lease(
+            "w0", ("h", 1), "quarantined", t_heartbeat=wall(),
+            extra={"quarantine_reason": "self-check 3: EPE drift"}))
+        assert sup.poll_once()["w0"] == "quarantine-recycled"
+        assert procs[0].killed
+        assert len(procs) == 2          # immediate directed respawn
+        assert store.read_all() == {}   # suspect's lease dropped
+        st = sup.status()["w0"]
+        assert st["quarantine_recycles"] == 1
+        assert st["crash_streak"] == 0
+        assert st["breaker"] == "closed"
+        # The replacement is under normal supervision immediately.
+        self._heartbeat(store, wall)
+        assert sup.poll_once()["w0"] == "ok"
+
+    def test_quarantine_recycle_registry_gauge(self, tmp_path):
+        from raft_tpu.observability.registry import MetricsRegistry
+
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        reg = MetricsRegistry()
+        sup.attach_registry(reg)
+        sup.start_all()
+        store.publish(Lease("w0", ("h", 1), "quarantined",
+                            t_heartbeat=wall()))
+        assert sup.poll_once()["w0"] == "quarantine-recycled"
+        txt = reg.prometheus_text()
+        assert ('gateway_worker_quarantine_recycles{worker="w0"} 1'
+                in txt)
+        assert 'gateway_worker_crash_streak{worker="w0"} 0' in txt
+
+    def test_draining_worker_not_quarantine_recycled(self, tmp_path):
+        """A drain directive outranks the sentinel: a worker already
+        leaving keeps its drain lifecycle (exit-0 retirement), it is
+        not killed as a quarantine recycle."""
+        clock, wall = FakeClock(), FakeClock(1000.0)
+        store = FileLeaseStore(str(tmp_path))
+        sup, procs = self._sup(store, clock, wall)
+        sup.start_all()
+        sup.expect_drain("w0")
+        store.publish(Lease("w0", ("h", 1), "quarantined",
+                            t_heartbeat=wall()))
+        assert sup.poll_once()["w0"] == "draining"
+        assert not procs[0].killed
 
     def test_add_worker_scales_the_fleet(self, tmp_path):
         clock, wall = FakeClock(), FakeClock(1000.0)
@@ -851,11 +1183,14 @@ def stub_worker(tmp_path):
 
 
 class TestWorkerProtocol:
-    def _submit_header(self, frame, deadline=None):
-        return {"op": "submit", "shape": list(frame.shape),
-                "dtype": str(frame.dtype), "split": frame.nbytes,
-                "priority": "high", "iters": None,
-                "deadline": deadline, "trace_id": None}
+    def _submit_header(self, frame, deadline=None, request_id=None):
+        hdr = {"op": "submit", "shape": list(frame.shape),
+               "dtype": str(frame.dtype), "split": frame.nbytes,
+               "priority": "high", "iters": None,
+               "deadline": deadline, "trace_id": None}
+        if request_id is not None:
+            hdr["request_id"] = request_id
+        return hdr
 
     def test_ping_reports_state_and_step(self, stub_worker):
         server, _ = stub_worker
@@ -972,6 +1307,354 @@ class TestWorkerProtocol:
         assert lease.state == "ready" and lease.step == 3
         assert tuple(lease.addr) == tuple(server.addr)
         assert lease.extra.get("post_warmup_compiles") == 0
+        # The reliability audit trail rides the same lease.
+        assert lease.extra["dedup"]["inserts"] == 0
+        assert lease.extra["dedup"]["computes"] == 0
+
+
+# -- idempotent dispatch (dedup cache + wire semantics) -------------------
+
+class TestDedupCache:
+    """The worker-side idempotency cache in isolation: bounded LRU,
+    attach-to-in-flight, replay-after-completion, and the deliberate
+    non-retention of failures."""
+
+    def _mk(self, capacity=4):
+        from raft_tpu.serving.worker import DedupCache
+        return DedupCache(capacity)
+
+    def _complete(self, cache, key, payload=b"x", cacheable=True):
+        entry, owner = cache.begin(key)
+        assert owner
+        cache.finish(key, entry, {"status": "ok"}, payload, cacheable)
+        return entry
+
+    def test_lru_eviction_under_churn_stays_bounded(self):
+        cache = self._mk(capacity=4)
+        for i in range(10):
+            self._complete(cache, f"k{i}", payload=bytes([i]))
+        s = cache.stats()
+        assert s["size"] == 4
+        assert s["inserts"] == 10
+        assert s["evictions"] == 6
+        # The survivors are the most recently used keys.
+        for i in range(6, 10):
+            entry, owner = cache.begin(f"k{i}")
+            assert not owner and entry.body == bytes([i])
+        # An evicted key recomputes honestly.
+        _, owner = cache.begin("k0")
+        assert owner
+
+    def test_duplicate_attaches_then_replays(self):
+        cache = self._mk()
+        entry, owner = cache.begin("req-1")
+        assert owner
+        # A concurrent duplicate attaches to the in-flight entry…
+        dup_entry, dup_owner = cache.begin("req-1")
+        assert not dup_owner and dup_entry is entry
+        assert not dup_entry.done.is_set()
+        cache.finish("req-1", entry, {"status": "ok"}, b"flow", True)
+        assert dup_entry.done.is_set() and dup_entry.body == b"flow"
+        # …and a later duplicate replays the completed reply.
+        late, late_owner = cache.begin("req-1")
+        assert not late_owner and late.body == b"flow"
+        s = cache.stats()
+        assert s["hits_inflight"] == 1 and s["replays"] == 1
+
+    def test_failures_are_not_retained_for_replay(self):
+        cache = self._mk()
+        entry, owner = cache.begin("req-1")
+        waiter, _ = cache.begin("req-1")
+        cache.finish("req-1", entry, {"status": "timeout"}, b"", False)
+        # The attached waiter still got the completion…
+        assert waiter.done.is_set()
+        # …but a retry of the failed key gets a fresh compute.
+        _, owner2 = cache.begin("req-1")
+        assert owner2
+        assert cache.stats()["inserts"] == 2
+
+    def test_eviction_never_strands_waiters(self):
+        """A waiter holds a direct entry reference: LRU eviction of
+        the key while the owner still computes must not lose the
+        completion signal."""
+        cache = self._mk(capacity=2)
+        entry, _ = cache.begin("old")
+        waiter, owner = cache.begin("old")
+        assert not owner
+        self._complete(cache, "new1")
+        self._complete(cache, "new2")     # "old" evicted here
+        assert cache.stats()["evictions"] == 1
+        cache.finish("old", entry, {"status": "ok"}, b"late", True)
+        assert waiter.done.is_set() and waiter.body == b"late"
+
+
+class _GateFuture:
+    def __init__(self, gate, value):
+        self._gate = gate
+        self._value = value
+
+    def result(self, timeout=None):
+        assert self._gate.wait(timeout=timeout or 30.0)
+        return self._value
+
+
+class _GateEngine(_StubEngine):
+    """Stub engine whose computes block on an Event — lets a test
+    hold a request in flight while duplicates arrive."""
+
+    def __init__(self):
+        super().__init__()
+        self.gate = threading.Event()
+
+    def submit(self, im1, im2, priority="high", iters=None,
+               trace_id=None, deadline_s=None):
+        self.submits.append({"shape": im1.shape})
+        flow = np.zeros((*im1.shape[:2], 2), np.float32)
+        return _GateFuture(self.gate, flow)
+
+
+class TestWorkerDedup:
+    """The dedup cache behind real sockets: one compute per key no
+    matter how many deliveries, bit-identical bytes on every reply."""
+
+    def _submit_header(self, frame, request_id=None, deadline=None):
+        hdr = {"op": "submit", "shape": list(frame.shape),
+               "dtype": str(frame.dtype), "split": frame.nbytes,
+               "priority": "high", "iters": None,
+               "deadline": deadline, "trace_id": None}
+        if request_id is not None:
+            hdr["request_id"] = request_id
+        return hdr
+
+    def test_replay_after_completion_is_bit_exact(self, stub_worker):
+        server, engine = stub_worker
+        frame = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+        tr = SocketTransport()
+        try:
+            hdr1, body1 = tr.request(
+                server.addr,
+                self._submit_header(frame, request_id="key-1"),
+                frame.tobytes() + frame.tobytes())
+            hdr2, body2 = tr.request(
+                server.addr,
+                self._submit_header(frame, request_id="key-1"),
+                frame.tobytes() + frame.tobytes())
+        finally:
+            tr.close()
+        assert hdr1["status"] == "ok" and hdr2["status"] == "ok"
+        assert "deduped" not in hdr1
+        assert hdr2["deduped"] is True
+        assert bytes(body1) == bytes(body2)
+        assert len(engine.submits) == 1     # exactly one compute
+        assert server.computes == 1
+        assert server.dedup.stats()["replays"] == 1
+
+    def test_concurrent_duplicate_attaches_to_in_flight(self, tmp_path):
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _GateEngine()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.05)
+        server = WorkerServer(engine, cfg).start(warmup=False)
+        frame = np.zeros((8, 8, 3), np.uint8)
+        hdr = self._submit_header(frame, request_id="key-inflight")
+        body = frame.tobytes() + frame.tobytes()
+        results = {}
+
+        def client(tag):
+            sock = socket.create_connection(server.addr, timeout=30.0)
+            try:
+                write_message(sock, hdr, body)
+                results[tag] = read_message(sock)
+            finally:
+                sock.close()
+
+        t1 = threading.Thread(target=client, args=("a",))
+        t2 = threading.Thread(target=client, args=("b",))
+        try:
+            t1.start()
+            deadline = time.monotonic() + 10.0
+            while not engine.submits:       # owner reached the engine
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            t2.start()
+            while server.dedup.stats()["hits_inflight"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            engine.gate.set()               # release the one compute
+            t1.join(30)
+            t2.join(30)
+            (h_a, b_a), (h_b, b_b) = results["a"], results["b"]
+            assert h_a["status"] == "ok" and h_b["status"] == "ok"
+            assert bytes(b_a) == bytes(b_b)     # bit-identical replies
+            assert len(engine.submits) == 1     # ONE engine compute
+            s = server.dedup.stats()
+            assert s["hits_inflight"] == 1 and s["inserts"] == 1
+        finally:
+            engine.gate.set()
+            server.stop()
+
+    def test_injected_duplicate_delivery_collapses(self, stub_worker):
+        """RAFT_FAULT_WORKER_DUP_DELIVERY_NTH: the transport replays a
+        frame it already delivered. Both passes share one request_id —
+        one engine compute, the duplicate's reply discarded to a
+        sink."""
+        from raft_tpu import resilience
+
+        server, engine = stub_worker
+        frame = np.zeros((8, 8, 3), np.uint8)
+        prev = resilience.set_injector(
+            resilience.FaultInjector(worker_dup_delivery_nth=1))
+        tr = SocketTransport()
+        try:
+            hdr, _ = tr.request(
+                server.addr,
+                self._submit_header(frame, request_id="key-dup"),
+                frame.tobytes() + frame.tobytes())
+            assert hdr["status"] == "ok"
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                s = server.dedup.stats()
+                if s["hits_inflight"] + s["replays"] >= 1:
+                    break
+                time.sleep(0.01)
+        finally:
+            resilience.set_injector(prev)
+            tr.close()
+        assert server.dup_deliveries == 1
+        assert len(engine.submits) == 1     # the dup never recomputed
+        s = server.dedup.stats()
+        assert s["inserts"] == 1
+        assert s["hits_inflight"] + s["replays"] == 1
+
+    def test_cache_dies_with_the_process(self, tmp_path):
+        """Restart = honest recompute: the cache survives nothing
+        across process death (determinism makes the recompute
+        bit-identical, so replay is an optimization, never a
+        correctness crutch)."""
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        frame = np.zeros((8, 8, 3), np.uint8)
+        hdr = self._submit_header(frame, request_id="key-respawn")
+        body = frame.tobytes() + frame.tobytes()
+        replies = []
+        for incarnation in range(2):
+            engine = _StubEngine()
+            cfg = WorkerConfig(worker_id="w0",
+                               lease_dir=str(tmp_path),
+                               heartbeat_interval_s=0.05)
+            server = WorkerServer(engine, cfg).start(warmup=False)
+            tr = SocketTransport()
+            try:
+                replies.append(tr.request(server.addr, dict(hdr), body))
+            finally:
+                tr.close()
+                server.stop()
+            # Each incarnation computed for itself: no replay marker,
+            # exactly one engine submit per process lifetime.
+            assert len(engine.submits) == 1
+            assert "deduped" not in replies[-1][0]
+        assert bytes(replies[0][1]) == bytes(replies[1][1])
+
+    def test_no_request_id_means_no_dedup(self, stub_worker):
+        """A keyless frame (legacy caller) computes every time — dedup
+        is opt-in via the wire key, never inferred."""
+        server, engine = stub_worker
+        frame = np.zeros((8, 8, 3), np.uint8)
+        tr = SocketTransport()
+        try:
+            for _ in range(2):
+                hdr, _ = tr.request(
+                    server.addr, self._submit_header(frame),
+                    frame.tobytes() + frame.tobytes())
+                assert hdr["status"] == "ok"
+        finally:
+            tr.close()
+        assert len(engine.submits) == 2
+        assert server.dedup.stats()["inserts"] == 0
+
+
+# -- SDC sentinel / quarantine -------------------------------------------
+
+class TestSDCSentinel:
+    def _worker(self, tmp_path, interval=0.02):
+        from raft_tpu.serving.worker import WorkerConfig, WorkerServer
+
+        engine = _StubEngine()
+        cfg = WorkerConfig(worker_id="w0", lease_dir=str(tmp_path),
+                           heartbeat_interval_s=0.02,
+                           buckets=((8, 8),),
+                           self_check_interval_s=interval)
+        return WorkerServer(engine, cfg), engine
+
+    def _wait(self, cond, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while not cond():
+            assert time.monotonic() < deadline, "condition never met"
+            time.sleep(0.01)
+
+    def test_healthy_sentinel_keeps_worker_routable(self, tmp_path):
+        server, engine = self._worker(tmp_path)
+        server.start(warmup=False)
+        try:
+            self._wait(lambda: server.store.read_all()["w0"]
+                       .extra.get("self_checks", 0) >= 2)
+            lease = server.store.read_all()["w0"]
+            assert lease.state == "ready"
+            assert "quarantine_reason" not in lease.extra
+        finally:
+            server.stop()
+
+    def test_injected_sdc_flips_lease_to_quarantined(self, tmp_path):
+        from raft_tpu import resilience
+        from raft_tpu.serving.health import QUARANTINED, is_routable
+
+        server, engine = self._worker(tmp_path)
+        prev = resilience.set_injector(
+            resilience.FaultInjector(worker_sdc_nth=1))
+        try:
+            server.start(warmup=False)
+            self._wait(lambda: server.store.read_all()["w0"].state
+                       == QUARANTINED)
+            lease = server.store.read_all()["w0"]
+            assert not is_routable(lease.state)
+            assert "EPE drift" in lease.extra["quarantine_reason"]
+            # A submit that raced the announcement gets a typed
+            # post-acceptance error the failover contract walks past —
+            # never a result the sentinel declared untrustworthy.
+            frame = np.zeros((8, 8, 3), np.uint8)
+            tr = SocketTransport()
+            try:
+                hdr, _ = tr.request(
+                    server.addr,
+                    {"op": "submit", "shape": list(frame.shape),
+                     "dtype": "uint8", "split": frame.nbytes,
+                     "priority": "high", "iters": None,
+                     "deadline": None, "trace_id": None},
+                    frame.tobytes() + frame.tobytes())
+            finally:
+                tr.close()
+            assert hdr["status"] == "error"
+            assert hdr["error_type"] == "WorkerQuarantined"
+        finally:
+            resilience.set_injector(prev)
+            server.stop()
+
+    def test_sentinel_runs_zero_extra_compiles(self, tmp_path):
+        """The golden pair is the first configured bucket shape — a
+        warmed executable by construction, so self-checks can never
+        introduce fresh compiles."""
+        server, engine = self._worker(tmp_path)
+        server.start(warmup=False)
+        try:
+            self._wait(lambda: server._self_checks >= 2)
+            lease = server.store.read_all()["w0"]
+            assert lease.extra["post_warmup_compiles"] == 0
+            # Golden pair matches bucket 0's shape exactly.
+            assert all(s["shape"] == (8, 8, 3)
+                       for s in engine.submits)
+        finally:
+            server.stop()
 
 
 # -- end to end (real engine, real sockets, one process) -----------------
@@ -1038,6 +1721,24 @@ def test_gateway_drill_subprocess():
     assert proc.returncode == 0, \
         f"drill failed:\n{proc.stdout}\n{proc.stderr}"
     assert "PASS drill_gateway" in proc.stdout
+
+
+@pytest.mark.slow
+def test_reliability_drill_subprocess():
+    """End-to-end request reliability: injected duplicate delivery,
+    reply lost after acceptance (same-key retry, bit-exact), hedging
+    against an injected stall, SDC quarantine -> supervisor recycle ->
+    rejoin. Slow-marked — spawns real interpreters and warms engines."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RAFT_BENCH_OUT", None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "serve_drill.py"),
+         "--drill", "reliability"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"drill failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "PASS drill_reliability" in proc.stdout
 
 
 @pytest.mark.slow

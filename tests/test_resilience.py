@@ -217,6 +217,30 @@ def test_fault_injector_new_serving_knobs(monkeypatch):
     assert off.maybe_stale_pool() is False
 
 
+def test_fault_injector_reliability_knobs(monkeypatch):
+    monkeypatch.setenv("RAFT_FAULT_WORKER_DUP_DELIVERY_NTH", "2")
+    monkeypatch.setenv("RAFT_FAULT_WORKER_SDC_NTH", "3")
+    inj = FaultInjector.from_env()
+    assert inj.worker_dup_delivery_nth == 2
+    assert inj.worker_sdc_nth == 3
+    assert inj.active
+    assert FaultInjector(worker_dup_delivery_nth=1).active
+    assert FaultInjector(worker_sdc_nth=1).active
+    # Both fire deterministically on exactly their 1-based sequence
+    # number: dup-delivery by receive order, SDC by self-check order.
+    assert [inj.duplicates_worker_request(i) for i in (1, 2, 3)] == \
+        [False, True, False]
+    assert [inj.corrupts_self_check(i) for i in (1, 2, 3, 4)] == \
+        [False, False, True, False]
+    # Disabled and off-target injectors never fire.
+    assert not FaultInjector().duplicates_worker_request(2)
+    assert not FaultInjector().corrupts_self_check(3)
+    off = FaultInjector(worker_dup_delivery_nth=2, worker_sdc_nth=3,
+                        target_process=jax.process_index() + 1)
+    assert not off.duplicates_worker_request(2)
+    assert not off.corrupts_self_check(3)
+
+
 def test_fault_knob_docstring_matches_from_env():
     """Consistency lint: every RAFT_FAULT_* knob documented in the
     FaultInjector docstring is parsed by from_env, and every knob
